@@ -1,0 +1,35 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch JAX/XLA implementation with the capability surface of LightGBM
+(see SURVEY.md at the repo root for the reference structural map). Import-compatible
+with common LightGBM user code:
+
+    import lightgbm_tpu as lgb
+    bst = lgb.train(params, lgb.Dataset(X, label=y))
+"""
+from .basic import Booster, Dataset
+from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .engine import CVBooster, cv, train
+from .utils.log import LightGBMError, register_logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
+    "LightGBMError", "register_logger",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
+]
+
+
+def __getattr__(name):
+    # lazy imports for optional-dependency modules (sklearn API, plotting)
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name in ("plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
+                "plot_split_value_histogram"):
+        from . import plotting as _pl
+        return getattr(_pl, name)
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

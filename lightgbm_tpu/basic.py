@@ -1,0 +1,598 @@
+"""Dataset and Booster — the primary user-facing objects.
+
+Reference: python-package/lightgbm/basic.py (Dataset :1692, Booster :3495). The reference
+binds a C++ core over ctypes; here the "core" is the JAX engine in-process, so Dataset
+directly owns the host binning result and the device bin matrix, and Booster owns the
+boosting engine. Public method surface mirrors the reference so existing LightGBM user
+code ports by changing the import.
+"""
+from __future__ import annotations
+
+import copy
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .binning import BinnedData, construct_binned, find_bin_mappers, find_feature_groups
+from .config import Config, resolve_aliases
+from .device_data import DeviceData, to_device
+from .metrics import create_metrics
+from .objectives import create_objective
+from .utils.log import LightGBMError, log_info, log_warning, set_verbosity
+
+_LABEL_FIELDS = ("label", "weight", "group", "init_score", "position")
+
+
+def _to_2d_float(data) -> Tuple[np.ndarray, Optional[List[str]], List[int]]:
+    """Coerce supported data containers to float64 ndarray; returns
+    (array, feature_names or None, pandas_categorical_indices)."""
+    feature_names = None
+    cat_idx: List[int] = []
+    if hasattr(data, "dtypes") and hasattr(data, "columns"):  # pandas DataFrame
+        import pandas as pd
+        feature_names = [str(c) for c in data.columns]
+        df = data.copy()
+        for i, col in enumerate(df.columns):
+            if isinstance(df[col].dtype, pd.CategoricalDtype):
+                df[col] = df[col].cat.codes
+                cat_idx.append(i)
+            elif df[col].dtype == object:
+                raise LightGBMError(f"DataFrame column {col!r} has object dtype; "
+                                    "convert to numeric or categorical first")
+        arr = df.to_numpy(dtype=np.float64, na_value=np.nan)
+        return arr, feature_names, cat_idx
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr, feature_names, cat_idx
+
+
+def _scipy_to_dense(data):
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(data):
+            return np.asarray(data.todense(), dtype=np.float64)
+    except ImportError:
+        pass
+    return None
+
+
+class Dataset:
+    """Training/validation dataset with lazy binning (reference: basic.py:1692)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False, position=None):
+        self.params = dict(params or {})
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+        self._feature_name_arg = feature_name
+        self._categorical_feature_arg = categorical_feature
+        self._predictor = None
+
+        if isinstance(data, (str, Path)):
+            from .dataset_io import load_data_file
+            data, label_file = load_data_file(str(data), self.params)
+            if label is None:
+                label = label_file
+        sp = _scipy_to_dense(data)
+        if sp is not None:
+            data = sp
+        self.raw_data, self._pandas_names, pandas_cat = _to_2d_float(data)
+        self.num_data_, self.num_feature_ = self.raw_data.shape
+        self._pandas_cat_idx = pandas_cat
+
+        self.label = None if label is None else np.asarray(label, np.float64).reshape(-1)
+        self.weight = None if weight is None else np.asarray(weight, np.float64).reshape(-1)
+        self.init_score = None if init_score is None else np.asarray(init_score, np.float64)
+        self.position = None if position is None else np.asarray(position, np.int32).reshape(-1)
+        self.group = None
+        if group is not None:
+            g = np.asarray(group, np.int64).reshape(-1)
+            self.group = g
+
+        self.binned: Optional[BinnedData] = None
+        self._device: Optional[DeviceData] = None
+        self._resolved_feature_names: Optional[List[str]] = None
+
+    # ------------------------------------------------------------------
+    def _resolve_categorical(self) -> List[int]:
+        arg = self._categorical_feature_arg
+        names = self.feature_name()
+        cats = list(self._pandas_cat_idx)
+        if arg == "auto" or arg is None or arg == "":
+            return cats
+        for c in (arg if isinstance(arg, (list, tuple)) else [arg]):
+            if isinstance(c, str):
+                if c in names:
+                    cats.append(names.index(c))
+                else:
+                    log_warning(f"categorical_feature {c!r} not found in features")
+            else:
+                cats.append(int(c))
+        return sorted(set(cats))
+
+    def feature_name(self) -> List[str]:
+        if self._resolved_feature_names is not None:
+            return self._resolved_feature_names
+        arg = self._feature_name_arg
+        if isinstance(arg, list):
+            names = [str(x) for x in arg]
+        elif self._pandas_names is not None:
+            names = self._pandas_names
+        else:
+            names = [f"Column_{i}" for i in range(self.num_feature_)]
+        self._resolved_feature_names = names
+        return names
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self.binned is not None:
+            return self
+        cfg = Config.from_params(self.params)
+        if self.reference is not None:
+            ref = self.reference.construct()
+            mappers = ref.binned.bin_mappers
+            groups = ref.binned.group_features
+            self.binned = construct_binned(self.raw_data, mappers, groups)
+        else:
+            cats = self._resolve_categorical()
+            mappers = find_bin_mappers(
+                self.raw_data, max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                categorical_features=cats,
+                use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
+                sample_cnt=cfg.bin_construct_sample_cnt, seed=cfg.data_random_seed,
+                max_bin_by_feature=cfg.max_bin_by_feature)
+            groups = None
+            if cfg.enable_bundle:
+                sample_n = min(self.num_data_, cfg.bin_construct_sample_cnt)
+                rng = np.random.RandomState(cfg.data_random_seed)
+                idx = (np.arange(self.num_data_) if self.num_data_ <= sample_n else
+                       np.sort(rng.choice(self.num_data_, sample_n, replace=False)))
+                sample_bins = [mappers[f].transform(self.raw_data[idx, f])
+                               for f in range(self.num_feature_)]
+                groups = find_feature_groups(sample_bins, mappers,
+                                             enable_bundle=True)
+            self.binned = construct_binned(self.raw_data, mappers, groups)
+        if self.free_raw_data:
+            self.raw_data = None
+        return self
+
+    def device_data(self) -> DeviceData:
+        if self._device is None:
+            self.construct()
+            self._device = to_device(self.binned)
+        return self._device
+
+    def bin_mappers(self):
+        self.construct()
+        return self.binned.bin_mappers
+
+    # ------------------------------------------------------------------
+    def num_data(self) -> int:
+        return self.num_data_
+
+    def num_feature(self) -> int:
+        return self.num_feature_
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return self.label
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self.weight
+
+    def get_group(self) -> Optional[np.ndarray]:
+        return self.group
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return self.init_score
+
+    def get_position(self) -> Optional[np.ndarray]:
+        return self.position
+
+    def set_label(self, label) -> "Dataset":
+        self.label = None if label is None else np.asarray(label, np.float64).reshape(-1)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = (None if weight is None
+                       else np.asarray(weight, np.float64).reshape(-1))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = None if group is None else np.asarray(group, np.int64).reshape(-1)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = (None if init_score is None
+                           else np.asarray(init_score, np.float64))
+        return self
+
+    def set_position(self, position) -> "Dataset":
+        self.position = (None if position is None
+                         else np.asarray(position, np.int32).reshape(-1))
+        return self
+
+    def get_field(self, field_name: str):
+        if field_name not in _LABEL_FIELDS:
+            raise LightGBMError(f"Unknown field {field_name}")
+        return getattr(self, field_name if field_name != "group" else "group")
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        if field_name == "position":
+            return self.set_position(data)
+        raise LightGBMError(f"Unknown field {field_name}")
+
+    # -- helpers used by the boosting engine ---------------------------
+    def get_query_boundaries(self) -> Optional[np.ndarray]:
+        if self.group is None:
+            return None
+        return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+
+    def get_label_padded(self, n: int) -> Optional[np.ndarray]:
+        if self.label is None:
+            return None
+        out = np.zeros(n, np.float64)
+        out[:len(self.label)] = self.label
+        return out
+
+    def get_init_score_padded(self, n: int, k: int) -> Optional[np.ndarray]:
+        if self.init_score is None:
+            return None
+        s = self.init_score
+        if k == 1:
+            out = np.zeros(n, np.float32)
+            out[:len(s)] = s.reshape(-1)
+        else:
+            s2 = s.reshape(self.num_data_, k) if s.ndim == 1 and s.size == self.num_data_ * k \
+                else s.reshape(-1, k) if s.ndim == 2 else np.tile(s.reshape(-1, 1), (1, k))
+            out = np.zeros((n, k), np.float32)
+            out[:s2.shape[0]] = s2
+        return out
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight, group=group,
+                       init_score=init_score, params=params or self.params,
+                       position=position)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        if self.raw_data is None:
+            raise LightGBMError("cannot subset after raw data was freed")
+        idx = np.asarray(used_indices, np.int64)
+        sub = Dataset(
+            self.raw_data[idx],
+            label=None if self.label is None else self.label[idx],
+            weight=None if self.weight is None else self.weight[idx],
+            init_score=None if self.init_score is None else
+            (self.init_score[idx] if self.init_score.ndim == 1
+             else self.init_score[idx, :]),
+            reference=self if self.binned is not None else self.reference or self,
+            feature_name=self._feature_name_arg,
+            categorical_feature=self._categorical_feature_arg,
+            params=params or self.params)
+        # note: group subsetting requires query-aligned indices (same as reference)
+        return sub
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize the binned dataset (reference: Dataset::SaveBinaryFile)."""
+        import pickle
+        self.construct()
+        with open(filename, "wb") as f:
+            pickle.dump({"binned": self.binned, "label": self.label,
+                         "weight": self.weight, "group": self.group,
+                         "init_score": self.init_score}, f)
+        return self
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        if self.raw_data is None or other.raw_data is None:
+            raise LightGBMError("add_features_from requires raw data")
+        self.raw_data = np.hstack([self.raw_data, other.raw_data])
+        self.num_feature_ = self.raw_data.shape[1]
+        self.binned = None
+        self._device = None
+        self._resolved_feature_names = None
+        return self
+
+
+class Booster:
+    """Booster (reference: basic.py:3495). Wraps the boosting engine."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._engine = None
+        self._loaded_trees = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set must be a Dataset")
+            self.params = resolve_aliases(params)
+            cfg = Config.from_params(params)
+            set_verbosity(cfg.verbosity)
+            # merge dataset params (dataset params win for binning keys)
+            train_set.params = {**params, **train_set.params}
+            train_set.construct()
+            objective = create_objective(cfg)
+            if objective is not None:
+                n = train_set.num_data()
+                if train_set.get_label() is None:
+                    raise LightGBMError("training requires labels")
+                objective.init(train_set.get_label(), train_set.get_weight(),
+                               query_boundaries=train_set.get_query_boundaries(),
+                               position=train_set.get_position(), n=n)
+            metrics = create_metrics(cfg, objective.name if objective else "none")
+            for m in metrics:
+                m.init(train_set.get_label() if train_set.get_label() is not None
+                       else np.zeros(train_set.num_data()),
+                       train_set.get_weight(), train_set.get_query_boundaries())
+            from .models.gbdt import create_boosting
+            self._engine = create_boosting(cfg, train_set, objective, metrics)
+            self.config = cfg
+            self.train_set = train_set
+        elif model_file is not None or model_str is not None:
+            from .model_io import load_model_string
+            if model_file is not None:
+                model_str = Path(model_file).read_text()
+            loaded = load_model_string(model_str)
+            self._loaded_trees = loaded
+            self.params = params
+            self.config = Config.from_params(params)
+        else:
+            raise LightGBMError("need train_set or model_file/model_str")
+
+    # ------------------------------------------------------------------
+    @property
+    def engine(self):
+        if self._engine is None:
+            raise LightGBMError("Booster was loaded from a model file; "
+                                "training operations unavailable")
+        return self._engine
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (reference: Booster.update, basic.py:4005)."""
+        if train_set is not None and train_set is not getattr(self, "train_set", None):
+            raise LightGBMError("changing train_set after construction is not supported")
+        if fobj is not None:
+            score = self.engine._unpad_score()
+            grad, hess = fobj(np.asarray(score), self.train_set)
+            return self.engine.train_one_iter(np.asarray(grad, np.float32),
+                                              np.asarray(hess, np.float32))
+        return self.engine.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self.engine.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self.engine.iter_ if self._engine else \
+            len(self._loaded_trees.trees) // max(self._loaded_trees.num_tree_per_iteration, 1)
+
+    def num_trees(self) -> int:
+        if self._engine:
+            return len(self.engine.models)
+        return len(self._loaded_trees.trees)
+
+    def num_model_per_iteration(self) -> int:
+        if self._engine:
+            return self.engine.num_tree_per_iteration
+        return self._loaded_trees.num_tree_per_iteration
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        metrics = create_metrics(
+            self.config,
+            self.engine.objective.name if self.engine.objective else "none")
+        for m in metrics:
+            m.init(data.get_label() if data.get_label() is not None
+                   else np.zeros(data.num_data()),
+                   data.get_weight(), data.get_query_boundaries())
+        self.engine.add_valid(data, name, metrics)
+        return self
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        out = [(n, m, v, hb) for (n, m, v, hb) in self.engine.eval_train()]
+        out.extend(self._run_feval(feval, "training", self.engine.train_data,
+                                   np.asarray(self.engine._unpad_score())))
+        return out
+
+    def eval_valid(self, feval=None) -> List:
+        out = [(n, m, v, hb) for (n, m, v, hb) in self.engine.eval_valid()]
+        for vi, vset in enumerate(self.engine.valid_sets):
+            n = vset.num_data()
+            score = np.asarray(self.engine._valid_scores[vi][:n])
+            out.extend(self._run_feval(feval, self.engine.valid_names[vi], vset, score))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        for vi, vset in enumerate(self.engine.valid_sets):
+            if vset is data:
+                n = vset.num_data()
+                score = np.asarray(self.engine._valid_scores[vi][:n])
+                out = []
+                conv = (self.engine.objective.convert_output
+                        if self.engine.objective else (lambda x: x))
+                for m in self.engine.valid_metrics[vi]:
+                    for (mn, v, hb) in m.evaluate(score, conv):
+                        out.append((name, mn, v, hb))
+                out.extend(self._run_feval(feval, name, vset, score))
+                return out
+        raise LightGBMError("eval() requires the dataset to be added via add_valid")
+
+    def _run_feval(self, feval, name, dset, raw_score) -> List:
+        if feval is None:
+            return []
+        fevals = feval if isinstance(feval, list) else [feval]
+        out = []
+        for f in fevals:
+            res = f(raw_score, dset)
+            if isinstance(res, tuple):
+                res = [res]
+            for (mn, v, hb) in res:
+                out.append((name, mn, float(v), bool(hb)))
+        return out
+
+    # ------------------------------------------------------------------
+    def _all_trees(self):
+        if self._engine is not None:
+            return self.engine.models
+        return self._loaded_trees.trees
+
+    def predict(self, data, start_iteration: int = 0, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, validate_features: bool = False,
+                **kwargs) -> np.ndarray:
+        """Predict (reference: Booster.predict, basic.py:4625)."""
+        if isinstance(data, Dataset):
+            raise LightGBMError("predict() takes raw data, not a Dataset")
+        sp = _scipy_to_dense(data)
+        if sp is not None:
+            data = sp
+        X, _, _ = _to_2d_float(data)
+        trees = self._all_trees()
+        k = self.num_model_per_iteration()
+        n_total_iters = len(trees) // max(k, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration and self.best_iteration > 0
+                             else n_total_iters)
+        end_iteration = min(start_iteration + num_iteration, n_total_iters)
+        use = trees[start_iteration * k:end_iteration * k]
+
+        if pred_leaf:
+            out = np.zeros((X.shape[0], len(use)), np.int32)
+            for i, t in enumerate(use):
+                out[:, i] = t.predict_leaf_raw(X)
+            return out
+        if pred_contrib:
+            from .shap import predict_contrib
+            return predict_contrib(use, X, k)
+
+        n = X.shape[0]
+        # init scores are folded into tree 0 at training time (AddBias), so a plain
+        # sum over trees is the complete raw score
+        if k == 1:
+            score = np.zeros(n, np.float64)
+            for t in use:
+                score += t.predict_raw(X)
+        else:
+            score = np.zeros((n, k), np.float64)
+            for i, t in enumerate(use):
+                score[:, i % k] += t.predict_raw(X)
+        if self._average_output() and len(use):
+            score = score / max(len(use) // max(k, 1), 1)
+        if raw_score:
+            return score
+        conv = self._convert_output_fn()
+        return np.asarray(conv(score))
+
+    def _average_output(self) -> bool:
+        if self._engine is not None:
+            return self.engine._average_output
+        if self._loaded_trees is not None:
+            return self._loaded_trees.average_output
+        return False
+
+    def _convert_output_fn(self):
+        if self._engine is not None and self.engine.objective is not None:
+            return self.engine.objective.convert_output
+        if self._loaded_trees is not None:
+            return self._loaded_trees.convert_output
+        return lambda x: x
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0, importance_type: str = "split") -> "Booster":
+        Path(filename).write_text(self.model_to_string(num_iteration, start_iteration,
+                                                       importance_type))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        from .model_io import save_model_string
+        return save_model_string(self, num_iteration, start_iteration, importance_type)
+
+    def dump_model(self, num_iteration: Optional[int] = None, start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        from .model_io import dump_model_dict
+        return dump_model_dict(self, num_iteration, start_iteration, importance_type)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        trees = self._all_trees()
+        if iteration is not None and iteration > 0:
+            trees = trees[:iteration * self.num_model_per_iteration()]
+        nf = self.num_feature()
+        imp = np.zeros(nf, np.float64)
+        for t in trees:
+            for i in range(t.num_leaves - 1):
+                f = int(t.split_feature[i])
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += float(t.split_gain[i])
+        if importance_type == "split":
+            return imp.astype(np.int32)
+        return imp
+
+    def num_feature(self) -> int:
+        if self._engine is not None:
+            return self.train_set.num_feature()
+        return self._loaded_trees.max_feature_idx + 1
+
+    def feature_name(self) -> List[str]:
+        if self._engine is not None:
+            return self.train_set.feature_name()
+        return self._loaded_trees.feature_names
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        resolved = resolve_aliases(params)
+        self.engine.config.update(resolved)
+        self.params.update(resolved)
+        # learning-rate etc. take effect next iteration; tree-shape params
+        # require new grow params
+        self.engine._grow_params = self.engine._make_grow_params()
+        import functools
+        from .ops.grow import grow_tree as _gt
+        import jax
+        self.engine._grow_fn = jax.jit(functools.partial(
+            _gt, layout=self.engine.dd.layout, routing=self.engine.dd.routing,
+            params=self.engine._grow_params))
+        return self
+
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+        from .model_io import refit_model
+        return refit_model(self, data, label, decay_rate, **kwargs)
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        model_str = self.model_to_string()
+        return Booster(model_str=model_str)

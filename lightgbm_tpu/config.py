@@ -1,0 +1,510 @@
+"""Parameter/config system.
+
+TPU-native re-design of the reference config layer (reference: include/LightGBM/config.h:41,
+src/io/config.cpp, src/io/config_auto.cpp — a flat struct of ~147 documented parameters plus a
+>300-entry alias table generated from doc comments). Here the config is a plain dataclass; the
+alias table is hand-maintained; unknown parameters warn (Python-style pass-through) instead of
+being fatal, matching the Python-package behaviour.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .utils.log import log_warning
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: src/io/config_auto.cpp alias map; config.cpp:23-98 resolution rules:
+# first the canonical name wins, then aliases in table order).
+# ---------------------------------------------------------------------------
+
+_PARAM_ALIASES: Dict[str, List[str]] = {
+    "config": ["config_file"],
+    "task": ["task_type"],
+    "objective": ["objective_type", "app", "application", "loss"],
+    "boosting": ["boosting_type", "boost"],
+    "data_sample_strategy": [],
+    "data": ["train", "train_data", "train_data_file", "data_filename"],
+    "valid": ["test", "valid_data", "valid_data_file", "test_data", "test_data_file",
+              "valid_filenames"],
+    "num_iterations": ["num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+                       "num_rounds", "nrounds", "num_boost_round", "n_estimators",
+                       "max_iter"],
+    "learning_rate": ["shrinkage_rate", "eta"],
+    "num_leaves": ["num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"],
+    "tree_learner": ["tree", "tree_type", "tree_learner_type"],
+    "num_threads": ["num_thread", "nthread", "nthreads", "n_jobs"],
+    "device_type": ["device"],
+    "seed": ["random_seed", "random_state"],
+    "deterministic": [],
+    "force_col_wise": [],
+    "force_row_wise": [],
+    "histogram_pool_size": ["hist_pool_size"],
+    "max_depth": [],
+    "min_data_in_leaf": ["min_data_per_leaf", "min_data", "min_child_samples",
+                         "min_samples_leaf"],
+    "min_sum_hessian_in_leaf": ["min_sum_hessian_per_leaf", "min_sum_hessian",
+                                "min_hessian", "min_child_weight"],
+    "bagging_fraction": ["sub_row", "subsample", "bagging"],
+    "pos_bagging_fraction": ["pos_sub_row", "pos_subsample", "pos_bagging"],
+    "neg_bagging_fraction": ["neg_sub_row", "neg_subsample", "neg_bagging"],
+    "bagging_freq": ["subsample_freq"],
+    "bagging_seed": ["bagging_fraction_seed"],
+    "bagging_by_query": [],
+    "feature_fraction": ["sub_feature", "colsample_bytree"],
+    "feature_fraction_bynode": ["sub_feature_bynode", "colsample_bynode"],
+    "feature_fraction_seed": [],
+    "extra_trees": ["extra_tree"],
+    "extra_seed": [],
+    "early_stopping_round": ["early_stopping_rounds", "early_stopping",
+                             "n_iter_no_change"],
+    "early_stopping_min_delta": [],
+    "first_metric_only": [],
+    "max_delta_step": ["max_tree_output", "max_leaf_output"],
+    "lambda_l1": ["reg_alpha", "l1_regularization"],
+    "lambda_l2": ["reg_lambda", "lambda", "l2_regularization"],
+    "linear_lambda": [],
+    "min_gain_to_split": ["min_split_gain"],
+    "drop_rate": ["rate_drop"],
+    "max_drop": [],
+    "skip_drop": [],
+    "xgboost_dart_mode": [],
+    "uniform_drop": [],
+    "drop_seed": [],
+    "top_rate": [],
+    "other_rate": [],
+    "min_data_per_group": [],
+    "max_cat_threshold": [],
+    "cat_l2": [],
+    "cat_smooth": [],
+    "max_cat_to_onehot": [],
+    "top_k": ["topk"],
+    "monotone_constraints": ["mc", "monotone_constraint", "monotonic_cst"],
+    "monotone_constraints_method": ["monotone_constraining_method", "mc_method"],
+    "monotone_penalty": ["monotone_splits_penalty", "ms_penalty", "mc_penalty"],
+    "feature_contri": ["feature_contrib", "fc", "fp", "feature_penalty"],
+    "forcedsplits_filename": ["fs", "forced_splits_filename", "forced_splits_file",
+                              "forced_splits"],
+    "refit_decay_rate": [],
+    "cegb_tradeoff": [],
+    "cegb_penalty_split": [],
+    "cegb_penalty_feature_lazy": [],
+    "cegb_penalty_feature_coupled": [],
+    "path_smooth": [],
+    "interaction_constraints": [],
+    "verbosity": ["verbose"],
+    "input_model": ["model_input", "model_in"],
+    "output_model": ["model_output", "model_out"],
+    "saved_feature_importance_type": [],
+    "snapshot_freq": ["save_period"],
+    "linear_tree": ["linear_trees"],
+    "max_bin": ["max_bins"],
+    "max_bin_by_feature": [],
+    "min_data_in_bin": [],
+    "bin_construct_sample_cnt": ["subsample_for_bin"],
+    "data_random_seed": ["data_seed"],
+    "is_enable_sparse": ["is_sparse", "enable_sparse", "sparse"],
+    "enable_bundle": ["is_enable_bundle", "bundle"],
+    "use_missing": [],
+    "zero_as_missing": [],
+    "feature_pre_filter": [],
+    "pre_partition": ["is_pre_partition"],
+    "two_round": ["two_round_loading", "use_two_round_loading"],
+    "header": ["has_header"],
+    "label_column": ["label"],
+    "weight_column": ["weight"],
+    "group_column": ["group", "group_id", "query_column", "query", "query_id"],
+    "ignore_column": ["ignore_feature", "blacklist"],
+    "categorical_feature": ["cat_feature", "categorical_column", "cat_column",
+                            "categorical_features"],
+    "forcedbins_filename": [],
+    "save_binary": ["is_save_binary", "is_save_binary_file"],
+    "precise_float_parser": [],
+    "parser_config_file": [],
+    "start_iteration_predict": [],
+    "num_iteration_predict": [],
+    "predict_raw_score": ["is_predict_raw_score", "predict_rawscore", "raw_score"],
+    "predict_leaf_index": ["is_predict_leaf_index", "leaf_index"],
+    "predict_contrib": ["is_predict_contrib", "contrib"],
+    "predict_disable_shape_check": [],
+    "pred_early_stop": [],
+    "pred_early_stop_freq": [],
+    "pred_early_stop_margin": [],
+    "output_result": ["predict_result", "prediction_result", "predict_name",
+                      "prediction_name", "pred_name", "name_pred"],
+    "convert_model_language": [],
+    "convert_model": ["convert_model_file"],
+    "objective_seed": [],
+    "num_class": ["num_classes"],
+    "is_unbalance": ["unbalance", "unbalanced_sets"],
+    "scale_pos_weight": [],
+    "sigmoid": [],
+    "boost_from_average": [],
+    "reg_sqrt": [],
+    "alpha": [],
+    "fair_c": [],
+    "poisson_max_delta_step": [],
+    "tweedie_variance_power": [],
+    "lambdarank_truncation_level": [],
+    "lambdarank_norm": [],
+    "label_gain": [],
+    "lambdarank_position_bias_regularization": [],
+    "metric": ["metrics", "metric_types"],
+    "metric_freq": ["output_freq"],
+    "is_provide_training_metric": ["training_metric", "is_training_metric",
+                                   "train_metric"],
+    "eval_at": ["ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"],
+    "multi_error_top_k": [],
+    "auc_mu_weights": [],
+    "num_machines": ["num_machine"],
+    "local_listen_port": ["local_port", "port"],
+    "time_out": [],
+    "machine_list_filename": ["machine_list_file", "machine_list", "mlist"],
+    "machines": ["workers", "nodes"],
+    "gpu_platform_id": [],
+    "gpu_device_id": [],
+    "gpu_use_dp": [],
+    "num_gpu": [],
+    "use_quantized_grad": [],
+    "num_grad_quant_bins": [],
+    "quant_train_renew_leaf": [],
+    "stochastic_rounding": [],
+    # --- TPU-specific knobs (new in this framework) ---
+    "hist_backend": [],          # auto | segsum | onehot | pallas
+    "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
+    "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
+    "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
+}
+
+# alias -> canonical
+_ALIAS_TO_CANONICAL: Dict[str, str] = {}
+for _canon, _aliases in _PARAM_ALIASES.items():
+    for _a in _aliases:
+        _ALIAS_TO_CANONICAL[_a] = _canon
+
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg", "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile", "huber": "huber", "fair": "fair",
+    "poisson": "poisson",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc", "average_precision": "average_precision",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "r2": "r2",
+    "": "", "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def canonical_objective(name: str) -> str:
+    name = name.strip().lower()
+    if name not in _OBJECTIVE_ALIASES:
+        raise ValueError(f"Unknown objective: {name!r}")
+    return _OBJECTIVE_ALIASES[name]
+
+
+def canonical_metric(name: str) -> str:
+    name = name.strip().lower()
+    if name not in _METRIC_ALIASES:
+        raise ValueError(f"Unknown metric: {name!r}")
+    return _METRIC_ALIASES[name]
+
+
+@dataclass
+class Config:
+    """Flat parameter set (reference: include/LightGBM/config.h:41)."""
+
+    # Core
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data_sample_strategy: str = "bagging"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: Optional[int] = None
+    deterministic: bool = False
+
+    # Learning control
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    bagging_by_query: bool = False
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    early_stopping_min_delta: float = 0.0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    linear_lambda: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: Any = None
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: Any = None
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: Any = None
+    cegb_penalty_feature_coupled: Any = None
+    path_smooth: float = 0.0
+    interaction_constraints: Any = None
+    verbosity: int = 1
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    saved_feature_importance_type: int = 0
+    snapshot_freq: int = -1
+    linear_tree: bool = False
+
+    # Dataset
+    max_bin: int = 255
+    max_bin_by_feature: Any = None
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: Any = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+    precise_float_parser: bool = False
+
+    # Predict
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    output_result: str = "LightGBM_predict_result.txt"
+
+    # Objective
+    objective_seed: int = 5
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: Any = None
+    lambdarank_position_bias_regularization: float = 0.0
+
+    # Metric
+    metric: Any = ""
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: Any = None  # default [1,2,3,4,5]
+    multi_error_top_k: int = 1
+    auc_mu_weights: Any = None
+
+    # Network (kept for API parity; TPU uses jax.distributed + mesh axes instead)
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # GPU params accepted for compat (ignored on TPU)
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    num_gpu: int = 1
+
+    # Quantized-gradient training
+    use_quantized_grad: bool = False
+    num_grad_quant_bins: int = 4
+    quant_train_renew_leaf: bool = False
+    stochastic_rounding: bool = True
+
+    # --- TPU-native knobs ---
+    hist_backend: str = "auto"
+    max_splits_per_round: int = 64
+    mesh_shape: str = ""
+    tpu_dtype: str = "f32"
+
+    def __post_init__(self) -> None:
+        self._unknown: Dict[str, Any] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        cfg = cls()
+        cfg.update(params or {})
+        return cfg
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved = resolve_aliases(params)
+        fields = {f.name for f in dataclasses.fields(self)}
+        for key, value in resolved.items():
+            if key in fields:
+                setattr(self, key, _coerce(getattr(self, key), value))
+            else:
+                self._unknown[key] = value
+        self._check()
+
+    def _check(self) -> None:
+        """Parameter conflict resolution (reference: Config::CheckParamConflict,
+        src/io/config.cpp)."""
+        if self.num_leaves < 2:
+            self.num_leaves = 2
+        obj = canonical_objective(str(self.objective)) if isinstance(self.objective, str) else "none"
+        if obj in ("multiclass", "multiclassova") and self.num_class < 2:
+            raise ValueError("num_class must be >= 2 for multiclass objectives")
+        if obj not in ("multiclass", "multiclassova") and self.num_class != 1:
+            if obj != "none":
+                raise ValueError("num_class must be 1 for non-multiclass objectives")
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
+                # rf requires bagging (reference: config.cpp CheckParamConflict)
+                self.bagging_freq = max(self.bagging_freq, 1)
+                if not (0.0 < self.bagging_fraction < 1.0):
+                    self.bagging_fraction = 0.9
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        d.update(self._unknown)
+        return d
+
+
+def _coerce(current: Any, value: Any) -> Any:
+    """Coerce a user-supplied value to the type of the dataclass default."""
+    if isinstance(current, bool):
+        if isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes", "+")
+        return bool(value)
+    if isinstance(current, int) and not isinstance(value, bool):
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return value
+    if isinstance(current, float):
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return value
+    return value
+
+
+def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Map aliased parameter names to canonical ones.
+
+    Canonical name in the dict wins over aliases; among aliases the first in table
+    order wins, with a warning on conflicts (reference: config.cpp:23-98
+    KeyAliasTransform)."""
+    out: Dict[str, Any] = {}
+    alias_hits: Dict[str, List[str]] = {}
+    for key, value in params.items():
+        canon = _ALIAS_TO_CANONICAL.get(key, key)
+        if canon != key:
+            alias_hits.setdefault(canon, []).append(key)
+        if canon in out:
+            if key == canon:
+                out[canon] = value  # canonical name wins
+            else:
+                log_warning(
+                    f"{key} is set with {value}, {canon}={out[canon]} will be used. "
+                    f"Current value: {canon}={out[canon]}")
+        else:
+            out[canon] = value
+    # canonical name in original params always wins over any alias
+    for canon, hits in alias_hits.items():
+        if canon in params:
+            out[canon] = params[canon]
+    return out
+
+
+_ConfigAliases = _PARAM_ALIASES  # exported name parity with python-package basic.py:513
+
+
+def get_all_param_names() -> List[str]:
+    return [f.name for f in dataclasses.fields(Config)]

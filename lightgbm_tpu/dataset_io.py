@@ -1,0 +1,103 @@
+"""Text data loading: CSV/TSV/LibSVM with auto-detection.
+
+Reference: src/io/parser.cpp (Parser::CreateParser auto-detection) and
+src/io/dataset_loader.cpp (label/weight/query column mapping). Host-side NumPy/pandas.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .utils.log import LightGBMError, log_info
+
+
+def _detect_format(first_lines) -> str:
+    for line in first_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.replace("\t", " ").split()
+        has_colon = any(":" in t for t in tokens[1:])
+        if has_colon:
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "csv"
+
+
+def load_data_file(path: str, params: Dict[str, Any]
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Load a data file; returns (features, label). First column is the label unless
+    label_column says otherwise (reference: dataset_loader.cpp label handling)."""
+    if not os.path.exists(path):
+        raise LightGBMError(f"data file {path} not found")
+    with open(path) as f:
+        head = [f.readline() for _ in range(3)]
+    fmt = _detect_format(head)
+    has_header = bool(params.get("header", False))
+    label_col = 0
+    lc = str(params.get("label_column", ""))
+    if lc.startswith("column="):
+        label_col = int(lc.split("=")[1])
+    elif lc.isdigit():
+        label_col = int(lc)
+
+    if fmt == "libsvm":
+        return _load_libsvm(path)
+    delim = "," if fmt == "csv" else "\t"
+    data = np.genfromtxt(path, delimiter=delim,
+                         skip_header=1 if has_header else 0, dtype=np.float64)
+    if data.ndim == 1:
+        data = data.reshape(-1, 1)
+    label = data[:, label_col].copy()
+    feats = np.delete(data, label_col, axis=1)
+    return feats, label
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    rows = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            kv = []
+            for tok in parts[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                if k == "qid":
+                    continue
+                ki = int(k)
+                kv.append((ki, float(v)))
+                max_idx = max(max_idx, ki)
+            rows.append(kv)
+    n = len(rows)
+    out = np.zeros((n, max_idx + 1), np.float64)
+    for i, kv in enumerate(rows):
+        for k, v in kv:
+            out[i, k] = v
+    return out, np.asarray(labels, np.float64)
+
+
+def load_query_file(path: str) -> Optional[np.ndarray]:
+    """Load .query file (group sizes, one per line) if present."""
+    qpath = path + ".query"
+    if os.path.exists(qpath):
+        return np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+    return None
+
+
+def load_weight_file(path: str) -> Optional[np.ndarray]:
+    wpath = path + ".weight"
+    if os.path.exists(wpath):
+        return np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+    return None

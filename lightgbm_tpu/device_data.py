@@ -1,0 +1,118 @@
+"""Host BinnedData -> device arrays + static layouts for the growers.
+
+Mirrors the reference's CUDA io layer (src/io/cuda/cuda_row_data.cpp, CUDAColumnData):
+the binned matrix is resident in HBM; layout metadata is baked into the compiled program.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import BIN_CATEGORICAL, MISSING_NONE, BinnedData
+from .ops.grow import RoutingLayout
+from .ops.split import FeatureLayout
+
+
+class DeviceData(NamedTuple):
+    bins: jax.Array              # (N, G)
+    layout: FeatureLayout
+    routing: RoutingLayout
+    num_data: int
+    num_features: int
+    num_groups: int
+    max_bins: int                # Bmax
+
+
+def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
+    """Compute FeatureLayout + RoutingLayout (numpy, then device constants)."""
+    F = binned.num_features
+    G = binned.num_groups
+    Bmax = int(max(int(binned.group_bin_counts.max()) if G else 1,
+                   int(binned.feature_num_bins.max()) if F else 1))
+
+    gather_idx = np.zeros((F, Bmax), np.int32)
+    valid_mask = np.zeros((F, Bmax), bool)
+    residual_pos = np.full(F, -1, np.int32)
+    nan_bin = np.full(F, -1, np.int32)
+    is_cat = np.zeros(F, bool)
+    num_bins = np.asarray(binned.feature_num_bins, np.int32).copy()
+
+    feat_group = np.zeros(F, np.int32)
+    span_start = np.zeros(F, np.int32)
+    default_bin = np.zeros(F, np.int32)
+    bundled = np.zeros(F, bool)
+
+    for gi, feats in enumerate(binned.group_features):
+        base = gi * Bmax
+        if len(feats) == 1:
+            f = feats[0]
+            m = binned.bin_mappers[f]
+            nb = m.num_bins
+            gather_idx[f, :nb] = base + np.arange(nb)
+            valid_mask[f, :nb] = True
+            feat_group[f] = gi
+            span_start[f] = 0
+            default_bin[f] = m.default_bin
+            if m.bin_type == BIN_CATEGORICAL:
+                is_cat[f] = True
+            elif m.missing_type != MISSING_NONE:
+                nan_bin[f] = nb - 1
+        else:
+            in_group = 1
+            for f in feats:
+                m = binned.bin_mappers[f]
+                nb = m.num_bins
+                d = m.default_bin
+                for b in range(nb):
+                    if b == d:
+                        continue
+                    stored = in_group + (b if b < d else b - 1)
+                    gather_idx[f, b] = base + stored
+                    valid_mask[f, b] = True
+                residual_pos[f] = d
+                feat_group[f] = gi
+                span_start[f] = in_group
+                default_bin[f] = d
+                bundled[f] = True
+                if m.bin_type == BIN_CATEGORICAL:
+                    is_cat[f] = True
+                elif m.missing_type != MISSING_NONE:
+                    nan_bin[f] = nb - 1
+                in_group += nb - 1
+
+    layout = FeatureLayout(
+        gather_idx=jnp.asarray(gather_idx),
+        valid_mask=jnp.asarray(valid_mask),
+        residual_pos=jnp.asarray(residual_pos),
+        nan_bin=jnp.asarray(nan_bin),
+        is_cat=jnp.asarray(is_cat),
+        num_bins=jnp.asarray(num_bins),
+    )
+    routing = RoutingLayout(
+        feat_group=jnp.asarray(feat_group),
+        span_start=jnp.asarray(span_start),
+        default_bin=jnp.asarray(default_bin),
+        bundled=jnp.asarray(bundled),
+        nan_bin=jnp.asarray(nan_bin),
+        num_bins=jnp.asarray(num_bins),
+    )
+    return layout, routing, Bmax
+
+
+def to_device(binned: BinnedData, pad_rows_to: int = 256,
+              sharding=None) -> DeviceData:
+    layout, routing, Bmax = build_layouts(binned)
+    bins = np.ascontiguousarray(binned.bins)
+    n = bins.shape[0]
+    n_pad = -(-n // pad_rows_to) * pad_rows_to
+    if n_pad != n:
+        bins = np.pad(bins, ((0, n_pad - n), (0, 0)))
+    arr = jnp.asarray(bins)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return DeviceData(bins=arr, layout=layout, routing=routing,
+                      num_data=n, num_features=binned.num_features,
+                      num_groups=binned.num_groups, max_bins=Bmax)

@@ -1,0 +1,431 @@
+"""Model serialization in the LightGBM text format (read AND write).
+
+Reference: src/boosting/gbdt_model_text.cpp:315 (SaveModelToString), src/io/tree.cpp
+(Tree::ToString / Tree constructor-from-string). Writing the reference's exact format
+gives free interop: models trained here load in stock LightGBM and vice versa, and the
+format doubles as a golden-file test oracle.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .binning import BIN_CATEGORICAL
+from .tree import Tree
+from .utils.log import LightGBMError, log_warning
+
+_MODEL_VERSION = "v4"
+
+
+def _fmt_double(x: float) -> str:
+    """High-precision repr that round-trips (reference: ArrayToString<true>)."""
+    if math.isinf(x):
+        return "inf" if x > 0 else "-inf"
+    return np.format_float_positional(np.float64(x), trim="0", unique=True) \
+        if False else repr(float(x))
+
+
+def _join(arr, fmt=str) -> str:
+    return " ".join(fmt(x) for x in arr)
+
+
+def _objective_string(booster) -> str:
+    if booster._engine is not None and booster.engine.objective is not None:
+        obj = booster.engine.objective
+        name = obj.name
+        c = booster.config
+        if name == "binary":
+            return f"binary sigmoid:{c.sigmoid:g}"
+        if name in ("multiclass", "multiclassova"):
+            return f"{name} num_class:{c.num_class}"
+        if name == "quantile":
+            return f"quantile alpha:{c.alpha:g}"
+        if name == "huber":
+            return f"huber alpha:{c.alpha:g}"
+        if name == "fair":
+            return f"fair fair_c:{c.fair_c:g}"
+        if name == "tweedie":
+            return f"tweedie tweedie_variance_power:{c.tweedie_variance_power:g}"
+        if name == "lambdarank":
+            return "lambdarank"
+        if name == "rank_xendcg":
+            return "rank_xendcg"
+        return name
+    if booster._loaded_trees is not None:
+        return booster._loaded_trees.objective_string
+    return "regression"
+
+
+def _feature_infos(booster) -> List[str]:
+    if booster._engine is None:
+        lt = booster._loaded_trees
+        return lt.feature_infos if lt.feature_infos else \
+            ["none"] * (lt.max_feature_idx + 1)
+    infos = []
+    for m in booster.train_set.bin_mappers():
+        if m.is_trivial:
+            infos.append("none")
+        elif m.bin_type == BIN_CATEGORICAL:
+            infos.append(":".join(str(int(c)) for c in m.categories))
+        else:
+            ub = m.upper_bounds
+            lo = float(ub[0]) if len(ub) else 0.0
+            hi = float(ub[-2]) if len(ub) >= 2 else lo
+            infos.append(f"[{_fmt_double(lo)}:{_fmt_double(hi)}]")
+    return infos
+
+
+def tree_to_string(tree: Tree, index: int) -> str:
+    nl = tree.num_leaves
+    ni = max(nl - 1, 0)
+    lines = [f"Tree={index}"]
+    lines.append(f"num_leaves={nl}")
+    lines.append(f"num_cat={tree.num_cat}")
+    if ni:
+        lines.append("split_feature=" + _join(tree.split_feature.astype(int)))
+        lines.append("split_gain=" + _join(tree.split_gain, lambda x: f"{x:g}"))
+        # categorical nodes store the cat ordinal in threshold
+        lines.append("threshold=" + _join(tree.threshold, _fmt_double))
+        lines.append("decision_type=" + _join(tree.decision_type.astype(int)))
+        lines.append("left_child=" + _join(tree.left_child.astype(int)))
+        lines.append("right_child=" + _join(tree.right_child.astype(int)))
+    else:
+        for key in ("split_feature", "split_gain", "threshold", "decision_type",
+                    "left_child", "right_child"):
+            lines.append(f"{key}=")
+    lines.append("leaf_value=" + _join(tree.leaf_value, _fmt_double))
+    lines.append("leaf_weight=" + _join(tree.leaf_weight, _fmt_double))
+    lines.append("leaf_count=" + _join(np.asarray(tree.leaf_count).astype(int)))
+    if ni:
+        lines.append("internal_value=" + _join(tree.internal_value, lambda x: f"{x:g}"))
+        lines.append("internal_weight=" + _join(tree.internal_weight, lambda x: f"{x:g}"))
+        lines.append("internal_count=" + _join(np.asarray(tree.internal_count).astype(int)))
+    else:
+        lines.append("internal_value=")
+        lines.append("internal_weight=")
+        lines.append("internal_count=")
+    if tree.num_cat > 0:
+        lines.append("cat_boundaries=" + _join(tree.cat_boundaries.astype(int)))
+        lines.append("cat_threshold=" + _join(tree.cat_threshold.astype(int)))
+    lines.append(f"is_linear={1 if tree.is_linear else 0}")
+    lines.append(f"shrinkage={tree.shrinkage:g}")
+    lines.append("")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def save_model_string(booster, num_iteration: Optional[int] = None,
+                      start_iteration: int = 0,
+                      importance_type: str = "split") -> str:
+    trees = booster._all_trees()
+    k = booster.num_model_per_iteration()
+    total_iteration = len(trees) // max(k, 1)
+    start_iteration = max(0, min(start_iteration, total_iteration))
+    if num_iteration is not None and num_iteration > 0:
+        end = min(start_iteration + num_iteration, total_iteration)
+    else:
+        end = total_iteration
+    use = trees[start_iteration * k:end * k]
+
+    num_class = (booster.config.num_class if booster._engine is not None
+                 else booster._loaded_trees.num_class)
+    feature_names = booster.feature_name()
+
+    lines = ["tree"]
+    lines.append(f"version={_MODEL_VERSION}")
+    lines.append(f"num_class={num_class}")
+    lines.append(f"num_tree_per_iteration={k}")
+    lines.append("label_index=0")
+    lines.append(f"max_feature_idx={booster.num_feature() - 1}")
+    lines.append(f"objective={_objective_string(booster)}")
+    if booster._average_output():
+        lines.append("average_output")
+    lines.append("feature_names=" + " ".join(feature_names))
+    lines.append("feature_infos=" + " ".join(_feature_infos(booster)))
+
+    tree_strs = [tree_to_string(t, i) for i, t in enumerate(use)]
+    tree_sizes = [len(s) + 1 for s in tree_strs]  # +1 for the joining newline
+    lines.append("tree_sizes=" + _join(tree_sizes))
+    lines.append("")
+    body = "\n".join(lines) + "\n"
+    body += "\n".join(tree_strs)
+    if tree_strs:
+        body += "\n"
+    body += "end of trees\n"
+
+    imp = booster.feature_importance(importance_type)
+    pairs = sorted(((int(v), feature_names[i]) for i, v in enumerate(imp) if v > 0),
+                   key=lambda p: -p[0])
+    body += "\nfeature_importances:\n"
+    for v, name in pairs:
+        body += f"{name}={v}\n"
+    body += "\nparameters:\n"
+    params = booster.params if isinstance(getattr(booster, "params", None), dict) else {}
+    for key, val in sorted(params.items()):
+        body += f"[{key}: {val}]\n"
+    body += "end of parameters\n"
+    body += "\npandas_categorical:null\n"
+    return body
+
+
+class LoadedModel:
+    """Parsed model file (used when no training engine is attached)."""
+
+    def __init__(self):
+        self.trees: List[Tree] = []
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.objective_string = "regression"
+        self.average_output = False
+        self.parameters: Dict[str, str] = {}
+
+    def convert_output(self, raw):
+        obj = self.objective_string.split(" ")[0] if self.objective_string else ""
+        if obj == "binary":
+            sigmoid = 1.0
+            for part in self.objective_string.split(" ")[1:]:
+                if part.startswith("sigmoid:"):
+                    sigmoid = float(part.split(":")[1])
+            return 1.0 / (1.0 + np.exp(-sigmoid * np.asarray(raw)))
+        if obj == "multiclass":
+            e = np.exp(raw - np.max(raw, axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        if obj == "multiclassova":
+            p = 1.0 / (1.0 + np.exp(-np.asarray(raw)))
+            return p / p.sum(axis=-1, keepdims=True)
+        if obj in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        if obj == "cross_entropy":
+            return 1.0 / (1.0 + np.exp(-np.asarray(raw)))
+        if obj == "cross_entropy_lambda":
+            return np.log1p(np.exp(raw))
+        return raw
+
+
+def _parse_array(s: str, dtype):
+    s = s.strip()
+    if not s:
+        return np.zeros(0, dtype)
+    return np.asarray([dtype(x) for x in s.split(" ") if x], dtype=dtype)
+
+
+def load_model_string(model_str: str) -> LoadedModel:
+    lines = model_str.split("\n")
+    lm = LoadedModel()
+    i = 0
+    # header
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("Tree="):
+            i -= 1
+            break
+        if line == "end of trees":
+            break
+        if "=" in line:
+            key, _, val = line.partition("=")
+            if key == "num_class":
+                lm.num_class = int(val)
+            elif key == "num_tree_per_iteration":
+                lm.num_tree_per_iteration = int(val)
+            elif key == "max_feature_idx":
+                lm.max_feature_idx = int(val)
+            elif key == "objective":
+                lm.objective_string = val
+            elif key == "feature_names":
+                lm.feature_names = val.split(" ") if val else []
+            elif key == "feature_infos":
+                lm.feature_infos = val.split(" ") if val else []
+        elif line == "average_output":
+            lm.average_output = True
+
+    # trees
+    while i < len(lines):
+        line = lines[i].strip()
+        if line == "end of trees":
+            break
+        if not line.startswith("Tree="):
+            i += 1
+            continue
+        block: Dict[str, str] = {}
+        i += 1
+        while i < len(lines):
+            ln = lines[i].strip()
+            if not ln:
+                i += 1
+                if i < len(lines) and (lines[i].strip().startswith("Tree=")
+                                       or lines[i].strip() == "end of trees"):
+                    break
+                continue
+            if ln.startswith("Tree=") or ln == "end of trees":
+                break
+            key, _, val = ln.partition("=")
+            block[key] = val
+            i += 1
+        lm.trees.append(_tree_from_block(block))
+    return lm
+
+
+def _tree_from_block(block: Dict[str, str]) -> Tree:
+    nl = int(block.get("num_leaves", "1"))
+    num_cat = int(block.get("num_cat", "0"))
+    thr = _parse_array(block.get("threshold", ""), float)
+    t = Tree(
+        num_leaves=nl,
+        split_feature=_parse_array(block.get("split_feature", ""), int).astype(np.int32),
+        threshold_bin=thr.astype(np.int32) if len(thr) else np.zeros(0, np.int32),
+        threshold=thr.astype(np.float64),
+        decision_type=_parse_array(block.get("decision_type", ""), int).astype(np.uint8),
+        left_child=_parse_array(block.get("left_child", ""), int).astype(np.int32),
+        right_child=_parse_array(block.get("right_child", ""), int).astype(np.int32),
+        split_gain=_parse_array(block.get("split_gain", ""), float),
+        internal_value=_parse_array(block.get("internal_value", ""), float),
+        internal_weight=_parse_array(block.get("internal_weight", ""), float),
+        internal_count=_parse_array(block.get("internal_count", ""), float),
+        leaf_value=_parse_array(block.get("leaf_value", ""), float),
+        leaf_weight=_parse_array(block.get("leaf_weight", ""), float),
+        leaf_count=_parse_array(block.get("leaf_count", ""), float),
+        shrinkage=float(block.get("shrinkage", "1")),
+        is_linear=bool(int(block.get("is_linear", "0"))),
+    )
+    if num_cat > 0:
+        t.cat_boundaries = _parse_array(block["cat_boundaries"], int).astype(np.int32)
+        t.cat_threshold = _parse_array(block["cat_threshold"], int).astype(np.uint32)
+    # threshold_bin for categorical nodes is the cat ordinal (already in threshold)
+    if len(t.decision_type):
+        cat_nodes = (t.decision_type & 1) != 0
+        t.threshold_bin = np.where(cat_nodes, thr.astype(np.int64), 0).astype(np.int32)
+    return t
+
+
+def dump_model_dict(booster, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    importance_type: str = "split") -> Dict[str, Any]:
+    """JSON model dump (reference: GBDT::DumpModel, gbdt_model_text.cpp:25)."""
+    trees = booster._all_trees()
+    k = booster.num_model_per_iteration()
+    total_iteration = len(trees) // max(k, 1)
+    start_iteration = max(0, min(start_iteration, total_iteration))
+    end = (min(start_iteration + num_iteration, total_iteration)
+           if num_iteration else total_iteration)
+    use = trees[start_iteration * k:end * k]
+    fnames = booster.feature_name()
+
+    def node_json(t: Tree, node: int):
+        if node < 0:
+            leaf = ~node
+            return {
+                "leaf_index": int(leaf),
+                "leaf_value": float(t.leaf_value[leaf]),
+                "leaf_weight": float(t.leaf_weight[leaf]) if leaf < len(t.leaf_weight) else 0.0,
+                "leaf_count": int(t.leaf_count[leaf]) if leaf < len(t.leaf_count) else 0,
+            }
+        dt = int(t.decision_type[node])
+        is_cat = bool(dt & 1)
+        d = {
+            "split_index": int(node),
+            "split_feature": int(t.split_feature[node]),
+            "split_gain": float(t.split_gain[node]),
+            "threshold": (float(t.threshold[node]) if not is_cat else
+                          _cat_threshold_str(t, node)),
+            "decision_type": "==" if is_cat else "<=",
+            "default_left": bool(dt & 2),
+            "missing_type": ["None", "Zero", "NaN"][min((dt >> 2) & 3, 2)],
+            "internal_value": float(t.internal_value[node]),
+            "internal_weight": float(t.internal_weight[node]),
+            "internal_count": int(t.internal_count[node]),
+            "left_child": node_json(t, int(t.left_child[node])),
+            "right_child": node_json(t, int(t.right_child[node])),
+        }
+        return d
+
+    def _cat_threshold_str(t: Tree, node: int) -> str:
+        kcat = int(t.threshold_bin[node])
+        s, e = t.cat_boundaries[kcat], t.cat_boundaries[kcat + 1]
+        cats = []
+        for w in range(s, e):
+            word = int(t.cat_threshold[w])
+            for b in range(32):
+                if word >> b & 1:
+                    cats.append((w - s) * 32 + b)
+        return "||".join(str(c) for c in cats)
+
+    out = {
+        "name": "tree",
+        "version": _MODEL_VERSION,
+        "num_class": (booster.config.num_class if booster._engine is not None
+                      else booster._loaded_trees.num_class),
+        "num_tree_per_iteration": k,
+        "label_index": 0,
+        "max_feature_idx": booster.num_feature() - 1,
+        "objective": _objective_string(booster),
+        "average_output": booster._average_output(),
+        "feature_names": fnames,
+        "feature_infos": {},
+        "tree_info": [
+            {"tree_index": i, "num_leaves": t.num_leaves, "num_cat": t.num_cat,
+             "shrinkage": t.shrinkage,
+             "tree_structure": node_json(t, 0 if t.num_leaves > 1 else ~0)}
+            for i, t in enumerate(use)
+        ],
+    }
+    imp = booster.feature_importance(importance_type)
+    out["feature_importances"] = {fnames[i]: float(v)
+                                  for i, v in enumerate(imp) if v > 0}
+    return out
+
+
+def refit_model(booster, data, label, decay_rate: float = 0.9, **kwargs):
+    """Refit leaf values on new data (reference: GBDT::RefitTree, gbdt.cpp).
+
+    new_leaf_value = decay_rate * old + (1 - decay_rate) * mean-of-new-gradients
+    expressed through re-running leaf assignment on the new data."""
+    import copy as _copy
+    from .basic import Booster, Dataset
+    X = np.asarray(data, np.float64)
+    y = np.asarray(label, np.float64)
+    trees = booster._all_trees()
+    k = booster.num_model_per_iteration()
+    new_model_str = booster.model_to_string()
+    out = Booster(model_str=new_model_str)
+    lt = out._loaded_trees
+    # sequential raw score for gradient evaluation
+    n = X.shape[0]
+    score = np.zeros((n, k), np.float64)
+    cfg = booster.config if booster._engine is not None else None
+    from .config import Config
+    cfg = cfg or Config()
+    from .objectives import create_objective
+    obj_name = _objective_string(booster).split(" ")[0]
+    cfg2 = _copy.copy(cfg)
+    cfg2.objective = obj_name if obj_name else "regression"
+    try:
+        obj = create_objective(cfg2)
+        obj.init(y, None, n=n)
+    except Exception:
+        obj = None
+    for i, t in enumerate(lt.trees):
+        kk = i % k
+        leaf = t.predict_leaf_raw(X)
+        if obj is not None:
+            import jax.numpy as jnp
+            g, h = obj.get_gradients(jnp.asarray(score if k > 1 else score[:, 0],
+                                                 np.float32))
+            g = np.asarray(g)
+            h = np.asarray(h)
+            if k > 1:
+                g, h = g[:, kk], h[:, kk]
+            sum_g = np.bincount(leaf, weights=g, minlength=t.num_leaves)
+            sum_h = np.bincount(leaf, weights=h, minlength=t.num_leaves)
+            new_vals = -sum_g / (sum_h + cfg2.lambda_l2 + 1e-15) * t.shrinkage
+            has_data = np.bincount(leaf, minlength=t.num_leaves) > 0
+            t.leaf_value = np.where(
+                has_data, decay_rate * t.leaf_value + (1 - decay_rate) * new_vals,
+                t.leaf_value)
+        score[:, kk] += t.leaf_value[leaf]
+    return out

@@ -1,0 +1,539 @@
+"""GBDT boosting engine.
+
+Reference: src/boosting/gbdt.cpp — Init (:60), Train (:246), TrainOneIter (:353-461),
+Boosting/grad compute (:229), UpdateScore (:502), RollbackOneIter (:463); DART
+(src/boosting/dart.hpp), RF (src/boosting/rf.hpp).
+
+TPU design: the score vector lives on device; a tree build is one jitted program
+(ops/grow.py); the training-score update is a leaf_value gather on the grower's leaf_id
+output (no second traversal); validation scores update incrementally with one jitted tree
+walk per new tree.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..device_data import DeviceData, to_device
+from ..metrics import Metric
+from ..objectives import ObjectiveFunction
+from ..ops.grow import GrowParams, grow_tree
+from ..ops.predict import StackedTrees, _walk_one_tree
+from ..tree import Tree, TreeArrays, finalize_tree
+from ..utils.log import LightGBMError, log_info, log_warning
+from .sample_strategy import create_sample_strategy
+
+
+class GBDT:
+    """The main booster (reference: src/boosting/gbdt.h GBDT class)."""
+
+    boosting_type = "gbdt"
+    _average_output = False
+
+    def __init__(self, config: Config, train_data, objective: Optional[ObjectiveFunction],
+                 metrics: Sequence[Metric]):
+        self.config = config
+        self.train_data = train_data          # basic.Dataset (constructed)
+        self.objective = objective
+        self.train_metrics = list(metrics)
+        self.models: List[Tree] = []          # host trees, iteration-major
+        self.iter_ = 0
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (objective.num_model_per_iteration
+                                       if objective is not None else config.num_class)
+        self.valid_sets: List[Any] = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self._valid_scores: List[jax.Array] = []
+        self.best_iteration = -1
+
+        dd: DeviceData = train_data.device_data()
+        self.dd = dd
+        n = dd.bins.shape[0]                  # padded row count
+        self.num_data = train_data.num_data()
+
+        # row-pad mask: padded rows contribute nothing
+        pad_mask = np.zeros(n, np.float32)
+        pad_mask[:self.num_data] = 1.0
+        self._pad_mask = jnp.asarray(pad_mask)
+
+        k = self.num_tree_per_iteration
+        self._score_shape = (n,) if k == 1 else (n, k)
+        init_scores = self._compute_init_score()
+        self.init_scores = init_scores        # python list of floats, len k
+        self.score = jnp.zeros(self._score_shape, jnp.float32) + jnp.asarray(
+            init_scores if k > 1 else init_scores[0], jnp.float32)
+        # user-provided init_score offsets (kept separate from boost_from_average)
+        base = train_data.get_init_score_padded(n, k)
+        if base is not None:
+            self.score = self.score + jnp.asarray(base, jnp.float32)
+
+        self.sample_strategy = create_sample_strategy(
+            config, n,
+            train_data.get_query_boundaries(),
+            train_data.get_label_padded(n))
+
+        self._grow_params = self._make_grow_params()
+        self._grow_fn = jax.jit(
+            functools.partial(grow_tree, layout=dd.layout, routing=dd.routing,
+                              params=self._grow_params))
+        self._rng = np.random.RandomState(config.feature_fraction_seed)
+        self._saved_state: Optional[Tuple] = None
+
+    # ------------------------------------------------------------------
+    def _make_grow_params(self) -> GrowParams:
+        c = self.config
+        return GrowParams(
+            num_leaves=max(c.num_leaves, 2),
+            max_depth=c.max_depth,
+            max_splits_per_round=max(1, c.max_splits_per_round),
+            lambda_l1=c.lambda_l1, lambda_l2=c.lambda_l2,
+            min_data_in_leaf=c.min_data_in_leaf,
+            min_sum_hessian_in_leaf=c.min_sum_hessian_in_leaf,
+            min_gain_to_split=c.min_gain_to_split,
+            max_delta_step=c.max_delta_step,
+            cat_l2=c.cat_l2, cat_smooth=c.cat_smooth,
+            max_cat_threshold=c.max_cat_threshold,
+            max_cat_to_onehot=c.max_cat_to_onehot,
+            min_data_per_group=c.min_data_per_group,
+            hist_backend=c.hist_backend,
+        )
+
+    def _compute_init_score(self) -> List[float]:
+        k = self.num_tree_per_iteration
+        if self.objective is None or not self.config.boost_from_average:
+            return [0.0] * k
+        try:
+            v = self.objective.boost_from_score()
+        except NotImplementedError:
+            v = 0.0
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return [float(x) for x in v]
+        return [float(v)] * k
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_data, name: str, metrics: Sequence[Metric]) -> None:
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name)
+        self.valid_metrics.append(list(metrics))
+        dd = valid_data.device_data()
+        n = dd.bins.shape[0]
+        k = self.num_tree_per_iteration
+        shape = (n,) if k == 1 else (n, k)
+        score = jnp.zeros(shape, jnp.float32)
+        if self.iter_ == 0:
+            # before training the init score is tracked separately; once trees exist
+            # it is folded into tree 0 (AddBias), so catch-up sums are complete
+            score = score + jnp.asarray(
+                self.init_scores if k > 1 else self.init_scores[0], jnp.float32)
+        base = valid_data.get_init_score_padded(n, k)
+        if base is not None:
+            score = score + jnp.asarray(base, jnp.float32)
+        # catch up on already-trained trees
+        for it in range(self.iter_):
+            for kk in range(k):
+                t = self.models[it * k + kk]
+                score = self._add_tree_to_score(score, t, dd, kk)
+        self._valid_scores.append(score)
+
+    # ------------------------------------------------------------------
+    def _feature_mask(self) -> jax.Array:
+        f = self.dd.num_features
+        frac = self.config.feature_fraction
+        mask = np.ones(f, bool)
+        if frac < 1.0:
+            kcnt = max(1, int(round(frac * f)))
+            keep = self._rng.choice(f, size=kcnt, replace=False)
+            mask = np.zeros(f, bool)
+            mask[keep] = True
+        return jnp.asarray(mask)
+
+    def _boost(self) -> Tuple[jax.Array, jax.Array]:
+        """Gradient computation (reference: GBDT::Boosting, gbdt.cpp:229)."""
+        if self.objective is None:
+            raise LightGBMError("cannot boost without an objective "
+                                "(use custom-gradient update)")
+        grad, hess = self.objective.get_gradients(self._unpad_score())
+        return self._pad_gh(grad), self._pad_gh(hess)
+
+    def _unpad_score(self):
+        return self.score[:self.num_data]
+
+    def _pad_gh(self, a):
+        n = self.dd.bins.shape[0]
+        if a.shape[0] == n:
+            return a
+        pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, pad)
+
+    def train_one_iter(self, grad: Optional[jax.Array] = None,
+                       hess: Optional[jax.Array] = None) -> bool:
+        """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
+        Returns True if no further training is possible (all-zero trees)."""
+        if grad is None or hess is None:
+            grad, hess = self._boost()
+        else:
+            grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
+            hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
+        mask, grad, hess = self.sample_strategy.sample(self.iter_, grad, hess)
+        mask = mask * self._pad_mask
+        if grad.ndim == 2:
+            grad = grad * self._pad_mask[:, None]
+            hess = hess * self._pad_mask[:, None]
+        else:
+            grad = grad * self._pad_mask
+            hess = hess * self._pad_mask
+
+        k = self.num_tree_per_iteration
+        col_mask = self._feature_mask()
+        finished = True
+        new_trees = []
+        for kk in range(k):
+            g = grad if k == 1 else grad[:, kk]
+            h = hess if k == 1 else hess[:, kk]
+            arrays, leaf_id = self._grow_fn(self.dd.bins, g, h, mask, col_mask)
+            arrays, leaf_id = self._post_grow(arrays, leaf_id, kk, mask)
+            nl = int(arrays.num_leaves)
+            if nl > 1:
+                finished = False
+            # score update: gather (reference: ScoreUpdater::AddScore)
+            delta = arrays.leaf_value[leaf_id] * self._shrinkage_rate()
+            if k == 1:
+                self.score = self.score + delta
+            else:
+                self.score = self.score.at[:, kk].add(delta)
+            tree = finalize_tree(arrays, self.train_data.bin_mappers(),
+                                 None, learning_rate=self._shrinkage_rate())
+            # fold the init score into the first tree (every tree for averaged
+            # output) so saved models are self-contained (reference: gbdt.cpp:425)
+            if (self.iter_ == 0 or self._average_output) and \
+                    self.init_scores[kk] != 0.0:
+                tree.add_bias(self.init_scores[kk])
+            new_trees.append((tree, arrays))
+            self.models.append(tree)
+
+        # update validation scores with the new trees
+        for vi, vset in enumerate(self.valid_sets):
+            dd = vset.device_data()
+            score = self._valid_scores[vi]
+            for kk, (tree, arrays) in enumerate(new_trees):
+                score = self._add_tree_arrays_to_score(score, arrays, dd, kk,
+                                                       self._shrinkage_rate())
+            self._valid_scores[vi] = score
+
+        self.iter_ += 1
+        return finished
+
+    def _shrinkage_rate(self) -> float:
+        return self.config.learning_rate
+
+    def _post_grow(self, arrays: TreeArrays, leaf_id, kk: int, mask):
+        """Hook: leaf renewal for percentile objectives (reference:
+        TreeLearner::RenewTreeOutput call in gbdt.cpp:419)."""
+        if self.objective is not None and self.objective.need_renew_leaf:
+            score = self.score if self.score.ndim == 1 else self.score[:, kk]
+            new_vals = self.objective.renew_leaf_values(
+                score[:self.num_data], leaf_id[:self.num_data],
+                self._grow_params.num_leaves, mask[:self.num_data])
+            keep = jnp.arange(new_vals.shape[0]) < arrays.num_leaves
+            vals = jnp.where(keep & (arrays.leaf_count > 0), new_vals,
+                             arrays.leaf_value)
+            vals = jnp.where(arrays.num_leaves > 1, vals, arrays.leaf_value)
+            arrays = arrays._replace(leaf_value=vals)
+        return arrays, leaf_id
+
+    # ------------------------------------------------------------------
+    def _add_tree_arrays_to_score(self, score, arrays: TreeArrays, dd: DeviceData,
+                                  kk: int, rate: float):
+        fields = (arrays.split_feature, arrays.threshold_bin, arrays.dir_flags,
+                  arrays.left_child, arrays.right_child, arrays.cat_bitset)
+        maxd = self._grow_params.num_leaves  # safe static bound
+        leaf = _walk_one_tree(fields, dd.bins, dd.routing, maxd)
+        delta = arrays.leaf_value[leaf] * rate
+        if score.ndim == 1:
+            return score + delta
+        return score.at[:, kk].add(delta)
+
+    def _add_tree_to_score(self, score, tree: Tree, dd: DeviceData, kk: int):
+        arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                 dd.max_bins, self.train_data)
+        return self._add_tree_arrays_to_score(score, arrays, dd, kk, 1.0)
+
+    # ------------------------------------------------------------------
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        score = np.asarray(self._unpad_score())
+        conv = (self.objective.convert_output if self.objective is not None
+                else (lambda x: x))
+        for m in self.train_metrics:
+            for (name, val, hb) in m.evaluate(score, conv):
+                out.append(("training", name, val, hb))
+        return out
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        conv = (self.objective.convert_output if self.objective is not None
+                else (lambda x: x))
+        for vi, vset in enumerate(self.valid_sets):
+            n = vset.num_data()
+            score = np.asarray(self._valid_scores[vi][:n])
+            for m in self.valid_metrics[vi]:
+                for (name, val, hb) in m.evaluate(score, conv):
+                    out.append((self.valid_names[vi], name, val, hb))
+        return out
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """reference: GBDT::RollbackOneIter (gbdt.cpp:463)."""
+        if self.iter_ <= 0:
+            return
+        k = self.num_tree_per_iteration
+        dropped = self.models[-k:]
+        del self.models[-k:]
+        dd = self.dd
+        for kk, tree in enumerate(dropped):
+            arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                     dd.max_bins, self.train_data)
+            self.score = self._add_tree_arrays_to_score(
+                self.score, arrays._replace(leaf_value=-arrays.leaf_value),
+                dd, kk, 1.0)
+        for vi, vset in enumerate(self.valid_sets):
+            vdd = vset.device_data()
+            score = self._valid_scores[vi]
+            for kk, tree in enumerate(dropped):
+                arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                         vdd.max_bins, self.train_data)
+                score = self._add_tree_arrays_to_score(
+                    score, arrays._replace(leaf_value=-arrays.leaf_value),
+                    vdd, kk, 1.0)
+            self._valid_scores[vi] = score
+        self.iter_ -= 1
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+
+class DART(GBDT):
+    """Dropout boosting (reference: src/boosting/dart.hpp)."""
+
+    boosting_type = "dart"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._drop_rng = np.random.RandomState(self.config.drop_seed)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        c = self.config
+        k = self.num_tree_per_iteration
+        n_iters = self.iter_
+        # choose dropped trees
+        drop_idx: List[int] = []
+        if n_iters > 0 and self._drop_rng.rand() >= c.skip_drop:
+            if c.uniform_drop:
+                sel = self._drop_rng.rand(n_iters) < c.drop_rate
+                drop_idx = list(np.where(sel)[0])
+            else:
+                kcnt = max(1, int(round(c.drop_rate * n_iters)))
+                drop_idx = list(self._drop_rng.choice(n_iters, size=min(kcnt, n_iters),
+                                                      replace=False))
+            if len(drop_idx) > c.max_drop > 0:
+                drop_idx = drop_idx[:c.max_drop]
+        kfac = len(drop_idx)
+        # remove dropped trees from the score
+        dd = self.dd
+        for it in drop_idx:
+            for kk in range(k):
+                tree = self.models[it * k + kk]
+                arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                         dd.max_bins, self.train_data)
+                self.score = self._add_tree_arrays_to_score(
+                    self.score, arrays._replace(leaf_value=-arrays.leaf_value),
+                    dd, kk, 1.0)
+        finished = super().train_one_iter(grad, hess)
+        # normalization (reference: dart.hpp Normalize)
+        if kfac > 0 and not finished:
+            if c.xgboost_dart_mode:
+                new_scale = c.learning_rate / (kfac + c.learning_rate)
+                old_scale = kfac / (kfac + c.learning_rate)
+            else:
+                new_scale = 1.0 / (kfac + 1.0)
+                old_scale = kfac / (kfac + 1.0)
+            # rescale the just-added trees
+            for kk in range(k):
+                tree = self.models[-k + kk]
+                factor = new_scale / self._shrinkage_rate()
+                arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                         dd.max_bins, self.train_data)
+                delta = arrays.leaf_value * (factor - 1.0)
+                self.score = self._add_tree_arrays_to_score(
+                    self.score, arrays._replace(leaf_value=delta), dd, kk, 1.0)
+                tree.shrink(new_scale / tree.shrinkage if tree.shrinkage else new_scale)
+            # rescale dropped trees and re-add
+            for it in drop_idx:
+                for kk in range(k):
+                    tree = self.models[it * k + kk]
+                    tree.shrink(old_scale)
+                    arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                             dd.max_bins, self.train_data)
+                    self.score = self._add_tree_arrays_to_score(
+                        self.score, arrays, dd, kk, 1.0)
+        elif kfac > 0:
+            # tree was trivial; restore dropped trees unchanged
+            for it in drop_idx:
+                for kk in range(k):
+                    tree = self.models[it * k + kk]
+                    arrays = _tree_to_device(tree, self._grow_params.num_leaves,
+                                             dd.max_bins, self.train_data)
+                    self.score = self._add_tree_arrays_to_score(
+                        self.score, arrays, dd, kk, 1.0)
+        return finished
+
+    def _shrinkage_rate(self) -> float:
+        return self.config.learning_rate
+
+
+class RF(GBDT):
+    """Random forest mode (reference: src/boosting/rf.hpp): bagging required, no
+    shrinkage, averaged outputs; gradients always taken at the init score."""
+
+    boosting_type = "rf"
+    _average_output = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        k = self.num_tree_per_iteration
+        self._init_score_const = jnp.zeros(self._score_shape, jnp.float32) + \
+            jnp.asarray(self.init_scores if k > 1 else self.init_scores[0], jnp.float32)
+        self._tree_sum = jnp.zeros(self._score_shape, jnp.float32)
+
+    def _boost(self):
+        if self.objective is None:
+            raise LightGBMError("rf requires an objective")
+        saved = self.score
+        self.score = self._init_score_const
+        try:
+            return super()._boost()
+        finally:
+            self.score = saved
+
+    def _shrinkage_rate(self) -> float:
+        return 1.0
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        saved_models = len(self.models)
+        # track tree-sum separately: score = init + tree_sum / iter
+        prev_score = self.score
+        self.score = self._tree_sum
+        finished = GBDT.train_one_iter(self, grad, hess)
+        self._tree_sum = self.score
+        t = max(self.iter_, 1)
+        self.score = self._init_score_const + self._tree_sum / t
+        return finished
+
+    def eval_valid(self):
+        # average the accumulated sums for metric evaluation
+        t = max(self.iter_, 1)
+        out = []
+        conv = (self.objective.convert_output if self.objective is not None
+                else (lambda x: x))
+        k = self.num_tree_per_iteration
+        for vi, vset in enumerate(self.valid_sets):
+            n = vset.num_data()
+            init = np.asarray(self.init_scores if k > 1 else self.init_scores[0])
+            raw = np.asarray(self._valid_scores[vi][:n])
+            # _valid_scores started at init and accumulated full tree outputs;
+            # averaged score = init + (raw - init)/t
+            score = init + (raw - init) / t
+            for m in self.valid_metrics[vi]:
+                for (name, val, hb) in m.evaluate(score, conv):
+                    out.append((self.valid_names[vi], name, val, hb))
+        return out
+
+    def eval_train(self):
+        out = []
+        conv = (self.objective.convert_output if self.objective is not None
+                else (lambda x: x))
+        score = np.asarray((self._init_score_const +
+                            self._tree_sum / max(self.iter_, 1))[:self.num_data])
+        for m in self.train_metrics:
+            for (name, val, hb) in m.evaluate(score, conv):
+                out.append(("training", name, val, hb))
+        return out
+
+
+def _tree_to_device(tree: Tree, num_leaves_budget: int, max_bins: int,
+                    train_data) -> TreeArrays:
+    """Host Tree -> padded device TreeArrays (bin-space) for score walks."""
+    L = num_leaves_budget
+    ni = L - 1 if L > 1 else 1
+    Bmax = max_bins
+
+    def pad1(a, size, dtype, fill=0):
+        out = np.full(size, fill, dtype)
+        out[:len(a)] = a
+        return out
+
+    n_int = len(tree.split_feature)
+    dirf = np.zeros(n_int, np.int32)
+    cat_bits = np.zeros((L, Bmax), bool)
+    mappers = train_data.bin_mappers()
+    thr_bin = np.asarray(tree.threshold_bin, np.int64).copy()
+    for i in range(n_int):
+        dt = int(tree.decision_type[i])
+        if dt & 1:
+            dirf[i] |= 2
+            # rebuild bin-space bitset from category-value bitset
+            f = int(tree.split_feature[i])
+            m = mappers[f]
+            kcat = int(tree.threshold_bin[i])
+            s, e = tree.cat_boundaries[kcat], tree.cat_boundaries[kcat + 1]
+            words = tree.cat_threshold[s:e]
+            for b, c in enumerate(m.categories):
+                c = int(c)
+                if c // 32 < len(words) and (int(words[c // 32]) >> (c % 32)) & 1:
+                    cat_bits[i, b] = True
+        else:
+            if dt & 2:
+                dirf[i] |= 1
+            # bin threshold from real threshold
+            f = int(tree.split_feature[i])
+            m = mappers[f]
+            thr_bin[i] = int(np.searchsorted(m.upper_bounds, tree.threshold[i],
+                                             side="left"))
+
+    return TreeArrays(
+        split_feature=jnp.asarray(pad1(tree.split_feature, L, np.int32)),
+        threshold_bin=jnp.asarray(pad1(thr_bin, L, np.int32)),
+        dir_flags=jnp.asarray(pad1(dirf, L, np.int32)),
+        left_child=jnp.asarray(pad1(tree.left_child, L, np.int32)),
+        right_child=jnp.asarray(pad1(tree.right_child, L, np.int32)),
+        split_gain=jnp.asarray(pad1(tree.split_gain, L, np.float32)),
+        internal_value=jnp.asarray(pad1(tree.internal_value, L, np.float32)),
+        internal_weight=jnp.asarray(pad1(tree.internal_weight, L, np.float32)),
+        internal_count=jnp.asarray(pad1(tree.internal_count, L, np.float32)),
+        cat_bitset=jnp.asarray(cat_bits),
+        leaf_value=jnp.asarray(pad1(tree.leaf_value, L, np.float32)),
+        leaf_weight=jnp.asarray(pad1(tree.leaf_weight, L, np.float32)),
+        leaf_count=jnp.asarray(pad1(tree.leaf_count, L, np.float32)),
+        leaf_parent=jnp.zeros(L, jnp.int32),
+        num_leaves=jnp.asarray(tree.num_leaves, jnp.int32),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+    )
+
+
+def create_boosting(config: Config, train_data, objective, metrics) -> GBDT:
+    """reference: Boosting::CreateBoosting (boosting.cpp:42)."""
+    t = config.boosting
+    if t in ("gbdt", "gbrt", "goss"):
+        return GBDT(config, train_data, objective, metrics)
+    if t == "dart":
+        return DART(config, train_data, objective, metrics)
+    if t in ("rf", "random_forest"):
+        return RF(config, train_data, objective, metrics)
+    raise LightGBMError(f"Unknown boosting type {t}")

@@ -1,0 +1,126 @@
+"""Row sampling strategies: bagging and GOSS.
+
+Reference: src/boosting/sample_strategy.cpp (factory), bagging.hpp:15, goss.hpp:19.
+TPU design: no index compaction — strategies return a dense {0,1} mask (and possibly
+re-weighted gradients), which feeds the histogram count channel directly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+
+
+class SampleStrategy:
+    """Returns (mask, grad, hess) per iteration; mask==1 means in-bag."""
+
+    def __init__(self, config: Config, num_data: int,
+                 query_boundaries: Optional[np.ndarray] = None,
+                 label: Optional[np.ndarray] = None):
+        self.config = config
+        self.num_data = num_data
+        self.query_boundaries = query_boundaries
+        self.label = label
+
+    def is_active(self) -> bool:
+        return False
+
+    def sample(self, iteration: int, grad: jax.Array, hess: jax.Array
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        mask = jnp.ones(grad.shape[0], jnp.float32)
+        return mask, grad, hess
+
+
+class BaggingSampleStrategy(SampleStrategy):
+    """reference: bagging.hpp — fraction/freq bagging, pos/neg balanced, by-query."""
+
+    def __init__(self, config: Config, num_data: int, query_boundaries=None,
+                 label=None):
+        super().__init__(config, num_data, query_boundaries, label)
+        c = config
+        self.use_posneg = (c.pos_bagging_fraction < 1.0 or c.neg_bagging_fraction < 1.0)
+        self.active = (c.bagging_freq > 0 and
+                       (c.bagging_fraction < 1.0 or self.use_posneg))
+        if self.active and label is not None and self.use_posneg:
+            self._is_pos = jnp.asarray(np.asarray(label) > 0)
+        if self.active and c.bagging_by_query and query_boundaries is not None:
+            nq = len(query_boundaries) - 1
+            sizes = np.diff(query_boundaries)
+            self._qid = jnp.asarray(np.repeat(np.arange(nq), sizes))
+            self._nq = nq
+        self._mask = None
+        self._mask_iter = -1
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def sample(self, iteration: int, grad, hess):
+        if not self.active:
+            return super().sample(iteration, grad, hess)
+        c = self.config
+        freq = max(c.bagging_freq, 1)
+        if self._mask is None or iteration % freq == 0:
+            key = jax.random.PRNGKey(c.bagging_seed * 131071 + iteration // freq)
+            n = self.num_data
+            if c.bagging_by_query and self.query_boundaries is not None:
+                u = jax.random.uniform(key, (self._nq,))
+                qmask = u < c.bagging_fraction
+                self._mask = qmask[self._qid].astype(jnp.float32)
+            elif self.use_posneg:
+                u = jax.random.uniform(key, (n,))
+                frac = jnp.where(self._is_pos, c.pos_bagging_fraction,
+                                 c.neg_bagging_fraction)
+                self._mask = (u < frac).astype(jnp.float32)
+            else:
+                u = jax.random.uniform(key, (n,))
+                self._mask = (u < c.bagging_fraction).astype(jnp.float32)
+        m = self._mask
+        if grad.ndim == 2:
+            return m, grad * m[:, None], hess * m[:, None]
+        return m, grad * m, hess * m
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based one-side sampling (reference: goss.hpp:19): keep top_rate by
+    |grad*hess|, sample other_rate of the rest with gradient amplification."""
+
+    def __init__(self, config: Config, num_data: int, query_boundaries=None,
+                 label=None):
+        super().__init__(config, num_data, query_boundaries, label)
+
+    def is_active(self) -> bool:
+        return True
+
+    def sample(self, iteration: int, grad, hess):
+        c = self.config
+        n = self.num_data
+        if iteration < 1.0 / max(c.learning_rate, 1e-12):
+            # reference warms up GOSS: no sampling for the first 1/lr iterations
+            return SampleStrategy.sample(self, iteration, grad, hess)
+        key = jax.random.PRNGKey(c.bagging_seed * 524287 + iteration)
+        g2 = grad * hess if grad.ndim == 1 else jnp.sum(jnp.abs(grad * hess), axis=1)
+        mag = jnp.abs(g2) if g2.ndim == 1 else g2
+        k_top = max(1, int(c.top_rate * n))
+        thresh = jax.lax.top_k(mag, k_top)[0][-1]
+        is_top = mag >= thresh
+        u = jax.random.uniform(key, (n,))
+        keep_rest = (~is_top) & (u < c.other_rate)
+        amp = (1.0 - c.top_rate) / max(c.other_rate, 1e-12)
+        mask = (is_top | keep_rest).astype(jnp.float32)
+        scale = jnp.where(keep_rest, amp, 1.0) * mask
+        if grad.ndim == 2:
+            return mask, grad * scale[:, None], hess * scale[:, None]
+        return mask, grad * scale, hess * scale
+
+
+def create_sample_strategy(config: Config, num_data: int, query_boundaries=None,
+                           label=None) -> SampleStrategy:
+    """reference: SampleStrategy::CreateSampleStrategy (sample_strategy.h:30)."""
+    if config.data_sample_strategy == "goss" or config.boosting == "goss":
+        return GOSSStrategy(config, num_data, query_boundaries, label)
+    return BaggingSampleStrategy(config, num_data, query_boundaries, label)

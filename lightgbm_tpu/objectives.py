@@ -1,0 +1,488 @@
+"""Objective functions (gradient/hessian producers).
+
+Reference: include/LightGBM/objective_function.h:38-120 (GetGradients / BoostFromScore /
+ConvertOutput / RenewTreeOutput) and src/objective/{regression,binary,multiclass,
+xentropy,rank}_objective.hpp. Every objective here is a pure jnp function over the score
+vector; ranking objectives use padded per-query blocks (see ranking.py) instead of the
+reference's per-query OpenMP loops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config, canonical_objective
+from .utils.log import LightGBMError, log_warning
+
+_EPS = 1e-15
+
+
+class ObjectiveFunction:
+    """Base class (reference: objective_function.h:38)."""
+
+    name = "none"
+    num_model_per_iteration = 1
+    is_ranking = False
+    need_renew_leaf = False
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.label: Optional[jnp.ndarray] = None
+        self.weight: Optional[jnp.ndarray] = None
+
+    def init(self, label: np.ndarray, weight: Optional[np.ndarray],
+             query_boundaries: Optional[np.ndarray] = None,
+             position: Optional[np.ndarray] = None, n: int = 0) -> None:
+        self.num_data = n
+        self.label = jnp.asarray(label, jnp.float32)
+        self.weight = None if weight is None else jnp.asarray(weight, jnp.float32)
+
+    # gradients w.r.t. raw score; returns (grad, hess), each (N,) or (N, K)
+    def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def boost_from_score(self) -> float:
+        """Initial raw score (reference: BoostFromScore)."""
+        return 0.0
+
+    def convert_output(self, raw: jnp.ndarray) -> jnp.ndarray:
+        return raw
+
+    def _apply_weight(self, grad, hess):
+        if self.weight is not None:
+            w = self.weight
+            if grad.ndim == 2:
+                w = w[:, None]
+            grad = grad * w
+            hess = hess * w
+        return grad, hess
+
+    # leaf-output renewal for percentile objectives (reference: RenewTreeOutput)
+    def renew_leaf_values(self, score, leaf_id, num_leaves, sample_mask):
+        raise NotImplementedError
+
+
+class RegressionL2(ObjectiveFunction):
+    """reference: regression_objective.hpp:94"""
+    name = "regression"
+
+    def init(self, label, weight, **kw):
+        if self.config.reg_sqrt:
+            label = np.sign(label) * np.sqrt(np.abs(label))
+        super().init(label, weight, **kw)
+
+    def get_gradients(self, score):
+        grad = score - self.label
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        if self.weight is not None:
+            return float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        return float(jnp.mean(self.label))
+
+    def convert_output(self, raw):
+        if self.config.reg_sqrt:
+            return jnp.sign(raw) * raw * raw
+        return raw
+
+
+class RegressionL1(ObjectiveFunction):
+    """reference: regression_objective.hpp:208 (leaf re-fit to weighted median)"""
+    name = "regression_l1"
+    need_renew_leaf = True
+    _percentile = 0.5
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        return _weighted_percentile(self.label, self.weight, 0.5)
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves, sample_mask):
+        resid = self.label - score
+        return _leaf_percentile(resid, leaf_id, num_leaves, self._percentile,
+                                self.weight, sample_mask)
+
+
+class Huber(ObjectiveFunction):
+    """reference: regression_objective.hpp:294"""
+    name = "huber"
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        a = self.config.alpha
+        grad = jnp.clip(diff, -a, a)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        return float(jnp.mean(self.label)) if self.weight is None else \
+            float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+
+
+class Fair(ObjectiveFunction):
+    """reference: regression_objective.hpp:352"""
+    name = "fair"
+
+    def get_gradients(self, score):
+        c = self.config.fair_c
+        diff = score - self.label
+        grad = c * diff / (jnp.abs(diff) + c)
+        hess = c * c / ((jnp.abs(diff) + c) ** 2)
+        return self._apply_weight(grad, hess)
+
+
+class Poisson(ObjectiveFunction):
+    """reference: regression_objective.hpp:399 (log link)"""
+    name = "poisson"
+
+    def init(self, label, weight, **kw):
+        if np.any(label < 0):
+            raise LightGBMError("poisson objective requires non-negative labels")
+        super().init(label, weight, **kw)
+
+    def get_gradients(self, score):
+        ex = jnp.exp(score)
+        grad = ex - self.label
+        hess = jnp.exp(score + self.config.poisson_max_delta_step)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        mean = float(jnp.mean(self.label)) if self.weight is None else \
+            float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        return float(np.log(max(mean, _EPS)))
+
+    def convert_output(self, raw):
+        return jnp.exp(raw)
+
+
+class Quantile(ObjectiveFunction):
+    """reference: regression_objective.hpp:482"""
+    name = "quantile"
+    need_renew_leaf = True
+
+    def get_gradients(self, score):
+        a = self.config.alpha
+        delta = score - self.label
+        grad = jnp.where(delta >= 0, 1.0 - a, -a)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        return _weighted_percentile(self.label, self.weight, self.config.alpha)
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves, sample_mask):
+        resid = self.label - score
+        return _leaf_percentile(resid, leaf_id, num_leaves, self.config.alpha,
+                                self.weight, sample_mask)
+
+
+class MAPE(ObjectiveFunction):
+    """reference: regression_objective.hpp:580"""
+    name = "mape"
+    need_renew_leaf = True
+    _percentile = 0.5
+
+    def init(self, label, weight, **kw):
+        super().init(label, weight, **kw)
+        self._mape_w = 1.0 / jnp.maximum(1.0, jnp.abs(self.label))
+        if self.weight is not None:
+            self._mape_w = self._mape_w * self.weight
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.sign(diff) * self._mape_w
+        hess = self._mape_w
+        return grad, hess
+
+    def boost_from_score(self):
+        return _weighted_percentile(self.label, self._mape_w, 0.5)
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves, sample_mask):
+        resid = self.label - score
+        return _leaf_percentile(resid, leaf_id, num_leaves, 0.5,
+                                self._mape_w, sample_mask)
+
+
+class Gamma(ObjectiveFunction):
+    """reference: regression_objective.hpp:681 (log link)"""
+    name = "gamma"
+
+    def get_gradients(self, score):
+        e = jnp.exp(-score)
+        grad = 1.0 - self.label * e
+        hess = self.label * e
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        mean = float(jnp.mean(self.label)) if self.weight is None else \
+            float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        return float(np.log(max(mean, _EPS)))
+
+    def convert_output(self, raw):
+        return jnp.exp(raw)
+
+
+class Tweedie(ObjectiveFunction):
+    """reference: regression_objective.hpp:718 (log link)"""
+    name = "tweedie"
+
+    def get_gradients(self, score):
+        rho = self.config.tweedie_variance_power
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        grad = -self.label * e1 + e2
+        hess = -self.label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        mean = float(jnp.mean(self.label)) if self.weight is None else \
+            float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        return float(np.log(max(mean, _EPS)))
+
+    def convert_output(self, raw):
+        return jnp.exp(raw)
+
+
+class BinaryLogloss(ObjectiveFunction):
+    """reference: binary_objective.hpp:22"""
+    name = "binary"
+
+    def init(self, label, weight, **kw):
+        u = np.unique(label[~np.isnan(label)])
+        if not np.all(np.isin(u, [0.0, 1.0])):
+            raise LightGBMError("binary objective requires 0/1 labels")
+        super().init(label, weight, **kw)
+        n_pos = float(np.sum(label > 0))
+        n_neg = float(len(label) - n_pos)
+        self._label_weights = (1.0, 1.0)
+        if self.config.is_unbalance and n_pos > 0 and n_neg > 0:
+            if n_pos > n_neg:
+                self._label_weights = (1.0, n_pos / n_neg)
+            else:
+                self._label_weights = (n_neg / n_pos, 1.0)
+        elif self.config.scale_pos_weight != 1.0:
+            self._label_weights = (1.0, self.config.scale_pos_weight)
+
+    def get_gradients(self, score):
+        sig = self.config.sigmoid
+        y = self.label
+        p = jax.nn.sigmoid(sig * score)
+        wn, wp = self._label_weights
+        lw = jnp.where(y > 0, wp, wn)
+        grad = sig * (p - y) * lw
+        hess = sig * sig * p * (1.0 - p) * lw
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        if not self.config.boost_from_average:
+            return 0.0
+        if self.weight is not None:
+            pavg = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        else:
+            pavg = float(jnp.mean(self.label))
+        pavg = min(max(pavg, 1e-9), 1.0 - 1e-9)
+        return float(np.log(pavg / (1.0 - pavg)) / self.config.sigmoid)
+
+    def convert_output(self, raw):
+        return jax.nn.sigmoid(self.config.sigmoid * raw)
+
+
+class MulticlassSoftmax(ObjectiveFunction):
+    """reference: multiclass_objective.hpp:25 — one tree per class per iteration."""
+    name = "multiclass"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_model_per_iteration = config.num_class
+
+    def init(self, label, weight, **kw):
+        k = self.config.num_class
+        il = label.astype(np.int64)
+        if np.any((il < 0) | (il >= k)):
+            raise LightGBMError(f"multiclass labels must be in [0, {k})")
+        super().init(label, weight, **kw)
+        self._onehot = jnp.asarray(np.eye(k, dtype=np.float32)[il])
+
+    def get_gradients(self, score):
+        # score: (N, K)
+        p = jax.nn.softmax(score, axis=-1)
+        grad = p - self._onehot
+        hess = 2.0 * p * (1.0 - p)
+        return self._apply_weight(grad, hess)
+
+    def convert_output(self, raw):
+        return jax.nn.softmax(raw, axis=-1)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """reference: multiclass_objective.hpp:187 — K independent binary problems."""
+    name = "multiclassova"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_model_per_iteration = config.num_class
+
+    def init(self, label, weight, **kw):
+        k = self.config.num_class
+        il = label.astype(np.int64)
+        super().init(label, weight, **kw)
+        self._onehot = jnp.asarray(np.eye(k, dtype=np.float32)[il])
+
+    def get_gradients(self, score):
+        sig = self.config.sigmoid
+        p = jax.nn.sigmoid(sig * score)
+        grad = sig * (p - self._onehot)
+        hess = sig * sig * p * (1.0 - p)
+        return self._apply_weight(grad, hess)
+
+    def convert_output(self, raw):
+        p = jax.nn.sigmoid(self.config.sigmoid * raw)
+        return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+class CrossEntropy(ObjectiveFunction):
+    """reference: xentropy_objective.hpp:45 — labels in [0, 1]."""
+    name = "cross_entropy"
+
+    def init(self, label, weight, **kw):
+        if np.any((label < 0) | (label > 1)):
+            raise LightGBMError("cross_entropy labels must be in [0, 1]")
+        super().init(label, weight, **kw)
+
+    def get_gradients(self, score):
+        p = jax.nn.sigmoid(score)
+        grad = p - self.label
+        hess = p * (1.0 - p)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self):
+        if self.weight is not None:
+            pavg = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        else:
+            pavg = float(jnp.mean(self.label))
+        pavg = min(max(pavg, 1e-9), 1.0 - 1e-9)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, raw):
+        return jax.nn.sigmoid(raw)
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """reference: xentropy_objective.hpp:186 — alternative log1p(exp) parameterisation."""
+    name = "cross_entropy_lambda"
+
+    def get_gradients(self, score):
+        y = self.label
+        if self.weight is None:
+            ep = jnp.exp(score)
+            z = jnp.log1p(ep)
+            grad = ep / (1.0 + ep) * (1.0 - y / jnp.maximum(z, _EPS))
+            # d/ds of grad
+            sig = ep / (1.0 + ep)
+            hess = sig * (1.0 - sig) * (1.0 - y / jnp.maximum(z, _EPS)) + \
+                sig * sig * y / jnp.maximum(z * z, _EPS)
+            return grad, hess
+        w = self.weight
+        ep = jnp.exp(score)
+        z = jnp.log1p(ep) * w
+        sig = ep / (1.0 + ep)
+        grad = sig * w * (1.0 - y / jnp.maximum(z, _EPS))
+        hess = sig * (1.0 - sig) * w * (1.0 - y / jnp.maximum(z, _EPS)) + \
+            (sig * w) ** 2 * y / jnp.maximum(z * z, _EPS)
+        return grad, hess
+
+    def boost_from_score(self):
+        pavg = float(jnp.mean(self.label))
+        pavg = min(max(pavg, 1e-9), 1.0 - 1e-9)
+        return float(np.log(np.expm1(-np.log1p(-pavg))) if pavg < 1 else 0.0)
+
+    def convert_output(self, raw):
+        return jnp.log1p(jnp.exp(raw))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _weighted_percentile(values, weights, alpha) -> float:
+    v = np.asarray(values, np.float64)
+    if weights is None:
+        return float(np.quantile(v, alpha, method="lower")) if len(v) else 0.0
+    w = np.asarray(weights, np.float64)
+    order = np.argsort(v)
+    cw = np.cumsum(w[order])
+    idx = int(np.searchsorted(cw, alpha * cw[-1]))
+    idx = min(idx, len(v) - 1)
+    return float(v[order[idx]])
+
+
+def _leaf_percentile(resid, leaf_id, num_leaves, alpha, weight, sample_mask):
+    """Per-leaf weighted percentile of residuals (device, sort-based).
+
+    reference: RenewTreeOutput in regression_objective.hpp — recomputes each leaf's
+    output as the alpha-percentile of its residuals."""
+    n = resid.shape[0]
+    w = jnp.ones_like(resid) if weight is None else weight
+    if sample_mask is not None:
+        w = w * sample_mask
+    # two-key sort (leaf, residual): sort by residual, then stable sort by leaf
+    o1 = jnp.argsort(resid)
+    o2 = jnp.argsort(leaf_id[o1])  # jnp.argsort is stable
+    order = o1[o2]
+    sl = leaf_id[order]
+    sr = resid[order]
+    sw = w[order]
+    cw = jnp.cumsum(sw)
+    leaf_tot = jax.ops.segment_sum(sw, sl, num_segments=num_leaves)
+    leaf_start_w = jnp.concatenate([jnp.zeros(1), jnp.cumsum(leaf_tot)[:-1]])
+    # target cumulative weight per row's leaf
+    target = leaf_start_w[sl] + alpha * leaf_tot[sl]
+    hit = (cw >= target) & (sw > 0)
+    # first hit per leaf: segment_min over positions
+    pos = jnp.where(hit, jnp.arange(n), n)
+    first = jax.ops.segment_min(pos, sl, num_segments=num_leaves)
+    first = jnp.clip(first, 0, n - 1)
+    vals = sr[first]
+    ok = leaf_tot > 0
+    return jnp.where(ok, vals, 0.0)
+
+
+_OBJECTIVE_CLASSES = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "mape": MAPE,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+}
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """Factory (reference: ObjectiveFunction::CreateObjectiveFunction,
+    objective_function.cpp:72)."""
+    name = canonical_objective(str(config.objective))
+    if name == "none":
+        return None
+    if name in ("lambdarank", "rank_xendcg"):
+        from .ranking import LambdarankNDCG, RankXENDCG
+        return LambdarankNDCG(config) if name == "lambdarank" else RankXENDCG(config)
+    cls = _OBJECTIVE_CLASSES.get(name)
+    if cls is None:
+        raise LightGBMError(f"Unknown objective {name}")
+    return cls(config)

@@ -1,0 +1,124 @@
+"""Histogram construction — the hot op of GBDT training.
+
+Reference: src/io/dense_bin.hpp:99-170 (ConstructHistogramInner: per-row fused add of
+grad/hess into hist[2*bin]) and src/treelearner/cuda/cuda_histogram_constructor.cu (device
+shared-memory atomics). TPUs have no fast scatter-add, so the TPU-native formulation is a
+one-hot matmul on the MXU:
+
+    hist[s, g, b, c] = sum_n  1[slot[n] == s] * 1[bins[n, g] == b] * w_c[n]
+
+with w = (grad, hess, count). ``slot`` assigns each row to the histogram slot of its leaf
+(-1 = row not needed this round), so histograms for up to S leaves are built in ONE pass
+over the data. Histogram layout is (S, G, Bmax, 3) — groups padded to a common bin count,
+which keeps shapes static for XLA.
+
+Backends:
+  * ``segsum``  — jax.ops.segment_sum scatter (correct everywhere; fast on CPU).
+  * ``onehot``  — blocked one-hot matmul (MXU path, pure XLA).
+  * ``pallas``  — fused Pallas TPU kernel (see pallas/hist_kernel.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_CHANNELS = 3  # grad, hess, count
+
+
+def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
+                     hess: jax.Array, cnt: jax.Array, num_slots: int,
+                     max_group_bins: int, backend: str = "auto",
+                     block_rows: int = 16384, dtype=jnp.float32) -> jax.Array:
+    """Build per-slot histograms.
+
+    Args:
+      bins: (N, G) integer bin matrix (uint8/uint16).
+      slot: (N,) int32 — histogram slot per row; negative = skip row.
+      grad/hess: (N,) float32 (pre-multiplied by any bagging mask).
+      cnt: (N,) float32 count weight (the bagging mask itself; 1.0 = in-bag).
+      num_slots: S (static).
+      max_group_bins: Bmax (static).
+    Returns:
+      (S, G, Bmax, 3) float32 histograms.
+    """
+    if backend == "auto":
+        backend = "onehot" if jax.default_backend() in ("tpu", "axon") else "segsum"
+    if backend == "segsum":
+        return _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins)
+    if backend == "onehot":
+        return _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins,
+                            block_rows, dtype)
+    if backend == "pallas":
+        from ..pallas.hist_kernel import hist_pallas
+        return hist_pallas(bins, slot, grad, hess, cnt, num_slots, max_group_bins)
+    raise ValueError(f"unknown hist backend {backend!r}")
+
+
+def _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins):
+    n, num_groups = bins.shape
+    valid = slot >= 0
+    s = jnp.where(valid, slot, 0)
+    w = jnp.stack([grad, hess, cnt], axis=-1)  # (N, 3)
+    w = w * valid[:, None].astype(w.dtype)
+
+    def per_group(bins_col):
+        ids = s * max_group_bins + bins_col.astype(jnp.int32)  # (N,)
+        h = jax.ops.segment_sum(w, ids, num_segments=num_slots * max_group_bins)
+        return h.reshape(num_slots, max_group_bins, NUM_CHANNELS)
+
+    # scan over groups keeps peak memory at O(N) instead of O(N*G)
+    hist_g = jax.lax.map(per_group, bins.T)          # (G, S, Bmax, 3)
+    return jnp.transpose(hist_g, (1, 0, 2, 3))       # (S, G, Bmax, 3)
+
+
+def _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins, block_rows,
+                 dtype):
+    """Blocked one-hot matmul: per row block and group, (Bmax, T) @ (T, 3S) on the MXU."""
+    n, num_groups = bins.shape
+    nb = -(-n // block_rows)
+    pad = nb * block_rows - n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        slot = jnp.pad(slot, (0, pad), constant_values=-1)
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+        cnt = jnp.pad(cnt, (0, pad))
+
+    valid = slot >= 0
+    s = jnp.where(valid, slot, 0)
+    # W[n, 3*s + c] = w_c[n] * 1[slot[n] == s]   -> (N, 3S)
+    slot_oh = jax.nn.one_hot(s, num_slots, dtype=dtype) * valid[:, None].astype(dtype)
+    w = jnp.stack([grad.astype(dtype), hess.astype(dtype),
+                   cnt.astype(dtype)], axis=-1)          # (N, 3)
+    W = (slot_oh[:, :, None] * w[:, None, :]).reshape(-1, num_slots * NUM_CHANNELS)
+
+    bins_b = bins.reshape(nb, block_rows, num_groups)
+    W_b = W.reshape(nb, block_rows, num_slots * NUM_CHANNELS)
+
+    def block_body(carry, xs):
+        b_blk, w_blk = xs                                  # (T, G), (T, 3S)
+        def group_body(g, acc):
+            col = jax.lax.dynamic_index_in_dim(b_blk, g, axis=1, keepdims=False)
+            oh = jax.nn.one_hot(col.astype(jnp.int32), max_group_bins,
+                                dtype=dtype, axis=0)       # (Bmax, T)
+            h = jax.lax.dot(oh, w_blk,
+                            preferred_element_type=jnp.float32)   # (Bmax, 3S)
+            return acc.at[g].add(h)
+        acc0 = carry
+        acc = jax.lax.fori_loop(0, num_groups, group_body, acc0)
+        return acc, None
+
+    init = jnp.zeros((num_groups, max_group_bins, num_slots * NUM_CHANNELS), jnp.float32)
+    hist, _ = jax.lax.scan(block_body, init, (bins_b, W_b))
+    # (G, Bmax, 3S) -> (S, G, Bmax, 3)
+    hist = hist.reshape(num_groups, max_group_bins, num_slots, NUM_CHANNELS)
+    return jnp.transpose(hist, (2, 0, 1, 3))
+
+
+def hist_subtract(parent: jax.Array, child: jax.Array) -> jax.Array:
+    """Histogram subtraction trick (reference: serial_tree_learner.cpp:481 use_subtract)."""
+    return parent - child

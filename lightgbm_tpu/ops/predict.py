@@ -1,0 +1,104 @@
+"""Batched tree traversal on binned data (jit).
+
+Reference: src/boosting/gbdt_prediction.cpp + tree.h:135 (per-row recursive walk).
+TPU design: all rows walk the tree synchronously — a fori_loop of gather/select steps
+bounded by the tree's maximum depth; trees of one model are scanned with accumulation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StackedTrees(NamedTuple):
+    """All trees of a model stacked along axis 0 (device-resident model)."""
+    split_feature: jax.Array    # (T, L-1) i32
+    threshold_bin: jax.Array    # (T, L-1) i32
+    dir_flags: jax.Array        # (T, L-1) i32
+    left_child: jax.Array       # (T, L-1) i32
+    right_child: jax.Array      # (T, L-1) i32
+    cat_bitset: jax.Array       # (T, L-1, Bmax) bool
+    leaf_value: jax.Array       # (T, L) f32
+    max_depth: int              # static bound for the walk loop
+
+
+def _walk_one_tree(tree_slice, bins, routing, max_depth):
+    """Leaf index per row for one tree. tree_slice fields without the T axis."""
+    (split_feature, threshold_bin, dir_flags, left_child, right_child,
+     cat_bitset) = tree_slice
+    n = bins.shape[0]
+    Bmax = cat_bitset.shape[-1]
+    node = jnp.zeros(n, jnp.int32)
+
+    from .grow import feature_local_bin  # local import to avoid cycle
+
+    def step(_, node):
+        active = node >= 0
+        ni = jnp.maximum(node, 0)
+        f = split_feature[ni]
+        grp = routing.feat_group[f]
+        gb = jnp.take_along_axis(bins, grp[:, None].astype(jnp.int32), axis=1)[:, 0]
+        fb = feature_local_bin(gb, f, routing)
+        thr = threshold_bin[ni]
+        d = dir_flags[ni]
+        is_cat = (d & 2) != 0
+        default_left = (d & 1) != 0
+        is_nan = (routing.nan_bin[f] >= 0) & (fb == routing.nan_bin[f])
+        go_left_num = jnp.where(is_nan, default_left, fb <= thr)
+        go_left_cat = cat_bitset.reshape(-1)[ni * Bmax + fb]
+        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+        nxt = jnp.where(go_left, left_child[ni], right_child[ni])
+        return jnp.where(active, nxt, node)
+
+    node = jax.lax.fori_loop(0, max_depth, step, node)
+    return ~node  # leaf index (walk guaranteed complete within max_depth)
+
+
+def predict_leaves(trees: StackedTrees, bins: jax.Array, routing) -> jax.Array:
+    """(T, N) leaf index per tree per row."""
+    def one(tree_fields):
+        return _walk_one_tree(tree_fields, bins, routing, trees.max_depth)
+    fields = (trees.split_feature, trees.threshold_bin, trees.dir_flags,
+              trees.left_child, trees.right_child, trees.cat_bitset)
+    return jax.lax.map(one, fields)
+
+
+def predict_score(trees: StackedTrees, bins: jax.Array, routing,
+                  num_class: int = 1) -> jax.Array:
+    """Sum of leaf values over trees -> (N,) or (N, K) raw scores.
+
+    Trees are laid out iteration-major (reference: GBDT models_ vector, class-parallel
+    trees per iteration)."""
+    n = bins.shape[0]
+
+    def body(acc, tree_fields_and_values):
+        tree_fields = tree_fields_and_values[:-1]
+        leaf_value = tree_fields_and_values[-1]
+        leaf = _walk_one_tree(tree_fields, bins, routing, trees.max_depth)
+        return acc + leaf_value[leaf], None
+
+    if num_class == 1:
+        init = jnp.zeros(n, jnp.float32)
+        xs = (trees.split_feature, trees.threshold_bin, trees.dir_flags,
+              trees.left_child, trees.right_child, trees.cat_bitset,
+              trees.leaf_value)
+        score, _ = jax.lax.scan(body, init, xs)
+        return score
+    # class-parallel: tree t belongs to class t % num_class
+    t_total = trees.split_feature.shape[0]
+    leaves = predict_leaves(trees, bins, routing)          # (T, N)
+    vals = jnp.take_along_axis(trees.leaf_value, leaves, axis=1)  # (T, N)
+    k_of_t = jnp.arange(t_total) % num_class
+    score = jax.ops.segment_sum(vals, k_of_t, num_segments=num_class)  # (K, N)
+    return score.T
+
+
+def add_tree_score(score: jax.Array, leaf_value: jax.Array,
+                   leaf_id: jax.Array) -> jax.Array:
+    """Training-time score update: the grower already knows each row's leaf
+    (reference: ScoreUpdater::AddScore — here it is a single gather)."""
+    return score + leaf_value[leaf_id]

@@ -1,0 +1,161 @@
+"""SHAP feature contributions (pred_contrib).
+
+Reference: src/io/tree.cpp TreeSHAP (Lundberg's exact algorithm) used by
+GBDT::PredictContrib (gbdt.cpp:655). Exact per-row TreeSHAP over host trees; output
+layout matches the reference: (N, F+1) per class with the expected value in the last
+column. Round-1 implementation is host-side Python — correct but not optimised for very
+large prediction batches.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import Tree
+
+
+class _PathElem:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index, zero_fraction, one_fraction, pweight):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path: List[_PathElem], zero_fraction, one_fraction, feature_index):
+    path.append(_PathElem(feature_index, zero_fraction, one_fraction,
+                          1.0 if len(path) == 0 else 0.0))
+    d = len(path) - 1
+    for i in range(d - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (d + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (d - i) / (d + 1)
+
+
+def _unwind_path(path: List[_PathElem], path_index):
+    d = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[d].pweight
+    for i in range(d - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (d + 1) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * (d - i) / (d + 1)
+        else:
+            path[i].pweight = path[i].pweight * (d + 1) / (zero_fraction * (d - i))
+    for i in range(path_index, d):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+    path.pop()
+
+
+def _unwound_path_sum(path: List[_PathElem], path_index):
+    d = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[d].pweight
+    total = 0.0
+    for i in range(d - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (d + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * ((d - i) / (d + 1))
+        elif zero_fraction != 0:
+            total += (path[i].pweight / zero_fraction) / ((d - i) / (d + 1))
+    return total
+
+
+def _decision(tree: Tree, node: int, x: np.ndarray) -> bool:
+    f = int(tree.split_feature[node])
+    v = x[f]
+    dt = int(tree.decision_type[node])
+    if dt & 1:  # categorical
+        if np.isnan(v) or v < 0:
+            return False
+        c = int(v)
+        kcat = int(tree.threshold_bin[node])
+        s, e = tree.cat_boundaries[kcat], tree.cat_boundaries[kcat + 1]
+        if c // 32 >= e - s:
+            return False
+        return bool((int(tree.cat_threshold[s + c // 32]) >> (c % 32)) & 1)
+    missing_type = (dt >> 2) & 3
+    is_missing = np.isnan(v) or (missing_type == 1 and abs(v) < 1e-35)
+    if is_missing and missing_type != 0:
+        return bool(dt & 2)  # default left
+    if np.isnan(v):
+        v = 0.0
+    return v <= tree.threshold[node]
+
+
+def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
+               path: List[_PathElem], parent_zero_fraction: float,
+               parent_one_fraction: float, parent_feature_index: int) -> None:
+    path = [
+        _PathElem(p.feature_index, p.zero_fraction, p.one_fraction, p.pweight)
+        for p in path
+    ]
+    _extend_path(path, parent_zero_fraction, parent_one_fraction,
+                 parent_feature_index)
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, len(path)):
+            w = _unwound_path_sum(path, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) * \
+                tree.leaf_value[leaf]
+        return
+    hot = _decision(tree, node, x)
+    hot_child = int(tree.left_child[node] if hot else tree.right_child[node])
+    cold_child = int(tree.right_child[node] if hot else tree.left_child[node])
+    w_node = _node_weight(tree, node)
+    w_hot = _child_weight(tree, hot_child)
+    w_cold = _child_weight(tree, cold_child)
+    hot_zero_fraction = w_hot / w_node if w_node > 0 else 0.0
+    cold_zero_fraction = w_cold / w_node if w_node > 0 else 0.0
+    incoming_zero = 1.0
+    incoming_one = 1.0
+    f = int(tree.split_feature[node])
+    # undo previous split on the same feature along the path
+    path_index = next((i for i in range(len(path))
+                       if path[i].feature_index == f), -1)
+    if path_index >= 0:
+        incoming_zero = path[path_index].zero_fraction
+        incoming_one = path[path_index].one_fraction
+        _unwind_path(path, path_index)
+    _tree_shap(tree, x, phi, hot_child, path,
+               hot_zero_fraction * incoming_zero, incoming_one, f)
+    _tree_shap(tree, x, phi, cold_child, path,
+               cold_zero_fraction * incoming_zero, 0.0, f)
+
+
+def _node_weight(tree: Tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_count[~node])
+    return float(tree.internal_count[node])
+
+
+_child_weight = _node_weight
+
+
+def predict_contrib(trees: List[Tree], X: np.ndarray, num_class: int) -> np.ndarray:
+    n, nf = X.shape
+    k = max(num_class, 1)
+    out = np.zeros((n, k, nf + 1), np.float64)
+    for ti, tree in enumerate(trees):
+        kk = ti % k
+        if tree.num_leaves <= 1:
+            out[:, kk, nf] += tree.leaf_value[0] if len(tree.leaf_value) else 0.0
+            continue
+        expected = tree.expected_value()
+        out[:, kk, nf] += expected
+        for r in range(n):
+            phi = np.zeros(nf + 1, np.float64)
+            _tree_shap(tree, X[r], phi, 0, [], 1.0, 1.0, -1)
+            out[r, kk, :nf] += phi[:nf]
+    if k == 1:
+        return out[:, 0, :]
+    return out.reshape(n, k * (nf + 1))

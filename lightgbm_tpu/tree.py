@@ -1,0 +1,242 @@
+"""Tree model structure.
+
+Reference: include/LightGBM/tree.h:27 (flat-array binary tree: split feature, bin + real
+thresholds, child pointers with ~leaf encoding, leaf values/counts, categorical bitsets)
+and src/io/tree.cpp (serialization). Here the device-side tree is a NamedTuple of fixed-size
+JAX arrays (shapes static under jit); the host-side `Tree` adds real-valued thresholds and
+category bitsets for model IO and raw-feature prediction.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+# dir_flags bits shared with ops.split
+from .ops.split import (DIR_CAT_ONEHOT, DIR_CAT_REVERSED, DIR_CATEGORICAL,
+                        DIR_DEFAULT_LEFT)
+
+
+class TreeArrays(NamedTuple):
+    """Device-side tree produced by the grower; sizes padded to the num_leaves budget L.
+
+    Child pointer convention matches the reference (tree.h): value >= 0 is an internal
+    node index, value < 0 encodes leaf ~leaf_idx."""
+    split_feature: "np.ndarray"     # (L-1,) i32
+    threshold_bin: "np.ndarray"     # (L-1,) i32 feature-local bin / cat prefix len
+    dir_flags: "np.ndarray"         # (L-1,) i32
+    left_child: "np.ndarray"        # (L-1,) i32
+    right_child: "np.ndarray"       # (L-1,) i32
+    split_gain: "np.ndarray"        # (L-1,) f32
+    internal_value: "np.ndarray"    # (L-1,) f32 (node output if it were a leaf)
+    internal_weight: "np.ndarray"   # (L-1,) f32 (sum_hessian)
+    internal_count: "np.ndarray"    # (L-1,) f32
+    cat_bitset: "np.ndarray"        # (L-1, Bmax) bool — left-side bin membership
+    leaf_value: "np.ndarray"        # (L,) f32
+    leaf_weight: "np.ndarray"       # (L,) f32
+    leaf_count: "np.ndarray"        # (L,) f32
+    leaf_parent: "np.ndarray"       # (L,) i32 node index (-1 for root)
+    num_leaves: "np.ndarray"        # () i32 — actual leaf count
+    leaf_depth: "np.ndarray"        # (L,) i32
+
+
+@dataclass
+class Tree:
+    """Host-side tree with real-valued thresholds (model IO + raw prediction).
+
+    ``shrinkage`` records the cumulative learning-rate factor applied to leaf values
+    (reference: Tree::Shrinkage, tree.h)."""
+
+    num_leaves: int
+    split_feature: np.ndarray        # (num_leaves-1,) int32
+    threshold_bin: np.ndarray        # (num_leaves-1,) int32
+    threshold: np.ndarray            # (num_leaves-1,) float64 — real split value
+    decision_type: np.ndarray        # (num_leaves-1,) uint8 — LightGBM-compatible bits
+    left_child: np.ndarray
+    right_child: np.ndarray
+    split_gain: np.ndarray
+    internal_value: np.ndarray
+    internal_weight: np.ndarray
+    internal_count: np.ndarray
+    leaf_value: np.ndarray           # (num_leaves,) float64
+    leaf_weight: np.ndarray
+    leaf_count: np.ndarray
+    cat_boundaries: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int32))
+    cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
+    shrinkage: float = 1.0
+    is_linear: bool = False
+
+    # LightGBM decision_type bit layout (reference: tree.h kCategoricalMask etc.)
+    _CAT_MASK = 1
+    _DEFAULT_LEFT_MASK = 2
+    # missing type in bits 2-3: 0 none, 1 zero, 2 nan
+    @staticmethod
+    def make_decision_type(is_cat: bool, default_left: bool, missing_type: int) -> int:
+        d = 0
+        if is_cat:
+            d |= Tree._CAT_MASK
+        if default_left:
+            d |= Tree._DEFAULT_LEFT_MASK
+        d |= (missing_type & 3) << 2
+        return d
+
+    @property
+    def num_cat(self) -> int:
+        return int(len(self.cat_boundaries) - 1) if len(self.cat_threshold) else 0
+
+    def shrink(self, rate: float) -> None:
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    def add_bias(self, bias: float) -> None:
+        """Fold a constant into the tree (reference: Tree::AddBias, used by
+        boost_from_average so saved models are self-contained, gbdt.cpp:425)."""
+        self.leaf_value = self.leaf_value + bias
+        self.internal_value = self.internal_value + bias
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Vectorised raw-feature prediction (reference: Tree::Predict / tree.h:135
+        NumericalDecision: missing handling + `value <= threshold` goes left)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        node = np.zeros(n, dtype=np.int64)
+        out_leaf = np.full(n, -1, dtype=np.int64)
+        active = node >= 0
+        # max path length bounded by number of internal nodes
+        for _ in range(self.num_leaves - 1):
+            if not active.any():
+                break
+            idx = node[active]
+            f = self.split_feature[idx]
+            v = X[active, f]
+            dt = self.decision_type[idx]
+            is_cat = (dt & self._CAT_MASK) != 0
+            default_left = (dt & self._DEFAULT_LEFT_MASK) != 0
+            missing_type = (dt >> 2) & 3
+            nan_mask = np.isnan(v)
+            zero_missing = missing_type == 1
+            miss = np.where(zero_missing, nan_mask | (np.abs(v) < 1e-35), nan_mask)
+            go_left = v <= self.threshold[idx]
+            # categorical: membership in bitset
+            if is_cat.any():
+                ci = idx[is_cat]
+                vi = v[is_cat]
+                iv = np.where(np.isnan(vi), -1, vi).astype(np.int64)
+                gl = np.zeros(len(ci), dtype=bool)
+                for j, (node_i, cat_v) in enumerate(zip(ci, iv)):
+                    k = self._cat_index_of_node(node_i)
+                    if k >= 0 and cat_v >= 0:
+                        s, e = self.cat_boundaries[k], self.cat_boundaries[k + 1]
+                        word = cat_v // 32
+                        if word < e - s:
+                            gl[j] = bool((self.cat_threshold[s + word] >> (cat_v % 32)) & 1)
+                go_left[is_cat] = gl
+                miss = miss & ~is_cat
+            go_left = np.where(miss, default_left, go_left)
+            nxt = np.where(go_left, self.left_child[idx], self.right_child[idx])
+            leaf_hit = nxt < 0
+            sel = np.where(active)[0]
+            out_leaf[sel[leaf_hit]] = ~nxt[leaf_hit]
+            node[sel] = nxt
+            active = node >= 0
+        out_leaf = np.where(out_leaf < 0, 0, out_leaf)
+        return self.leaf_value[out_leaf]
+
+    def predict_leaf_raw(self, X: np.ndarray) -> np.ndarray:
+        """Leaf index per row (pred_leaf path)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        saved = self.leaf_value
+        try:
+            self.leaf_value = np.arange(self.num_leaves, dtype=np.float64)
+            return self.predict_raw(X).astype(np.int32)
+        finally:
+            self.leaf_value = saved
+
+    def _cat_index_of_node(self, node_i: int) -> int:
+        """Index into cat_boundaries for a categorical node: the threshold_bin field of a
+        categorical node stores its categorical-split ordinal."""
+        return int(self.threshold_bin[node_i])
+
+    # -- SHAP-style expected-value helpers ------------------------------
+    def expected_value(self) -> float:
+        if self.num_leaves <= 1:
+            return float(self.leaf_value[0]) if len(self.leaf_value) else 0.0
+        total = self.internal_count[0] if len(self.internal_count) else 0
+        if total <= 0:
+            return 0.0
+        return float(np.sum(self.leaf_value[:self.num_leaves] *
+                            self.leaf_count[:self.num_leaves]) / max(total, 1.0))
+
+
+def finalize_tree(arrays, bin_mappers, feat_group, learning_rate: float = 1.0,
+                  missing_types=None) -> Tree:
+    """Convert device TreeArrays to a host Tree: bin thresholds -> real thresholds,
+    bin bitsets -> category-value bitsets, trim padding."""
+    import numpy as _np
+
+    nl = int(arrays.num_leaves)
+    ni = max(nl - 1, 0)
+    split_feature = _np.asarray(arrays.split_feature[:ni], dtype=np.int32)
+    thr_bin = _np.asarray(arrays.threshold_bin[:ni], dtype=np.int32)
+    dirf = _np.asarray(arrays.dir_flags[:ni], dtype=np.int32)
+    cat_bits = _np.asarray(arrays.cat_bitset[:ni]) if ni else _np.zeros((0, 1), bool)
+
+    threshold = _np.zeros(ni, dtype=np.float64)
+    decision_type = _np.zeros(ni, dtype=np.uint8)
+    cat_boundaries = [0]
+    cat_words: List[np.ndarray] = []
+    thr_out = thr_bin.copy()
+    n_cat = 0
+    for i in range(ni):
+        f = int(split_feature[i])
+        m = bin_mappers[f]
+        is_cat = bool(dirf[i] & DIR_CATEGORICAL)
+        default_left = bool(dirf[i] & DIR_DEFAULT_LEFT)
+        if is_cat:
+            # bins in the left set -> category values
+            left_bins = _np.where(cat_bits[i])[0]
+            left_bins = left_bins[left_bins < len(m.categories)]
+            cats = m.categories[left_bins]
+            max_cat = int(cats.max()) if len(cats) else 0
+            words = _np.zeros(max_cat // 32 + 1, dtype=np.uint32)
+            for c in cats:
+                words[int(c) // 32] |= np.uint32(1 << (int(c) % 32))
+            cat_words.append(words)
+            cat_boundaries.append(cat_boundaries[-1] + len(words))
+            thr_out[i] = n_cat            # categorical ordinal
+            threshold[i] = float(n_cat)
+            n_cat += 1
+            decision_type[i] = Tree.make_decision_type(True, False, 0)
+        else:
+            threshold[i] = m.bin_to_threshold(int(thr_bin[i]))
+            decision_type[i] = Tree.make_decision_type(
+                False, default_left, int(m.missing_type))
+
+    tree = Tree(
+        num_leaves=max(nl, 1),
+        split_feature=split_feature,
+        threshold_bin=thr_out,
+        threshold=threshold,
+        decision_type=decision_type,
+        left_child=_np.asarray(arrays.left_child[:ni], dtype=np.int32),
+        right_child=_np.asarray(arrays.right_child[:ni], dtype=np.int32),
+        split_gain=_np.asarray(arrays.split_gain[:ni], dtype=np.float64),
+        internal_value=_np.asarray(arrays.internal_value[:ni], dtype=np.float64),
+        internal_weight=_np.asarray(arrays.internal_weight[:ni], dtype=np.float64),
+        internal_count=_np.asarray(arrays.internal_count[:ni], dtype=np.float64),
+        leaf_value=_np.asarray(arrays.leaf_value[:max(nl, 1)], dtype=np.float64),
+        leaf_weight=_np.asarray(arrays.leaf_weight[:max(nl, 1)], dtype=np.float64),
+        leaf_count=_np.asarray(arrays.leaf_count[:max(nl, 1)], dtype=np.float64),
+        cat_boundaries=_np.asarray(cat_boundaries, dtype=np.int32),
+        cat_threshold=(_np.concatenate(cat_words) if cat_words
+                       else _np.zeros(0, dtype=np.uint32)),
+    )
+    if learning_rate != 1.0:
+        tree.shrink(learning_rate)
+    return tree

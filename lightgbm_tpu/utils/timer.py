@@ -1,0 +1,59 @@
+"""Named accumulating timers + profiler scopes.
+
+Reference: include/LightGBM/utils/common.h:980 (Common::Timer / global_timer, RAII
+FunctionTimer, printed at exit under USE_TIMETAG). TPU equivalent additionally wraps
+jax.named_scope so regions show up in xprof traces.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+import jax
+
+
+class Timer:
+    """Accumulating named wall-clock timer (host-side)."""
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+    @contextlib.contextmanager
+    def scope(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = [f"{name}: {total:.3f}s ({self.counts[name]} calls)"
+                 for name, total in sorted(self.totals.items())]
+        return "\n".join(lines)
+
+
+global_timer = Timer()
+
+
+@atexit.register
+def _print_timers() -> None:
+    if global_timer.enabled and global_timer.totals:
+        print("[LightGBM-TPU] timers:\n" + global_timer.report())
+
+
+@contextlib.contextmanager
+def named_scope(name: str) -> Iterator[None]:
+    """Combined host timer + device trace annotation (shows in JAX profiler)."""
+    with jax.named_scope(name):
+        with global_timer.scope(name):
+            yield

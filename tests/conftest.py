@@ -1,0 +1,60 @@
+"""Test configuration: force an 8-device CPU platform so sharding/multi-chip paths are
+testable without TPU hardware (mirrors the reference's strategy of testing distributed
+mode with localhost multi-process, SURVEY.md §4 tier 2)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_synthetic_regression(n=2000, f=10, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    y = (X[:, 0] * 2.0 + np.sin(X[:, 1] * 3.0) + X[:, 2] * X[:, 3]
+         + 0.1 * rs.randn(n))
+    return X, y
+
+
+def make_synthetic_binary(n=2000, f=10, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    logit = X[:, 0] * 1.5 - X[:, 1] + X[:, 2] * X[:, 3] * 0.5
+    p = 1.0 / (1.0 + np.exp(-logit))
+    y = (rs.rand(n) < p).astype(np.float64)
+    return X, y
+
+
+def make_synthetic_multiclass(n=3000, f=10, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    centers = rs.randn(k, f) * 1.5
+    logits = X @ centers.T
+    y = np.argmax(logits + 0.5 * rs.randn(n, k), axis=1).astype(np.float64)
+    return X, y
+
+
+def make_synthetic_ranking(nq=100, docs_per_q=(5, 40), f=10, seed=0):
+    rs = np.random.RandomState(seed)
+    sizes = rs.randint(docs_per_q[0], docs_per_q[1], size=nq)
+    n = int(sizes.sum())
+    X = rs.randn(n, f)
+    rel_score = X[:, 0] * 2.0 + X[:, 1] + 0.3 * rs.randn(n)
+    # map to 0-4 relevance grades within query
+    y = np.zeros(n)
+    start = 0
+    for s in sizes:
+        seg = rel_score[start:start + s]
+        ranks = np.argsort(np.argsort(seg))
+        y[start:start + s] = np.minimum(4, (ranks * 5) // max(s, 1))
+        start += s
+    return X, y, sizes

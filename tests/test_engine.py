@@ -1,0 +1,191 @@
+"""End-to-end training tests (model: reference tests/python_package_test/test_engine.py)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import (make_synthetic_binary, make_synthetic_multiclass,
+                      make_synthetic_regression)
+
+
+def _split(X, y, frac=0.2, seed=1):
+    rs = np.random.RandomState(seed)
+    n = len(y)
+    test = rs.rand(n) < frac
+    return X[~test], y[~test], X[test], y[test]
+
+
+def test_regression_l2():
+    X, y = make_synthetic_regression()
+    Xtr, ytr, Xte, yte = _split(X, y)
+    train_set = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31, "verbosity": -1,
+                     "learning_rate": 0.1}, train_set, num_boost_round=50)
+    pred = bst.predict(Xte)
+    mse = float(np.mean((pred - yte) ** 2))
+    base = float(np.var(yte))
+    assert mse < 0.35 * base, f"mse {mse} vs var {base}"
+
+
+def test_binary_classification():
+    X, y = make_synthetic_binary()
+    Xtr, ytr, Xte, yte = _split(X, y)
+    train_set = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31, "verbosity": -1},
+                    train_set, num_boost_round=50)
+    p = bst.predict(Xte)
+    assert p.min() >= 0 and p.max() <= 1
+    acc = np.mean((p > 0.5) == (yte > 0))
+    assert acc > 0.8, f"accuracy {acc}"
+
+
+def test_binary_auc_improves():
+    X, y = make_synthetic_binary(n=4000)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    train_set = lgb.Dataset(Xtr, label=ytr)
+    valid_set = train_set.create_valid(Xte, label=yte)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss"],
+                     "num_leaves": 31, "verbosity": -1},
+                    train_set, num_boost_round=60, valid_sets=[valid_set],
+                    callbacks=[lgb.record_evaluation(evals)])
+    aucs = evals["valid_0"]["auc"]
+    assert aucs[-1] > 0.85
+    assert aucs[-1] > aucs[0]
+
+
+def test_multiclass():
+    X, y = make_synthetic_multiclass()
+    Xtr, ytr, Xte, yte = _split(X, y)
+    train_set = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "multiclass", "num_class": 4, "verbosity": -1,
+                     "num_leaves": 15}, train_set, num_boost_round=30)
+    p = bst.predict(Xte)
+    assert p.shape == (len(yte), 4)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    acc = np.mean(np.argmax(p, axis=1) == yte)
+    assert acc > 0.75, f"accuracy {acc}"
+
+
+def test_early_stopping():
+    X, y = make_synthetic_regression()
+    Xtr, ytr, Xte, yte = _split(X, y)
+    train_set = lgb.Dataset(Xtr, label=ytr)
+    valid_set = train_set.create_valid(Xte, label=yte)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "early_stopping_round": 5},
+                    train_set, num_boost_round=500, valid_sets=[valid_set])
+    assert bst.best_iteration > 0
+    assert bst.best_iteration < 500
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = make_synthetic_binary()
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 15},
+                    train_set, num_boost_round=10)
+    pred1 = bst.predict(X, raw_score=True)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    pred2 = bst2.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-5, atol=1e-6)
+    # probabilities too (objective string round-trips)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_synthetic_regression(n=3000)
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "bagging_fraction": 0.7, "bagging_freq": 1,
+                     "feature_fraction": 0.8}, train_set, num_boost_round=30)
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_goss():
+    X, y = make_synthetic_binary(n=3000)
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "data_sample_strategy": "goss"}, train_set, num_boost_round=40)
+    p = bst.predict(X)
+    acc = np.mean((p > 0.5) == (y > 0))
+    assert acc > 0.8
+
+
+def test_dart():
+    X, y = make_synthetic_regression()
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "boosting": "dart",
+                     "verbosity": -1}, train_set, num_boost_round=30)
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.6 * np.var(y)
+
+
+def test_rf():
+    X, y = make_synthetic_binary(n=3000)
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_fraction": 0.7, "bagging_freq": 1,
+                     "verbosity": -1}, train_set, num_boost_round=20)
+    p = bst.predict(X)
+    acc = np.mean((p > 0.5) == (y > 0))
+    assert acc > 0.8
+
+
+def test_l1_objective_renews_leaves():
+    X, y = make_synthetic_regression()
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression_l1", "verbosity": -1},
+                    train_set, num_boost_round=40)
+    pred = bst.predict(X)
+    mae = np.mean(np.abs(pred - y))
+    assert mae < 0.6 * np.mean(np.abs(y - np.median(y)))
+
+
+def test_categorical_features():
+    rs = np.random.RandomState(7)
+    n = 3000
+    cat = rs.randint(0, 8, n)
+    x1 = rs.randn(n)
+    effect = np.array([2.0, -1.0, 0.5, 3.0, -2.0, 0.0, 1.0, -0.5])
+    y = effect[cat] + 0.5 * x1 + 0.1 * rs.randn(n)
+    X = np.column_stack([cat.astype(np.float64), x1])
+    train_set = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "verbosity": -1, "num_leaves": 15,
+                     "min_data_per_group": 10},
+                    train_set, num_boost_round=40)
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.1 * np.var(y)
+
+
+def test_custom_objective():
+    X, y = make_synthetic_regression()
+    train_set = lgb.Dataset(X, label=y)
+
+    def fobj(preds, dataset):
+        grad = preds - dataset.get_label()
+        hess = np.ones_like(grad)
+        return grad, hess
+
+    # custom objective via booster.update
+    bst2 = lgb.Booster({"objective": "none", "verbosity": -1},
+                       lgb.Dataset(X, label=y))
+    for _ in range(30):
+        bst2.update(fobj=fobj)
+    pred = bst2.predict(X, raw_score=True)
+    assert np.mean((pred - y) ** 2) < 0.5 * np.var(y)
+
+
+def test_feature_importance():
+    X, y = make_synthetic_regression()
+    train_set = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, train_set,
+                    num_boost_round=20)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (X.shape[1],)
+    assert imp_split.sum() > 0
+    # informative features should dominate
+    assert imp_gain[0] > imp_gain[5]
