@@ -1,0 +1,74 @@
+"""Benchmark: HIGGS-shaped binary classification training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: LightGBM CPU trains HIGGS (10.5M rows x 28 features, num_leaves=255,
+lr=0.1, 500 iters) in 130.094 s => 0.2602 s/tree (BASELINE.md, docs/Experiments.rst:113).
+This benchmark trains the same configuration on a row-subsampled HIGGS-shaped synthetic
+dataset (same feature count, bins, leaves) and reports seconds per tree scaled to the
+10.5M-row workload for an apples-to-apples vs_baseline ratio:
+    s_per_tree_full = s_per_tree_bench * (10.5e6 / n_bench)
+    vs_baseline     = 0.2602 / s_per_tree_full            (>1 = faster than LightGBM CPU)
+The histogram build cost is linear in rows (one-hot matmul contraction over N), making
+the row scaling a good proxy until the full dataset fits the bench budget.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_FEATURES = 28
+NUM_LEAVES = 255
+N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+BASELINE_S_PER_TREE = 130.094 / 500.0  # LightGBM CPU HIGGS
+HIGGS_ROWS = 10_500_000
+
+
+def make_higgs_like(n, f, seed=7):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).astype(np.float32)
+    logit = (1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.6 * X[:, 2] * X[:, 3]
+             + 0.4 * np.sin(3 * X[:, 4]) + 0.3 * X[:, 5])
+    p = 1.0 / (1.0 + np.exp(-logit))
+    y = (rs.rand(n) < p).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(N_ROWS, N_FEATURES)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "verbosity": -1,
+        "max_splits_per_round": 64,
+    }
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params, ds)
+    # warmup: compile + first tree
+    bst.update()
+    t0 = time.time()
+    for _ in range(N_ITERS):
+        bst.update()
+    # sync
+    bst.engine.score.block_until_ready()
+    elapsed = time.time() - t0
+    s_per_tree = elapsed / N_ITERS
+    s_per_tree_full = s_per_tree * (HIGGS_ROWS / N_ROWS)
+    vs_baseline = BASELINE_S_PER_TREE / s_per_tree_full
+    print(json.dumps({
+        "metric": "higgs_like_train_s_per_tree_10p5M_rows",
+        "value": round(s_per_tree_full, 4),
+        "unit": "s/tree (lower is better; scaled to 10.5M rows, 255 leaves)",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

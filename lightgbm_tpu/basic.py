@@ -133,6 +133,8 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self.binned is not None:
             return self
+        if self.num_data_ == 0:
+            raise LightGBMError("Cannot construct Dataset: it has no rows")
         cfg = Config.from_params(self.params)
         if self.reference is not None:
             ref = self.reference.construct()
@@ -468,6 +470,11 @@ class Booster:
         if sp is not None:
             data = sp
         X, _, _ = _to_2d_float(data)
+        expected = self.num_feature()
+        if expected and X.shape[1] != expected:
+            raise LightGBMError(
+                f"The number of features in data ({X.shape[1]}) is not the same "
+                f"as it was in training data ({expected})")
         trees = self._all_trees()
         k = self.num_model_per_iteration()
         n_total_iters = len(trees) // max(k, 1)

@@ -140,6 +140,12 @@ class BinMapper:
                 hit = self.categories[sorter[pos]] == iv
                 out = np.where(hit, sorter[pos], 0).astype(np.int32)
             return out
+        # native fast path (C++/OpenMP binary search; reference: BinMapper::ValueToBin)
+        from .native import value_to_bin as _native_v2b
+        res = _native_v2b(values, self.upper_bounds, self.missing_type,
+                          self.num_bins, self.default_bin)
+        if res is not None:
+            return res.astype(np.int32)
         nan_mask = np.isnan(values)
         if self.missing_type == MISSING_ZERO:
             nan_mask = nan_mask | (np.abs(values) <= _ZERO_UB)

@@ -49,8 +49,11 @@ def load_data_file(path: str, params: Dict[str, Any]
     if fmt == "libsvm":
         return _load_libsvm(path)
     delim = "," if fmt == "csv" else "\t"
-    data = np.genfromtxt(path, delimiter=delim,
-                         skip_header=1 if has_header else 0, dtype=np.float64)
+    from .native import parse_csv as _native_parse
+    data = _native_parse(path, delim=delim, skip_header=has_header)
+    if data is None:
+        data = np.genfromtxt(path, delimiter=delim,
+                             skip_header=1 if has_header else 0, dtype=np.float64)
     if data.ndim == 1:
         data = data.reshape(-1, 1)
     label = data[:, label_col].copy()

@@ -123,6 +123,10 @@ def save_model_string(booster, num_iteration: Optional[int] = None,
     k = booster.num_model_per_iteration()
     total_iteration = len(trees) // max(k, 1)
     start_iteration = max(0, min(start_iteration, total_iteration))
+    if num_iteration is None:
+        # LightGBM semantics (basic.py save_model): None -> best_iteration if set
+        bi = getattr(booster, "best_iteration", -1)
+        num_iteration = bi if bi and bi > 0 else None
     if num_iteration is not None and num_iteration > 0:
         end = min(start_iteration + num_iteration, total_iteration)
     else:
@@ -216,6 +220,9 @@ def _parse_array(s: str, dtype):
 
 def load_model_string(model_str: str) -> LoadedModel:
     lines = model_str.split("\n")
+    if not lines or lines[0].strip() != "tree":
+        raise LightGBMError("Model string is not a LightGBM model "
+                            "(missing 'tree' header)")
     lm = LoadedModel()
     i = 0
     # header

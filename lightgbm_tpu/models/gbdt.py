@@ -52,7 +52,25 @@ class GBDT:
         self._valid_scores: List[jax.Array] = []
         self.best_iteration = -1
 
+        from ..parallel.mesh import (bins_sharding, create_mesh, data_sharding,
+                                     pad_rows_for_mesh)
+        self.mesh = create_mesh(config.mesh_shape, config.tree_learner,
+                                config.num_machines)
         dd: DeviceData = train_data.device_data()
+        self._row_sharding = None
+        if self.mesh is not None:
+            n_pad = pad_rows_for_mesh(dd.bins.shape[0], self.mesh)
+            bins = dd.bins
+            if n_pad != bins.shape[0]:
+                bins = jnp.pad(bins, ((0, n_pad - bins.shape[0]), (0, 0)))
+            bins = jax.device_put(bins, bins_sharding(self.mesh, config.tree_learner))
+            dd = dd._replace(bins=bins)
+            if config.tree_learner != "feature":
+                # rows are the sharded axis: keep every per-row array (score, grad,
+                # hess, bagging mask) on the same sharding so each eager op compiles
+                # to ONE consistent SPMD program (mixed placements would race the
+                # in-process collectives)
+                self._row_sharding = data_sharding(self.mesh)
         self.dd = dd
         n = dd.bins.shape[0]                  # padded row count
         self.num_data = train_data.num_data()
@@ -60,7 +78,7 @@ class GBDT:
         # row-pad mask: padded rows contribute nothing
         pad_mask = np.zeros(n, np.float32)
         pad_mask[:self.num_data] = 1.0
-        self._pad_mask = jnp.asarray(pad_mask)
+        self._pad_mask = self._shard_row_array(jnp.asarray(pad_mask))
 
         k = self.num_tree_per_iteration
         self._score_shape = (n,) if k == 1 else (n, k)
@@ -72,6 +90,7 @@ class GBDT:
         base = train_data.get_init_score_padded(n, k)
         if base is not None:
             self.score = self.score + jnp.asarray(base, jnp.float32)
+        self.score = self._shard_row_array(self.score)
 
         self.sample_strategy = create_sample_strategy(
             config, n,
@@ -84,6 +103,18 @@ class GBDT:
                               params=self._grow_params))
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         self._saved_state: Optional[Tuple] = None
+
+    # ------------------------------------------------------------------
+    def _shard_row_array(self, a):
+        """Place a per-row array ((N,) or (N, K)) on the mesh's row sharding."""
+        if self._row_sharding is None:
+            return a
+        if a.ndim == 1:
+            return jax.device_put(a, self._row_sharding)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = self._row_sharding.spec
+        return jax.device_put(
+            a, NamedSharding(self._row_sharding.mesh, P(spec[0], None)))
 
     # ------------------------------------------------------------------
     def _make_grow_params(self) -> GrowParams:
@@ -102,6 +133,8 @@ class GBDT:
             max_cat_to_onehot=c.max_cat_to_onehot,
             min_data_per_group=c.min_data_per_group,
             hist_backend=c.hist_backend,
+            has_categorical=any(m.bin_type == 1
+                                for m in self.train_data.bin_mappers()),
         )
 
     def _compute_init_score(self) -> List[float]:
@@ -181,7 +214,9 @@ class GBDT:
             grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
             hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
         mask, grad, hess = self.sample_strategy.sample(self.iter_, grad, hess)
-        mask = mask * self._pad_mask
+        mask = self._shard_row_array(mask) * self._pad_mask
+        grad = self._shard_row_array(grad)
+        hess = self._shard_row_array(hess)
         if grad.ndim == 2:
             grad = grad * self._pad_mask[:, None]
             hess = hess * self._pad_mask[:, None]

@@ -1,0 +1,190 @@
+// Native host-side data kernels: CSV/TSV parsing and bin transformation.
+//
+// Reference: src/io/parser.cpp (CSV/TSV/LibSVM parser with fast_double_parser) and
+// src/io/bin.cpp BinMapper::ValueToBin / dense_bin.hpp Push. These are the host-side
+// hot paths of dataset construction (the TPU owns everything after binning); a
+// vectorised C++17 implementation with OpenMP keeps ingest off the Python interpreter.
+//
+// Exposed C ABI (ctypes):
+//   lgbt_parse_csv     — parse a delimited text buffer into a dense double matrix
+//   lgbt_value_to_bin  — upper_bounds binary-search transform, OpenMP over rows
+//   lgbt_rows_cols     — count rows/cols of a delimited buffer (sizing pass)
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Fast strtod-lite: handles the common numeric forms in data files; falls back to
+// strtod for exotic inputs.
+static double parse_double(const char* p, const char* end, const char** out) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  if (p >= end) { *out = p; return std::numeric_limits<double>::quiet_NaN(); }
+  bool neg = false;
+  if (*p == '-') { neg = true; ++p; }
+  else if (*p == '+') { ++p; }
+  // nan / inf
+  if (p < end && (*p == 'n' || *p == 'N')) {
+    *out = p + 3 <= end ? p + 3 : end;
+    return std::numeric_limits<double>::quiet_NaN();
+  }
+  if (p < end && (*p == 'i' || *p == 'I')) {
+    *out = p + 3 <= end ? p + 3 : end;
+    double v = std::numeric_limits<double>::infinity();
+    return neg ? -v : v;
+  }
+  uint64_t mant = 0;
+  int digits = 0, dp_offset = 0, consumed = 0;
+  bool saw_dot = false;
+  while (p < end) {
+    char c = *p;
+    if (c >= '0' && c <= '9') {
+      if (digits < 18) { mant = mant * 10 + (c - '0'); ++digits; if (saw_dot) --dp_offset; }
+      else if (!saw_dot) ++dp_offset;
+      ++consumed;
+      ++p;
+    } else if (c == '.' && !saw_dot) {
+      saw_dot = true; ++p;
+    } else {
+      break;
+    }
+  }
+  if (consumed == 0) {  // empty / non-numeric field -> missing, not 0.0
+    *out = p;
+    return std::numeric_limits<double>::quiet_NaN();
+  }
+  double v = static_cast<double>(mant);
+  int exp10 = dp_offset;
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < end && (*p == '-')) { eneg = true; ++p; }
+    else if (p < end && (*p == '+')) ++p;
+    int e = 0;
+    while (p < end && *p >= '0' && *p <= '9') { e = e * 10 + (*p - '0'); ++p; }
+    exp10 += eneg ? -e : e;
+  }
+  if (exp10 != 0) v *= std::pow(10.0, exp10);
+  *out = p;
+  return neg ? -v : v;
+}
+
+// Count data rows and columns (first sizing pass).
+void lgbt_rows_cols(const char* buf, int64_t len, char delim, int skip_header,
+                    int64_t* out_rows, int64_t* out_cols) {
+  int64_t rows = 0, cols = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  bool first_line = true;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    if (line_end > p && line_end[-1] == '\r') --line_end;  // CRLF
+    if (line_end > p) {
+      if (first_line && skip_header) {
+        first_line = false;
+      } else {
+        if (cols == 0) {
+          int64_t c = 1;
+          for (const char* q = p; q < line_end; ++q)
+            if (*q == delim) ++c;
+          cols = c;
+        }
+        ++rows;
+        first_line = false;
+      }
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  *out_rows = rows;
+  *out_cols = cols;
+}
+
+// Parse a delimited buffer into out[rows*cols] (row-major). Rows are located in a
+// serial newline scan, then parsed in parallel.
+void lgbt_parse_csv(const char* buf, int64_t len, char delim, int skip_header,
+                    int64_t rows, int64_t cols, double* out) {
+  std::vector<const char*> line_starts;
+  line_starts.reserve(rows + 1);
+  const char* p = buf;
+  const char* end = buf + len;
+  bool first_line = true;
+  while (p < end && static_cast<int64_t>(line_starts.size()) < rows) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    if (line_end > p && line_end[-1] == '\r') --line_end;  // CRLF
+    if (line_end > p) {
+      if (first_line && skip_header) {
+        first_line = false;
+      } else {
+        line_starts.push_back(p);
+        first_line = false;
+      }
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  const int64_t n = static_cast<int64_t>(line_starts.size());
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const char* q = line_starts[r];
+    const char* line_end = static_cast<const char*>(
+        memchr(q, '\n', end - q));
+    if (!line_end) line_end = end;
+    double* row_out = out + r * cols;
+    for (int64_t c = 0; c < cols; ++c) {
+      if (q >= line_end) {
+        row_out[c] = std::numeric_limits<double>::quiet_NaN();
+        continue;
+      }
+      const char* next;
+      row_out[c] = parse_double(q, line_end, &next);
+      q = next;
+      while (q < line_end && *q != delim) ++q;
+      if (q < line_end) ++q;  // skip delimiter
+    }
+  }
+}
+
+// values[n] -> bins[n] via upper-bound binary search (reference:
+// BinMapper::ValueToBin). missing_type: 0 none, 1 zero-as-missing, 2 nan.
+void lgbt_value_to_bin(const double* values, int64_t n,
+                       const double* upper_bounds, int32_t num_bounds,
+                       int32_t missing_type, int32_t num_bins,
+                       int32_t default_bin, uint16_t* out) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    double v = values[i];
+    bool miss = std::isnan(v);
+    if (missing_type == 1 && std::fabs(v) <= 1e-35) miss = true;
+    if (miss) {
+      out[i] = static_cast<uint16_t>(
+          missing_type == 0 ? default_bin : num_bins - 1);
+      continue;
+    }
+    // first index with upper_bounds[idx] >= v
+    int32_t lo = 0, hi = num_bounds - 1;
+    while (lo < hi) {
+      int32_t mid = (lo + hi) / 2;
+      if (upper_bounds[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    out[i] = static_cast<uint16_t>(lo);
+  }
+}
+
+}  // extern "C"

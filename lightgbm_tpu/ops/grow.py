@@ -51,6 +51,7 @@ class GrowParams(NamedTuple):
     max_cat_to_onehot: int
     min_data_per_group: int
     hist_backend: str = "auto"
+    has_categorical: bool = True
 
 
 class RoutingLayout(NamedTuple):
@@ -134,6 +135,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot,
         min_data_per_group=params.min_data_per_group,
+        enable_categorical=params.has_categorical,
     )
 
     # ---- root ----
@@ -212,11 +214,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
         # ---- categorical bitsets for the chosen splits ----
         parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 3)
-        hf = gather_feature_histograms(parent_hist, layout, pg, ph, pc)
-        hf_feat = hf[jnp.arange(S), feat]                     # (S, Bmax, 3)
-        bitset = categorical_left_bitset(
-            hf_feat, thr, dirf, layout.valid_mask[feat],
-            params.cat_smooth, params.min_data_per_group)     # (S, Bmax)
+        if params.has_categorical:
+            hf = gather_feature_histograms(parent_hist, layout, pg, ph, pc)
+            hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 3)
+            bitset = categorical_left_bitset(
+                hf_feat, thr, dirf, layout.valid_mask[feat],
+                params.cat_smooth, params.min_data_per_group)  # (S, Bmax)
+        else:
+            bitset = jnp.zeros((S, Bmax), bool)
 
         # ---- node array updates ----
         out = leaf_output(pg, ph, params.lambda_l1, params.lambda_l2,

@@ -103,6 +103,7 @@ def find_best_splits(
     max_cat_threshold: int = 32,
     max_cat_to_onehot: int = 4,
     min_data_per_group: int = 100,
+    enable_categorical: bool = True,
 ) -> SplitResult:
     S, G, Bmax, _ = hist.shape
     F = layout.gather_idx.shape[0]
@@ -150,10 +151,39 @@ def find_best_splits(
     num_gain = jnp.maximum(gain_d0, gain_d1)               # (S, F, Bmax)
     num_default_left = gain_d1 > gain_d0
 
+    if not enable_categorical:
+        # numeric-only fast path: much smaller compiled program (no per-bin argsort)
+        best_t = jnp.argmax(num_gain, axis=-1)
+        best_gain_f = jnp.take_along_axis(num_gain, best_t[..., None], -1)[..., 0]
+        if col_mask is not None:
+            cm = jnp.broadcast_to(jnp.asarray(col_mask, bool), best_gain_f.shape)
+            best_gain_f = jnp.where(cm, best_gain_f, NEG_INF)
+        best_f = jnp.argmax(best_gain_f, axis=-1)
+        ar = jnp.arange(S)
+        best_gain = best_gain_f[ar, best_f]
+        t = best_t[ar, best_f]
+        dflt_l = num_default_left[ar, best_f, t]
+
+        def pick(a3):
+            return a3[ar, best_f, t]
+
+        lg = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
+        lh = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
+        lc = pick(cc) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_c, cc.shape)), 0.0)
+        parent_term = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
+        rel_gain = best_gain - parent_term
+        splittable = best_gain > (parent_term + min_gain_to_split)
+        rel_gain = jnp.where(splittable, rel_gain, NEG_INF)
+        dir_flags = jnp.where(dflt_l, DIR_DEFAULT_LEFT, 0)
+        return SplitResult(
+            gain=rel_gain.astype(jnp.float32), feature=best_f.astype(jnp.int32),
+            threshold=t.astype(jnp.int32), dir_flags=dir_flags.astype(jnp.int32),
+            left_sum_g=lg, left_sum_h=lh, left_count=lc,
+            right_sum_g=parent_g - lg, right_sum_h=parent_h - lh,
+            right_count=parent_c - lc)
+
     # ---------------- categorical ----------------
     is_cat = layout.is_cat[None, :, None]
-    # one-hot: left = single bin b
-    oh_gain = split_gain_cat = None
     cat_l2_total = lambda_l2 + cat_l2
 
     def split_gain_cat(lg, lh, lc):

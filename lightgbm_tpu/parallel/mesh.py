@@ -1,0 +1,115 @@
+"""Device mesh + sharding policy — the distributed backend.
+
+Reference: src/network/ (from-scratch socket/MPI collectives: Allreduce/ReduceScatter/
+Allgather, network.cpp:72-307) and the three distributed learners in src/treelearner/
+(feature_parallel_tree_learner.cpp, data_parallel_tree_learner.cpp,
+voting_parallel_tree_learner.cpp).
+
+TPU re-design: the entire collective layer is replaced by XLA GSPMD over a
+jax.sharding.Mesh. The tree grower (ops/grow.py) is pure jnp, so:
+
+  * tree_learner="data"    -> shard rows (N) across the mesh. The histogram build
+    contracts over N, so XLA inserts an all-reduce of histogram blocks — exactly the
+    reference's ReduceScatter+Allgather specialisation (data_parallel_tree_learner.
+    cpp:285-299) chosen automatically, riding ICI instead of TCP.
+  * tree_learner="feature" -> shard the feature-group axis (G). Each device builds
+    histograms and split candidates for its feature slice; the argmax over features
+    becomes an all-gather of per-shard bests (the reference Allreduces SplitInfo,
+    feature_parallel_tree_learner.cpp:25-83).
+  * tree_learner="voting"  -> planned as a comm optimisation of "data" for DCN-connected
+    hosts (top-k vote before the histogram reduce, PV-Tree); round-2 work.
+
+Multi-host: call jax.distributed.initialize() before building the mesh; the same
+program runs SPMD across hosts (replaces LGBM_NetworkInit / machine_list entirely).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.log import LightGBMError, log_info
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def parse_mesh_shape(spec: str) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """Parse "data:4,feature:2" into axis names/sizes."""
+    names, sizes = [], []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition(":")
+        names.append(name.strip())
+        sizes.append(int(size))
+    return tuple(names), tuple(sizes)
+
+
+def create_mesh(mesh_shape: str = "", tree_learner: str = "serial",
+                num_machines: int = 1) -> Optional[Mesh]:
+    """Build the device mesh for the configured parallelism (None = single device)."""
+    devices = jax.devices()
+    n = len(devices)
+    if num_machines > 1 and jax.process_count() < num_machines:
+        log_info(f"num_machines={num_machines} but only {jax.process_count()} "
+                 "JAX process(es) are initialized; call jax.distributed.initialize() "
+                 "on every host before training (replaces LGBM_NetworkInit). "
+                 "Proceeding with the devices visible to this process.")
+    if mesh_shape:
+        names, sizes = parse_mesh_shape(mesh_shape)
+        total = int(np.prod(sizes))
+        if total > n:
+            raise LightGBMError(f"mesh {mesh_shape} needs {total} devices, have {n}")
+        dev = np.asarray(devices[:total]).reshape(sizes)
+        return Mesh(dev, names)
+    if tree_learner in ("data", "voting"):
+        if n == 1:
+            log_info("tree_learner=data with a single device: running serial")
+            return None
+        return Mesh(np.asarray(devices), (DATA_AXIS,))
+    if tree_learner == "feature":
+        if n == 1:
+            log_info("tree_learner=feature with a single device: running serial")
+            return None
+        return Mesh(np.asarray(devices), (FEATURE_AXIS,))
+    return None
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across the data axis (bins (N, G), grad/hess/leaf_id (N,))."""
+    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis))
+
+
+def bins_sharding(mesh: Mesh, tree_learner: str) -> NamedSharding:
+    if tree_learner == "feature" or (FEATURE_AXIS in mesh.axis_names
+                                     and DATA_AXIS not in mesh.axis_names):
+        return NamedSharding(mesh, P(None, FEATURE_AXIS))
+    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    if FEATURE_AXIS in mesh.axis_names and tree_learner != "data":
+        return NamedSharding(mesh, P(axis, FEATURE_AXIS))
+    return NamedSharding(mesh, P(axis))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh: Optional[Mesh], *arrays):
+    """Place row-dimension arrays on the mesh (no-op without a mesh)."""
+    if mesh is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    sh = data_sharding(mesh)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def pad_rows_for_mesh(n: int, mesh: Optional[Mesh], base: int = 256) -> int:
+    """Row count padded so every shard is equal-sized and tile-aligned."""
+    mult = base
+    if mesh is not None:
+        mult = base * int(np.prod(mesh.devices.shape))
+    return -(-n // mult) * mult

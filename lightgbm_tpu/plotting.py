@@ -1,0 +1,208 @@
+"""Plotting utilities (matplotlib/graphviz gated).
+
+Reference: python-package/lightgbm/plotting.py — plot_importance, plot_metric,
+plot_split_value_histogram, plot_tree / create_tree_digraph.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .sklearn import LGBMModel
+from .utils.log import LightGBMError
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:
+        raise ImportError("You must install matplotlib to plot") from e
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be a Booster or LGBMModel")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2, xlim=None, ylim=None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance", ylabel: str = "Features",
+                    importance_type: str = "auto", max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None, grid: bool = True,
+                    precision: Optional[int] = 3, **kwargs):
+    plt = _check_matplotlib()
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = bst.feature_importance(importance_type)
+    feature_names = bst.feature_name()
+    tuples = sorted(zip(feature_names, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [t for t in tuples if t[1] != 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("Cannot plot trees with zero importance")
+    labels, values = zip(*tuples)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if importance_type == "gain" else str(int(x)),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict, Any], metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None, xlim=None,
+                ylim=None, title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    plt = _check_matplotlib()
+    if isinstance(booster, dict):
+        eval_results = booster
+    elif isinstance(booster, LGBMModel):
+        eval_results = booster.evals_result_
+    else:
+        raise TypeError("booster must be a dict from record_evaluation or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results are empty")
+    if dataset_names is None:
+        dataset_names = list(eval_results.keys())
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    msg = None
+    for name in dataset_names:
+        metrics = eval_results[name]
+        if metric is None:
+            metric = next(iter(metrics.keys()))
+        if metric not in metrics:
+            raise ValueError(f"metric {metric} not found for {name}")
+        results = metrics[metric]
+        ax.plot(range(len(results)), results, label=name)
+    ax.legend(loc="best")
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel.replace("@metric@", metric or ""))
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None, width_coef=0.8,
+                               xlim=None, ylim=None,
+                               title="Split value histogram for feature with @index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid=True, **kwargs):
+    plt = _check_matplotlib()
+    bst = _to_booster(booster)
+    feature_names = bst.feature_name()
+    if isinstance(feature, str):
+        fidx = feature_names.index(feature)
+    else:
+        fidx = int(feature)
+    values = []
+    for t in bst._all_trees():
+        for i in range(t.num_leaves - 1):
+            if int(t.split_feature[i]) == fidx and not (int(t.decision_type[i]) & 1):
+                values.append(float(t.threshold[i]))
+    if not values:
+        raise ValueError("Cannot plot split value histogram, "
+                         f"because feature {feature} was not used in splitting")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
+    width = width_coef * (bin_edges[1] - bin_edges[0]) if len(bin_edges) > 1 else 1.0
+    ax.bar(centers, hist, width=width, **kwargs)
+    ax.set_title(title.replace("@index/name@", "name" if isinstance(feature, str)
+                               else "index").replace("@feature@", str(feature)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
+                        precision: Optional[int] = 3, orientation: str = "horizontal",
+                        **kwargs):
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError("You must install graphviz to plot tree") from e
+    bst = _to_booster(booster)
+    trees = bst._all_trees()
+    if tree_index >= len(trees):
+        raise IndexError(f"tree_index {tree_index} out of range")
+    t = trees[tree_index]
+    show_info = show_info or []
+    graph = graphviz.Digraph(**kwargs)
+    graph.attr(rankdir="LR" if orientation == "horizontal" else "TB")
+    fnames = bst.feature_name()
+
+    def add(node: int, parent: Optional[str], decision: Optional[str]):
+        if node < 0:
+            leaf = ~node
+            name = f"leaf{leaf}"
+            label = f"leaf {leaf}: {t.leaf_value[leaf]:.{precision}f}"
+            if "leaf_count" in show_info:
+                label += f"\ncount: {int(t.leaf_count[leaf])}"
+            if "leaf_weight" in show_info:
+                label += f"\nweight: {t.leaf_weight[leaf]:.{precision}f}"
+            graph.node(name, label=label)
+        else:
+            name = f"split{node}"
+            f = int(t.split_feature[node])
+            dt = int(t.decision_type[node])
+            if dt & 1:
+                label = f"{fnames[f]} in cat set {int(t.threshold_bin[node])}"
+            else:
+                label = f"{fnames[f]} <= {t.threshold[node]:.{precision}f}"
+            if "split_gain" in show_info:
+                label += f"\ngain: {t.split_gain[node]:.{precision}f}"
+            if "internal_value" in show_info:
+                label += f"\nvalue: {t.internal_value[node]:.{precision}f}"
+            if "internal_count" in show_info:
+                label += f"\ncount: {int(t.internal_count[node])}"
+            graph.node(name, label=label)
+            add(int(t.left_child[node]), name, "yes")
+            add(int(t.right_child[node]), name, "no")
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    add(0 if t.num_leaves > 1 else ~0, None, None)
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info=None, precision: Optional[int] = 3,
+              orientation: str = "horizontal", **kwargs):
+    plt = _check_matplotlib()
+    try:
+        import importlib
+        image_mod = importlib.import_module("PIL.Image")
+    except ImportError as e:
+        raise ImportError("You must install Pillow to plot tree") from e
+    import io
+    graph = create_tree_digraph(booster, tree_index=tree_index, show_info=show_info,
+                                precision=precision, orientation=orientation)
+    s = io.BytesIO(graph.pipe(format="png"))
+    img = image_mod.open(s)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

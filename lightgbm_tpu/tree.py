@@ -178,8 +178,10 @@ def finalize_tree(arrays, bin_mappers, feat_group, learning_rate: float = 1.0,
                   missing_types=None) -> Tree:
     """Convert device TreeArrays to a host Tree: bin thresholds -> real thresholds,
     bin bitsets -> category-value bitsets, trim padding."""
+    import jax
     import numpy as _np
 
+    arrays = jax.device_get(arrays)  # one transfer for the whole pytree
     nl = int(arrays.num_leaves)
     ni = max(nl - 1, 0)
     split_feature = _np.asarray(arrays.split_feature[:ni], dtype=np.int32)

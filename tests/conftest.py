@@ -3,13 +3,31 @@ testable without TPU hardware (mirrors the reference's strategy of testing distr
 mode with localhost multi-process, SURVEY.md §4 tier 2)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform with 8 virtual devices. A site hook may have already
+# imported jax and registered an accelerator backend at interpreter startup, so
+# env-var settings alone are too late — update jax.config and clear any
+# initialized backends. XLA_FLAGS is still read lazily at CPU client creation.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:  # noqa: BLE001 — best effort; fresh interpreters need no clearing
+    pass
+
 import numpy as np
 import pytest
+
+# persistent compilation cache: repeated test runs skip XLA compiles
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgb_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture

@@ -1,0 +1,87 @@
+"""Binning unit tests (model: reference bin-mapper semantics, bin.cpp)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                                  BinMapper, construct_binned, find_bin_mappers,
+                                  find_feature_groups)
+
+
+def test_few_distinct_values_get_own_bins():
+    vals = np.array([1.0, 1.0, 2.0, 2.0, 3.0, 3.0, 3.0])
+    m = BinMapper.find_numerical(vals, max_bin=255, min_data_in_bin=1,
+                                 use_missing=True, zero_as_missing=False)
+    assert m.num_bins == 3
+    b = m.transform(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    # ordering preserved
+    assert b[0] < b[1] < b[2]
+
+
+def test_quantile_binning_many_values():
+    rs = np.random.RandomState(0)
+    vals = rs.randn(10000)
+    m = BinMapper.find_numerical(vals, max_bin=64, min_data_in_bin=3,
+                                 use_missing=True, zero_as_missing=False)
+    assert 2 <= m.num_bins <= 64
+    b = m.transform(vals)
+    counts = np.bincount(b, minlength=m.num_bins)
+    # roughly balanced bins: no bin with more than 15% of data
+    assert counts.max() < 0.15 * len(vals)
+
+
+def test_nan_gets_own_bin():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan])
+    m = BinMapper.find_numerical(vals, max_bin=16, min_data_in_bin=1,
+                                 use_missing=True, zero_as_missing=False)
+    assert m.missing_type == MISSING_NAN
+    b = m.transform(vals)
+    assert b[2] == b[4] == m.num_bins - 1
+    assert b[0] != b[2]
+
+
+def test_monotone_transform():
+    rs = np.random.RandomState(1)
+    vals = rs.randn(1000)
+    m = BinMapper.find_numerical(vals, max_bin=32, min_data_in_bin=3,
+                                 use_missing=False, zero_as_missing=False)
+    x = np.sort(rs.randn(100))
+    b = m.transform(x)
+    assert np.all(np.diff(b) >= 0), "binning must be monotone"
+
+
+def test_categorical_binning():
+    vals = np.array([3.0, 3.0, 3.0, 1.0, 1.0, 7.0], dtype=np.float64)
+    m = BinMapper.find_categorical(vals, max_bin=16, min_data_in_bin=1,
+                                   use_missing=True)
+    assert m.bin_type == BIN_CATEGORICAL
+    b = m.transform(np.array([3.0, 1.0, 7.0, 99.0]))
+    assert b[0] == 0          # most frequent category = bin 0
+    assert b[3] == 0          # unseen -> bin 0
+    assert len({b[0], b[1], b[2]}) == 3
+
+
+def test_efb_bundles_exclusive_features():
+    n = 1000
+    rs = np.random.RandomState(2)
+    f0 = np.zeros(n); f0[:300] = rs.rand(300) + 1.0
+    f1 = np.zeros(n); f1[500:700] = rs.rand(200) + 1.0
+    f2 = rs.rand(n)  # dense — must not bundle
+    data = np.column_stack([f0, f1, f2])
+    mappers = find_bin_mappers(data, 255, 1, sample_cnt=1000)
+    sample_bins = [mappers[f].transform(data[:, f]) for f in range(3)]
+    groups = find_feature_groups(sample_bins, mappers, enable_bundle=True)
+    grouped = [g for g in groups if len(g) > 1]
+    assert any(set(g) == {0, 1} for g in grouped), f"expected bundle of 0,1: {groups}"
+
+
+def test_construct_binned_layout():
+    rs = np.random.RandomState(3)
+    data = rs.randn(500, 4)
+    mappers = find_bin_mappers(data, 16, 1)
+    binned = construct_binned(data, mappers)
+    assert binned.bins.shape == (500, 4)
+    assert binned.num_total_bins == sum(m.num_bins for m in mappers)
+    # round trip: bin values within range
+    for f in range(4):
+        assert binned.bins[:, f].max() < mappers[f].num_bins

@@ -1,0 +1,103 @@
+"""Model text-format tests (model: reference test_engine.py save/load + golden format)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_synthetic_binary, make_synthetic_multiclass, \
+    make_synthetic_regression
+
+
+def test_model_string_structure():
+    X, y = make_synthetic_binary()
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    s = bst.model_to_string()
+    # LightGBM v4 text format landmarks
+    assert s.startswith("tree\n")
+    for key in ("version=v4", "num_class=1", "num_tree_per_iteration=1",
+                "max_feature_idx=9", "objective=binary sigmoid:1",
+                "feature_names=", "feature_infos=", "tree_sizes=",
+                "Tree=0", "num_leaves=", "split_feature=", "threshold=",
+                "decision_type=", "left_child=", "right_child=", "leaf_value=",
+                "internal_value=", "shrinkage=", "end of trees",
+                "feature_importances:", "parameters:", "end of parameters"):
+        assert key in s, f"missing {key!r} in model string"
+
+
+def test_roundtrip_exact_predictions():
+    X, y = make_synthetic_regression()
+    bst = lgb.train({"objective": "regression", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-6, atol=1e-7)
+
+
+def test_multiclass_roundtrip():
+    X, y = make_synthetic_multiclass()
+    bst = lgb.train({"objective": "multiclass", "num_class": 4, "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, label=y), num_boost_round=4)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    assert bst2.num_model_per_iteration() == 4
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_categorical_roundtrip():
+    rs = np.random.RandomState(4)
+    n = 2000
+    cat = rs.randint(0, 6, n).astype(np.float64)
+    x1 = rs.randn(n)
+    effect = np.array([1.0, -2.0, 0.5, 2.0, -1.0, 3.0])
+    y = effect[cat.astype(int)] + 0.1 * rs.randn(n)
+    X = np.column_stack([cat, x1])
+    bst = lgb.train({"objective": "regression", "verbosity": -1, "num_leaves": 7,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=5)
+    s = bst.model_to_string()
+    assert "num_cat=" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_dump_model_json():
+    X, y = make_synthetic_regression()
+    bst = lgb.train({"objective": "regression", "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    d = bst.dump_model()
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    t0 = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in t0
+    assert "left_child" in t0
+    # walk: every path ends in a leaf
+    def depth(node):
+        if "leaf_index" in node:
+            return 0
+        return 1 + max(depth(node["left_child"]), depth(node["right_child"]))
+    assert depth(t0) >= 1
+
+
+def test_num_iteration_predict():
+    X, y = make_synthetic_regression()
+    bst = lgb.train({"objective": "regression", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    p5 = bst.predict(X, num_iteration=5)
+    p10 = bst.predict(X, num_iteration=10)
+    assert not np.allclose(p5, p10)
+    # fewer trees = worse fit generally
+    assert np.mean((p10 - y) ** 2) <= np.mean((p5 - y) ** 2) + 1e-6
+
+
+def test_pred_leaf_and_contrib():
+    X, y = make_synthetic_regression(n=400)
+    bst = lgb.train({"objective": "regression", "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (len(y), 3)
+    assert leaves.max() < 7
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    pred = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), pred, rtol=1e-4, atol=1e-4)

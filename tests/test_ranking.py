@@ -1,0 +1,71 @@
+"""Ranking objective/metric tests (model: reference test_engine.py lambdarank tests)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_synthetic_ranking
+
+
+def _ndcg_at(scores, labels, qb, k=5):
+    nq = len(qb) - 1
+    vals = []
+    for qi in range(nq):
+        s, e = qb[qi], qb[qi + 1]
+        sc, lb = scores[s:e], labels[s:e]
+        order = np.argsort(-sc)
+        gains = 2.0 ** lb - 1.0
+        disc = 1.0 / np.log2(np.arange(len(sc)) + 2.0)
+        dcg = np.sum(gains[order][:k] * disc[:k])
+        ideal = np.sum(np.sort(gains)[::-1][:k] * disc[:k])
+        if ideal > 0:
+            vals.append(dcg / ideal)
+    return float(np.mean(vals))
+
+
+def test_lambdarank_improves_ndcg():
+    X, y, sizes = make_synthetic_ranking(nq=120)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15, "verbosity": -1,
+                     "metric": "ndcg", "eval_at": [5]},
+                    ds, num_boost_round=30)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    pred = bst.predict(X, raw_score=True)
+    ndcg_trained = _ndcg_at(pred, y, qb)
+    rs = np.random.RandomState(0)
+    ndcg_random = _ndcg_at(rs.randn(len(y)), y, qb)
+    assert ndcg_trained > ndcg_random + 0.15
+    assert ndcg_trained > 0.75
+
+
+def test_rank_xendcg():
+    X, y, sizes = make_synthetic_ranking(nq=120, seed=3)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    bst = lgb.train({"objective": "rank_xendcg", "num_leaves": 15, "verbosity": -1},
+                    ds, num_boost_round=30)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    pred = bst.predict(X, raw_score=True)
+    assert _ndcg_at(pred, y, qb) > 0.7
+
+
+def test_ndcg_metric_reported():
+    X, y, sizes = make_synthetic_ranking(nq=80)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    valid = ds.create_valid(X, label=y, group=sizes)
+    evals = {}
+    lgb.train({"objective": "lambdarank", "verbosity": -1, "eval_at": [1, 3, 5],
+               "num_leaves": 15},
+              ds, num_boost_round=10, valid_sets=[valid],
+              callbacks=[lgb.record_evaluation(evals)])
+    assert "ndcg@1" in evals["valid_0"]
+    assert "ndcg@5" in evals["valid_0"]
+    assert evals["valid_0"]["ndcg@5"][-1] >= evals["valid_0"]["ndcg@5"][0] - 0.05
+
+
+def test_lambdarank_ranker_sklearn():
+    X, y, sizes = make_synthetic_ranking(nq=100)
+    m = lgb.LGBMRanker(n_estimators=20, num_leaves=15, verbosity=-1)
+    m.fit(X, y, group=sizes)
+    pred = m.predict(X)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    assert _ndcg_at(pred, y, qb) > 0.7
