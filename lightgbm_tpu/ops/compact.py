@@ -1,0 +1,81 @@
+"""Slot compaction: sort rows by histogram slot so the Pallas histogram kernel can
+process fixed-size row blocks that each belong to exactly ONE slot.
+
+Reference analog: src/treelearner/data_partition.hpp (LightGBM keeps rows of one leaf
+contiguous via a parallel stable partition so per-leaf histograms scan a contiguous
+range). The TPU re-design reaches the same contiguity with a device-wide key sort +
+per-block scalar metadata instead of host threads:
+
+  * rows are sorted by slot (invalid rows, slot < 0, sort to the end),
+  * each slot's run is covered by ceil(count/T) blocks of T rows starting at the run
+    start (the last block of a run overlaps the next run and is masked by `valid`),
+  * per-block scalars (slot, start, valid, first) are scalar-prefetched by the kernel
+    so the block -> histogram-slot mapping costs one SMEM read.
+
+Everything here is O(N log N) sort + O(S) scalar math — no (N, S) intermediates.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CompactPlan(NamedTuple):
+    perm: jax.Array          # (N,) i32 — original row index at each sorted position
+    block_scalars: jax.Array  # (NB, 5) i32 — (slot, start, row_lo, row_hi, is_first)
+    counts: jax.Array        # (S,) i32 — rows per slot (for empty-slot masking)
+
+
+ALIGN = 128  # DMA slices along the row (lane) dimension must be 128-aligned
+
+
+def num_blocks(n: int, num_slots: int, block_rows: int) -> int:
+    """Static worst-case block count: every slot may add one partial block plus one
+    block of leading-alignment slack."""
+    return -(-n // block_rows) + 2 * num_slots
+
+
+def plan_compaction(slot: jax.Array, num_slots: int, block_rows: int) -> CompactPlan:
+    """Build the sorted-row plan for one histogram round.
+
+    slot: (N,) int32, histogram slot per row; negative = row not needed.
+    """
+    n = slot.shape[0]
+    T = block_rows
+    S = num_slots
+    NB = num_blocks(n, S, T)
+    i32 = jnp.int32
+
+    key = jnp.where(slot >= 0, slot, S).astype(i32)
+    sorted_key, perm = jax.lax.sort_key_val(key, jnp.arange(n, dtype=i32))
+
+    # run boundaries per slot (S+1 values; run_start[S] = first invalid row)
+    run_start = jnp.searchsorted(sorted_key, jnp.arange(S + 1, dtype=i32)).astype(i32)
+    counts = run_start[1:] - run_start[:-1]                      # (S,)
+    # blocks start at the 128-aligned address below the run start; `lead` rows at
+    # the front of the first block belong to the previous run and are masked out
+    lead = run_start[:-1] % ALIGN
+    aligned_start = run_start[:-1] - lead
+    blocks_per_slot = -(-(lead + counts) // T)
+    blk_off = jnp.concatenate([jnp.zeros(1, i32),
+                               jnp.cumsum(blocks_per_slot).astype(i32)])
+    total_blocks = blk_off[S]
+
+    b = jnp.arange(NB, dtype=i32)
+    s_of_b = (jnp.searchsorted(blk_off, b, side="right") - 1).astype(i32)
+    s_of_b = jnp.clip(s_of_b, 0, S - 1)
+    local = b - blk_off[s_of_b]
+    start = aligned_start[s_of_b] + local * T
+    row_lo = jnp.where(local == 0, lead[s_of_b], 0)
+    row_hi = jnp.clip(lead[s_of_b] + counts[s_of_b] - local * T, 0, T)
+    real = b < total_blocks
+    scalars = jnp.stack([
+        jnp.where(real, s_of_b, -1),
+        jnp.where(real, start, 0),
+        jnp.where(real, row_lo, 0),
+        jnp.where(real, row_hi, 0),
+        jnp.where(real & (local == 0), 1, 0),
+    ], axis=1)
+    return CompactPlan(perm=perm, block_scalars=scalars, counts=counts)
