@@ -275,10 +275,20 @@ class Dataset:
         if self.raw_data is None:
             raise LightGBMError("cannot subset after raw data was freed")
         idx = np.asarray(used_indices, np.int64)
+        # group propagation: when the indices are query-aligned (as cv()'s
+        # group-aware folds guarantee), recompute the subset's query sizes
+        group_sub = None
+        if self.group is not None and len(idx) and np.all(np.diff(idx) > 0):
+            bounds = np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+            q_of = np.searchsorted(bounds, idx, side="right") - 1
+            sel_q, counts = np.unique(q_of, return_counts=True)
+            if np.array_equal(counts, bounds[sel_q + 1] - bounds[sel_q]):
+                group_sub = counts
         sub = Dataset(
             self.raw_data[idx],
             label=None if self.label is None else self.label[idx],
             weight=None if self.weight is None else self.weight[idx],
+            group=group_sub,
             init_score=None if self.init_score is None else
             (self.init_score[idx] if self.init_score.ndim == 1
              else self.init_score[idx, :]),
@@ -286,7 +296,6 @@ class Dataset:
             feature_name=self._feature_name_arg,
             categorical_feature=self._categorical_feature_arg,
             params=params or self.params)
-        # note: group subsetting requires query-aligned indices (same as reference)
         return sub
 
     def save_binary(self, filename: str) -> "Dataset":

@@ -44,6 +44,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     booster = Booster(params=params, train_set=train_set)
     if valid_sets:
+        if valid_names is not None and len(valid_names) != len(valid_sets):
+            raise LightGBMError(
+                f"Length of valid_names ({len(valid_names)}) does not match "
+                f"valid_sets ({len(valid_sets)})")
         names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
         for vs, name in zip(valid_sets, names):
             if vs is train_set:

@@ -117,6 +117,19 @@ class GBDT:
             a, NamedSharding(self._row_sharding.mesh, P(spec[0], None)))
 
     # ------------------------------------------------------------------
+    def _resolve_hist_backend(self) -> str:
+        """Pick the histogram backend. The Pallas kernels are single-device
+        programs; under a GSPMD mesh the contraction-based backends partition
+        automatically (row-sharded histograms turn into psum), so auto selects
+        them there instead."""
+        b = self.config.hist_backend
+        if b != "auto":
+            return b
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        if self.mesh is not None:
+            return "onehot" if on_tpu else "segsum"
+        return "pallas" if on_tpu else "segsum"
+
     def _make_grow_params(self) -> GrowParams:
         c = self.config
         return GrowParams(
@@ -132,7 +145,7 @@ class GBDT:
             max_cat_threshold=c.max_cat_threshold,
             max_cat_to_onehot=c.max_cat_to_onehot,
             min_data_per_group=c.min_data_per_group,
-            hist_backend=c.hist_backend,
+            hist_backend=self._resolve_hist_backend(),
             has_categorical=any(m.bin_type == 1
                                 for m in self.train_data.bin_mappers()),
         )

@@ -51,7 +51,12 @@ class BaggingSampleStrategy(SampleStrategy):
         if self.active and c.bagging_by_query and query_boundaries is not None:
             nq = len(query_boundaries) - 1
             sizes = np.diff(query_boundaries)
-            self._qid = jnp.asarray(np.repeat(np.arange(nq), sizes))
+            qid = np.repeat(np.arange(nq), sizes)
+            if len(qid) < num_data:
+                # grad/hess are padded to num_data rows; padded rows get the
+                # out-of-range query id nq, whose mask entry is always 0
+                qid = np.concatenate([qid, np.full(num_data - len(qid), nq)])
+            self._qid = jnp.asarray(qid)
             self._nq = nq
         self._mask = None
         self._mask_iter = -1
@@ -69,7 +74,8 @@ class BaggingSampleStrategy(SampleStrategy):
             n = self.num_data
             if c.bagging_by_query and self.query_boundaries is not None:
                 u = jax.random.uniform(key, (self._nq,))
-                qmask = u < c.bagging_fraction
+                qmask = jnp.concatenate([u < c.bagging_fraction,
+                                         jnp.zeros(1, bool)])
                 self._mask = qmask[self._qid].astype(jnp.float32)
             elif self.use_posneg:
                 u = jax.random.uniform(key, (n,))

@@ -1,16 +1,18 @@
-"""Slot compaction: sort rows by histogram slot so the Pallas histogram kernel can
-process fixed-size row blocks that each belong to exactly ONE slot.
+"""Slot compaction: sort rows by histogram slot and emit fixed-size row blocks that
+each belong to exactly ONE slot, as a compact gather plan.
 
 Reference analog: src/treelearner/data_partition.hpp (LightGBM keeps rows of one leaf
 contiguous via a parallel stable partition so per-leaf histograms scan a contiguous
-range). The TPU re-design reaches the same contiguity with a device-wide key sort +
-per-block scalar metadata instead of host threads:
+range) and src/treelearner/cuda/cuda_data_partition.cu (prefix-sum compaction on
+device). The TPU re-design reaches the same contiguity with a device-wide key sort +
+per-block gather indices:
 
   * rows are sorted by slot (invalid rows, slot < 0, sort to the end),
-  * each slot's run is covered by ceil(count/T) blocks of T rows starting at the run
-    start (the last block of a run overlaps the next run and is masked by `valid`),
-  * per-block scalars (slot, start, valid, first) are scalar-prefetched by the kernel
-    so the block -> histogram-slot mapping costs one SMEM read.
+  * each slot's run is covered by ceil(count/T) blocks of T rows; a block's rows are
+    fetched through a gather-index vector, with out-of-run positions pointing at a
+    zero pad row (so no in-kernel row masking is needed),
+  * per-block scalars (slot, is_first, is_last) are scalar-prefetched by the Pallas
+    kernel so block -> histogram-slot mapping costs one SMEM read.
 
 Everything here is O(N log N) sort + O(S) scalar math — no (N, S) intermediates.
 """
@@ -22,23 +24,19 @@ import jax
 import jax.numpy as jnp
 
 
-class CompactPlan(NamedTuple):
-    perm: jax.Array          # (N,) i32 — original row index at each sorted position
-    block_scalars: jax.Array  # (NB, 5) i32 — (slot, start, row_lo, row_hi, is_first)
+class BlockPlan(NamedTuple):
+    gather_idx: jax.Array    # (NB*T,) i32 — source row per block position; n = pad row
+    scalars: jax.Array       # (NB, 3) i32 — (slot | -1, is_first, is_last)
     counts: jax.Array        # (S,) i32 — rows per slot (for empty-slot masking)
 
 
-ALIGN = 128  # DMA slices along the row (lane) dimension must be 128-aligned
-
-
 def num_blocks(n: int, num_slots: int, block_rows: int) -> int:
-    """Static worst-case block count: every slot may add one partial block plus one
-    block of leading-alignment slack."""
-    return -(-n // block_rows) + 2 * num_slots
+    """Static worst-case block count: every slot may add one partial block."""
+    return -(-n // block_rows) + num_slots
 
 
-def plan_compaction(slot: jax.Array, num_slots: int, block_rows: int) -> CompactPlan:
-    """Build the sorted-row plan for one histogram round.
+def plan_blocks(slot: jax.Array, num_slots: int, block_rows: int) -> BlockPlan:
+    """Build the sorted-row block plan for one histogram round.
 
     slot: (N,) int32, histogram slot per row; negative = row not needed.
     """
@@ -54,11 +52,7 @@ def plan_compaction(slot: jax.Array, num_slots: int, block_rows: int) -> Compact
     # run boundaries per slot (S+1 values; run_start[S] = first invalid row)
     run_start = jnp.searchsorted(sorted_key, jnp.arange(S + 1, dtype=i32)).astype(i32)
     counts = run_start[1:] - run_start[:-1]                      # (S,)
-    # blocks start at the 128-aligned address below the run start; `lead` rows at
-    # the front of the first block belong to the previous run and are masked out
-    lead = run_start[:-1] % ALIGN
-    aligned_start = run_start[:-1] - lead
-    blocks_per_slot = -(-(lead + counts) // T)
+    blocks_per_slot = -(-counts // T)
     blk_off = jnp.concatenate([jnp.zeros(1, i32),
                                jnp.cumsum(blocks_per_slot).astype(i32)])
     total_blocks = blk_off[S]
@@ -67,15 +61,33 @@ def plan_compaction(slot: jax.Array, num_slots: int, block_rows: int) -> Compact
     s_of_b = (jnp.searchsorted(blk_off, b, side="right") - 1).astype(i32)
     s_of_b = jnp.clip(s_of_b, 0, S - 1)
     local = b - blk_off[s_of_b]
-    start = aligned_start[s_of_b] + local * T
-    row_lo = jnp.where(local == 0, lead[s_of_b], 0)
-    row_hi = jnp.clip(lead[s_of_b] + counts[s_of_b] - local * T, 0, T)
+    pos = run_start[s_of_b] + local * T                          # sorted-space start
     real = b < total_blocks
-    scalars = jnp.stack([
-        jnp.where(real, s_of_b, -1),
-        jnp.where(real, start, 0),
-        jnp.where(real, row_lo, 0),
-        jnp.where(real, row_hi, 0),
-        jnp.where(real & (local == 0), 1, 0),
-    ], axis=1)
-    return CompactPlan(perm=perm, block_scalars=scalars, counts=counts)
+    first = real & (local == 0)
+    last = real & (local == blocks_per_slot[s_of_b] - 1)
+    scalars = jnp.stack([jnp.where(real, s_of_b, -1),
+                         first.astype(i32), last.astype(i32)], axis=1)
+
+    # per-block gather indices into the original row order; out-of-run -> pad row n
+    gpos = pos[:, None] + jnp.arange(T, dtype=i32)[None, :]      # (NB, T)
+    in_run = real[:, None] & (gpos < run_start[s_of_b + 1][:, None])
+    src = jnp.take(perm, jnp.clip(gpos, 0, n - 1), axis=0)
+    gather_idx = jnp.where(in_run, src, n).reshape(-1)
+    return BlockPlan(gather_idx=gather_idx, scalars=scalars, counts=counts)
+
+
+def plan_single_slot(n: int, block_rows: int) -> BlockPlan:
+    """Trivial plan for the root histogram (every row in slot 0) — no sort needed."""
+    T = block_rows
+    NB = num_blocks(n, 1, T)
+    i32 = jnp.int32
+    b = jnp.arange(NB, dtype=i32)
+    nb_real = -(-n // T)
+    real = b < nb_real
+    scalars = jnp.stack([jnp.where(real, 0, -1),
+                         (b == 0).astype(i32),
+                         (b == nb_real - 1).astype(i32)], axis=1)
+    gpos = (b[:, None] * T + jnp.arange(T, dtype=i32)[None, :]).reshape(-1)
+    gather_idx = jnp.where(gpos < n, gpos, n)
+    return BlockPlan(gather_idx=gather_idx, scalars=scalars,
+                     counts=jnp.full((1,), n, i32))

@@ -31,7 +31,8 @@ import numpy as np
 from ..tree import TreeArrays
 from .histogram import build_histograms
 from .split import (NEG_INF, FeatureLayout, SplitResult, categorical_left_bitset,
-                    find_best_splits, gather_feature_histograms, leaf_output)
+                    constrained_child_outputs, find_best_splits,
+                    gather_feature_histograms, leaf_output, smooth_output)
 
 
 class GrowParams(NamedTuple):
@@ -52,6 +53,14 @@ class GrowParams(NamedTuple):
     min_data_per_group: int
     hist_backend: str = "auto"
     has_categorical: bool = True
+    # constraints / sampling extensions (reference: monotone_constraints.hpp,
+    # col_sampler.hpp, feature_histogram.hpp path_smooth + extra_trees)
+    has_monotone: bool = False
+    monotone_penalty: float = 0.0
+    path_smooth: float = 0.0
+    has_interaction: bool = False
+    extra_trees: bool = False
+    bynode_fraction: float = 1.0
 
 
 class RoutingLayout(NamedTuple):
@@ -139,9 +148,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     )
 
     # ---- root ----
+    bins_packed = None
+    if params.hist_backend == "pallas":
+        from ..pallas.hist_kernel import pack_bins
+        bins_packed = pack_bins(bins)  # once per tree; bins are static
     leaf_id = jnp.zeros(N, i32)
     root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
-                                 backend=params.hist_backend)
+                                 backend=params.hist_backend,
+                                 bins_packed=bins_packed)
     root_g = jnp.sum(grad)
     root_h = jnp.sum(hess)
     root_c = jnp.sum(cnt_w)
@@ -301,7 +315,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             jnp.where(pair_valid, smaller_id, drop)].set(jnp.arange(S), mode="drop")
         slot = slot_map[new_leaf_id]
         hist_small = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
-                                      backend=params.hist_backend)
+                                      backend=params.hist_backend,
+                                      bins_packed=bins_packed)
         hist_large = parent_hist - hist_small
         sm_idx = jnp.where(pair_valid, smaller_id, drop)
         lg_idx = jnp.where(pair_valid, larger_id, drop)

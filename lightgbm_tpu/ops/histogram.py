@@ -32,7 +32,8 @@ NUM_CHANNELS = 3  # grad, hess, count
 def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
                      hess: jax.Array, cnt: jax.Array, num_slots: int,
                      max_group_bins: int, backend: str = "auto",
-                     block_rows: int = 16384, dtype=jnp.float32) -> jax.Array:
+                     block_rows: int = 16384, dtype=jnp.float32,
+                     bins_packed: Optional[jax.Array] = None) -> jax.Array:
     """Build per-slot histograms.
 
     Args:
@@ -46,15 +47,16 @@ def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
       (S, G, Bmax, 3) float32 histograms.
     """
     if backend == "auto":
-        backend = "onehot" if jax.default_backend() in ("tpu", "axon") else "segsum"
+        backend = "pallas" if jax.default_backend() in ("tpu", "axon") else "segsum"
     if backend == "segsum":
         return _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins)
     if backend == "onehot":
         return _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins,
                             block_rows, dtype)
     if backend == "pallas":
-        from ..pallas.hist_kernel import hist_pallas
-        return hist_pallas(bins, slot, grad, hess, cnt, num_slots, max_group_bins)
+        from ..pallas.hist_kernel import build_histograms_sorted
+        return build_histograms_sorted(bins, slot, grad, hess, cnt, num_slots,
+                                       max_group_bins, bins_packed=bins_packed)
     raise ValueError(f"unknown hist backend {backend!r}")
 
 

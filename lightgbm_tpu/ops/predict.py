@@ -55,7 +55,9 @@ def _walk_one_tree(tree_slice, bins, routing, max_depth):
         return jnp.where(active, nxt, node)
 
     node = jax.lax.fori_loop(0, max_depth, step, node)
-    return ~node  # leaf index (walk guaranteed complete within max_depth)
+    # trivial trees (num_leaves <= 1, zero-filled child arrays) never reach a
+    # negative child; resolve those rows to leaf 0 instead of gathering padding
+    return jnp.where(node < 0, ~node, 0)
 
 
 def predict_leaves(trees: StackedTrees, bins: jax.Array, routing) -> jax.Array:

@@ -67,6 +67,44 @@ def leaf_output(sum_g, sum_h, l1, l2, max_delta_step=0.0):
                      jnp.clip(out, -max_delta_step, max_delta_step), out)
 
 
+def leaf_gain_given_output(sum_g, sum_h, l1, l2, output):
+    """GetLeafGainGivenOutput (reference: feature_histogram.hpp) — the gain of a leaf
+    forced to a (constrained/smoothed) output instead of its optimum."""
+    t = _threshold_l1(sum_g, l1)
+    return -(2.0 * t * output + (sum_h + l2) * output * output)
+
+
+def smooth_output(raw, count, parent_output, path_smooth):
+    """Path smoothing (reference: feature_histogram.hpp path_smooth template arg):
+    smoothed = raw * n/(n+a) + parent * a/(n+a)."""
+    return (raw * count / (count + path_smooth)
+            + parent_output * path_smooth / (count + path_smooth))
+
+
+def monotone_penalty_factor(depth, penalty):
+    """ComputeMonotoneSplitGainPenalty (reference: monotone_constraints.hpp)."""
+    eps = 1e-10
+    d = depth.astype(jnp.float32)
+    f_small = 1.0 - penalty / jnp.exp2(d) + eps
+    f_big = 1.0 - jnp.exp2(penalty - 1.0 - d) + eps
+    out = jnp.where(penalty <= 1.0, f_small, f_big)
+    return jnp.where(penalty >= d + 1.0, eps, out)
+
+
+def constrained_child_outputs(lg, lh, lc, rg, rh, rc, l1, l2, lo, hi,
+                              path_smooth=0.0, parent_out=None):
+    """Child outputs under monotone bounds [lo, hi] and optional path smoothing —
+    used both inside the split scan and to propagate bounds after a split."""
+    ol = -_threshold_l1(lg, l1) / (lh + l2 + EPS_HESS)
+    orr = -_threshold_l1(rg, l1) / (rh + l2 + EPS_HESS)
+    if path_smooth > 0.0 and parent_out is not None:
+        ol = smooth_output(ol, lc, parent_out, path_smooth)
+        orr = smooth_output(orr, rc, parent_out, path_smooth)
+    ol = jnp.clip(ol, lo, hi)
+    orr = jnp.clip(orr, lo, hi)
+    return ol, orr
+
+
 def gather_feature_histograms(hist: jax.Array, layout: FeatureLayout,
                               parent_g: jax.Array, parent_h: jax.Array,
                               parent_c: jax.Array) -> jax.Array:
@@ -104,7 +142,21 @@ def find_best_splits(
     max_cat_to_onehot: int = 4,
     min_data_per_group: int = 100,
     enable_categorical: bool = True,
+    monotone: Optional[jax.Array] = None,   # (F,) i32 in {-1,0,1}
+    out_lo: Optional[jax.Array] = None,     # (S,) leaf output lower bounds
+    out_hi: Optional[jax.Array] = None,     # (S,) leaf output upper bounds
+    slot_depth: Optional[jax.Array] = None,  # (S,) i32 — for monotone penalty
+    monotone_penalty: float = 0.0,
+    path_smooth: float = 0.0,
+    parent_out: Optional[jax.Array] = None,  # (S,) parent (smoothed) outputs
+    extra_key: Optional[jax.Array] = None,   # PRNG key — extra_trees random thresholds
 ) -> SplitResult:
+    """Monotone constraints use the reference's "basic" method
+    (monotone_constraints.hpp BasicLeafConstraints): candidate outputs are clipped
+    to the leaf's inherited [out_lo, out_hi] bounds, order-violating splits are
+    rejected, and gains are evaluated at the constrained outputs. Path smoothing
+    and monotonicity apply to numerical features only (matching the reference's
+    restriction of monotone constraints to numerical features)."""
     S, G, Bmax, _ = hist.shape
     F = layout.gather_idx.shape[0]
     hf = gather_feature_histograms(hist, layout, parent_g, parent_h, parent_c)
@@ -113,6 +165,11 @@ def find_best_splits(
     pg = parent_g[:, None, None]
     ph = parent_h[:, None, None]
     pc = parent_c[:, None, None]
+    use_output_gain = (monotone is not None) or (path_smooth > 0.0)
+    mono_b = monotone[None, :, None] if monotone is not None else None
+    lo_b = out_lo[:, None, None] if out_lo is not None else -jnp.inf
+    hi_b = out_hi[:, None, None] if out_hi is not None else jnp.inf
+    po_b = parent_out[:, None, None] if parent_out is not None else None
 
     # ---------------- numerical scan ----------------
     cg = jnp.cumsum(hg, axis=-1)
@@ -129,8 +186,18 @@ def find_best_splits(
 
     def split_gain(lg, lh, lc):
         rg, rh, rc = pg - lg, ph - lh, pc - lc
-        gain = leaf_term(lg, lh, lambda_l1, lambda_l2) + \
-               leaf_term(rg, rh, lambda_l1, lambda_l2)
+        if use_output_gain:
+            ol, orr = constrained_child_outputs(
+                lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
+                path_smooth, po_b)
+            gain = leaf_gain_given_output(lg, lh, lambda_l1, lambda_l2, ol) + \
+                   leaf_gain_given_output(rg, rh, lambda_l1, lambda_l2, orr)
+            if mono_b is not None:
+                viol = ((mono_b > 0) & (ol > orr)) | ((mono_b < 0) & (ol < orr))
+                gain = jnp.where((mono_b != 0) & viol, NEG_INF, gain)
+        else:
+            gain = leaf_term(lg, lh, lambda_l1, lambda_l2) + \
+                   leaf_term(rg, rh, lambda_l1, lambda_l2)
         ok = ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
               (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
         return jnp.where(ok, gain, NEG_INF)
@@ -151,16 +218,30 @@ def find_best_splits(
     num_gain = jnp.maximum(gain_d0, gain_d1)               # (S, F, Bmax)
     num_default_left = gain_d1 > gain_d0
 
+    # relative (vs parent) gain so per-feature penalties compose before the argmax
+    parent_term_num = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
+    num_rel = num_gain - parent_term_num[:, None, None]
+    num_rel = jnp.where(num_gain <= NEG_INF / 2, NEG_INF, num_rel)
+    if monotone is not None and monotone_penalty > 0.0 and slot_depth is not None:
+        pen = monotone_penalty_factor(slot_depth, monotone_penalty)[:, None, None]
+        num_rel = jnp.where((mono_b != 0) & (num_rel > 0), num_rel * pen, num_rel)
+    if extra_key is not None:
+        # extra_trees: evaluate ONE random threshold per (slot, feature)
+        # (reference: feature_histogram.hpp rand_threshold under extra_trees)
+        rand_t = jax.random.randint(
+            extra_key, (S, F), 0, 1 << 30) % jnp.maximum(nbins[None, :] - 1, 1)
+        num_rel = jnp.where(bin_iota == rand_t[..., None], num_rel, NEG_INF)
+
     if not enable_categorical:
         # numeric-only fast path: much smaller compiled program (no per-bin argsort)
-        best_t = jnp.argmax(num_gain, axis=-1)
-        best_gain_f = jnp.take_along_axis(num_gain, best_t[..., None], -1)[..., 0]
+        best_t = jnp.argmax(num_rel, axis=-1)
+        best_gain_f = jnp.take_along_axis(num_rel, best_t[..., None], -1)[..., 0]
         if col_mask is not None:
             cm = jnp.broadcast_to(jnp.asarray(col_mask, bool), best_gain_f.shape)
             best_gain_f = jnp.where(cm, best_gain_f, NEG_INF)
         best_f = jnp.argmax(best_gain_f, axis=-1)
         ar = jnp.arange(S)
-        best_gain = best_gain_f[ar, best_f]
+        rel_gain = best_gain_f[ar, best_f]
         t = best_t[ar, best_f]
         dflt_l = num_default_left[ar, best_f, t]
 
@@ -170,10 +251,7 @@ def find_best_splits(
         lg = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
         lh = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
         lc = pick(cc) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_c, cc.shape)), 0.0)
-        parent_term = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
-        rel_gain = best_gain - parent_term
-        splittable = best_gain > (parent_term + min_gain_to_split)
-        rel_gain = jnp.where(splittable, rel_gain, NEG_INF)
+        rel_gain = jnp.where(rel_gain > min_gain_to_split, rel_gain, NEG_INF)
         dir_flags = jnp.where(dflt_l, DIR_DEFAULT_LEFT, 0)
         return SplitResult(
             gain=rel_gain.astype(jnp.float32), feature=best_f.astype(jnp.int32),
@@ -229,8 +307,14 @@ def find_best_splits(
     cat_use_oh = use_onehot | (oh_gain >= sorted_gain)
     cat_gain = jnp.where(is_cat, cat_gain, NEG_INF)
 
+    # categorical rel gain uses the cat-regularised parent term (reference:
+    # feature_histogram.hpp computes the gain shift with l2 + cat_l2)
+    parent_term_cat = leaf_term(parent_g, parent_h, lambda_l1, cat_l2_total)
+    cat_rel = cat_gain - parent_term_cat[:, None, None]
+    cat_rel = jnp.where(cat_gain <= NEG_INF / 2, NEG_INF, cat_rel)
+
     # ---------------- combine ----------------
-    gain_t = jnp.where(is_cat, cat_gain, num_gain)         # (S, F, Bmax)
+    gain_t = jnp.where(is_cat, cat_rel, num_rel)           # (S, F, Bmax) rel gains
     best_t = jnp.argmax(gain_t, axis=-1)                   # (S, F)
     best_gain_f = jnp.take_along_axis(gain_t, best_t[..., None], -1)[..., 0]
 
@@ -268,10 +352,7 @@ def find_best_splits(
     lc = jnp.where(f_is_cat,
                    jnp.where(f_use_oh, lc_oh, jnp.where(f_rev, lc_rs, lc_fs)), lc_num)
 
-    parent_term = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
-    rel_gain = best_gain - parent_term
-    splittable = best_gain > (parent_term + min_gain_to_split)
-    rel_gain = jnp.where(splittable, rel_gain, NEG_INF)
+    rel_gain = jnp.where(best_gain > min_gain_to_split, best_gain, NEG_INF)
 
     dir_flags = (jnp.where(dflt_l & ~f_is_cat, DIR_DEFAULT_LEFT, 0)
                  | jnp.where(f_is_cat, DIR_CATEGORICAL, 0)

@@ -1,28 +1,36 @@
-"""Fused Pallas TPU histogram kernel — the framework's hot op.
+"""Fused Pallas TPU histogram kernels — the framework's hot op.
 
 Reference analog: src/io/dense_bin.hpp:99-170 (ConstructHistogramInner — per-row
 scatter-add into an L1-resident histogram) and src/treelearner/cuda/
 cuda_histogram_constructor.cu (shared-memory atomic adds). TPUs have neither fast
-scatter nor atomics; the dense alternative (one-hot matmul in XLA) materialises an
-(N, Bmax)-shaped one-hot per feature group, whose HBM traffic dominates.
+scatter nor atomics, so the histogram is expressed as a one-hot contraction on the
+MXU over slot-sorted row blocks (ops/compact.py): each fixed-size block of rows
+belongs to exactly one histogram slot, so the kernel accumulates into a single
+VMEM-resident accumulator per slot and writes it back once per slot.
 
-This kernel removes that traffic with a nibble decomposition: bin = 16*hi + lo, so
+XLA's row gather runs at ~1.6G elements/s on TPU, which makes materialising the
+sorted (N, G) uint8 bin matrix the dominant cost. The kernels therefore take bins
+PACKED 4-per-int32 (G//4 words per row — 4x fewer gathered elements) and unpack
+with shifts on the VPU inside the kernel.
 
-    hist[s, g, 16h+l, c] = sum_t  w[c, t] * 1[hi_g[t] == h] * 1[lo_g[t] == l]
-                         = (A_g B_g^T)[c*HI+h, l]
+Two kernels, chosen by the padded per-group bin count Bmax:
 
-with A_g[c*HI+h, t] = w[c, t]*onehot(hi)[h, t]  (VPU build, (3*HI, T))
-and  B_g[l, t]      = onehot(lo)[l, t]          (VPU build, (LO, T)).
+  * direct (Bmax <= 128): per block ONE wide contraction
+        acc[g*B+b, c] += sum_t 1[bin_g[t] == b] * w[c, t]
+    i.e. (G*B, T) one-hot  @  (T, 8) weights. The one-hot lives only in VMEM; the
+    MXU cost is streaming-bound (G*B*T operand values), ~3*B flops per row-group.
 
-Per row-block only 3*HI + LO ≈ 64 one-hot sublanes are generated (vs Bmax = 256),
-everything stays in VMEM, and the contraction runs on the MXU. Rows are pre-sorted
-by slot (ops/compact.py) so each block accumulates into exactly one histogram slot;
-the block -> slot mapping and the block's row window arrive via scalar prefetch, and
-per-block DMAs slice the sorted arrays directly from HBM at 128-aligned row offsets
-(no padded copy).
+  * nibble (Bmax > 128): bin = 16*hi + lo, so per group
+        hist[16h+l, c] = (A_g B_g^T)[c*HI+h, l]
+    with A_g[c*HI+h, t] = w[c, t]*onehot(hi)[h, t] and B_g[l, t] = onehot(lo)[l, t],
+    keeping one-hot build cost at G*(3*HI + LO) sublanes per block instead of G*Bmax.
 
-Output layout (S, 3*HI, G*LO): keeps the minor dimension wide (G*LO = 448 lanes for
-28 groups) so VMEM<->HBM writebacks of a slot's accumulator stay dense.
+The one-hot operand is exact in bfloat16; the weight operand is split into
+high/low bfloat16 parts (two MXU passes) so the f32 weights accumulate without
+the default bf16 rounding — cheaper than Precision.HIGHEST's 3x3 decomposition.
+
+Both kernels use Pallas grid pipelining (BlockSpec index maps) for the block inputs
+— no manual DMA — and scalar-prefetched (slot, first, last) per-block metadata.
 """
 from __future__ import annotations
 
@@ -34,134 +42,209 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LO = 16  # low-nibble width; HI = ceil(Bmax / LO)
+from ..ops.compact import num_blocks, plan_blocks, plan_single_slot
+
+LO = 16  # nibble kernel low-digit width; HI = ceil(Bmax / LO)
+
+_INTERPRET = False  # flipped by tests to run kernels in interpret mode on CPU
 
 
-def _hist_kernel(scalar_ref, bins_hbm, w_hbm, out_ref, bins_vmem, w_vmem,
-                 acc_ref, sem_b, sem_w, *, T: int, G: int, HI: int):
-    # bins_hbm is (G_pad, Nc) and w_hbm (8, Nc): leading dims padded to the sublane
-    # tile so the per-block DMA slices are aligned; only rows < G / < 3 are used.
+def pack_bins(bins: jax.Array) -> jax.Array:
+    """(N, G) uint8 -> (N, ceil(G/4)) int32, 4 bins per word (little-endian)."""
+    n, g = bins.shape
+    gw = -(-g // 4) * 4
+    if gw != g:
+        bins = jnp.pad(bins, ((0, 0), (0, gw - g)))
+    w = bins.reshape(n, gw // 4, 4).astype(jnp.int32)
+    return (w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24))
+
+
+def _unpack_group(words, g):
+    """Extract group g's bin column from packed words (GW, T) i32 -> (1, T) i32."""
+    word = words[g // 4:g // 4 + 1, :]
+    shift = (g % 4) * 8
+    return jax.lax.shift_right_logical(word, shift) & 0xFF
+
+
+def _wsplit(w):
+    """Split f32 weights into (hi, lo) bf16 parts: w ~= hi + lo exactly enough."""
+    hi = w.astype(jnp.bfloat16)
+    lo = (w - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _direct_kernel(scalar_ref, bins_ref, w_ref, out_ref, oh_ref, acc_ref,
+                   *, T: int, G: int, B: int):
     b = pl.program_id(0)
     slot = scalar_ref[b, 0]
-    start = pl.multiple_of(scalar_ref[b, 1], 128)
-    row_lo = scalar_ref[b, 2]
-    row_hi = scalar_ref[b, 3]
-    first = scalar_ref[b, 4]
-
-    cp_b = pltpu.make_async_copy(bins_hbm.at[:, pl.ds(start, T)], bins_vmem, sem_b)
-    cp_w = pltpu.make_async_copy(w_hbm.at[:, pl.ds(start, T)], w_vmem, sem_w)
+    first = scalar_ref[b, 1]
+    last = scalar_ref[b, 2]
 
     @pl.when(slot >= 0)
     def _():
-        cp_b.start()
-        cp_w.start()
+        @pl.when(first == 1)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(first == 1)
-    def _():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        biota = jax.lax.broadcasted_iota(jnp.int32, (B, T), 0)
+        for g in range(G):  # static unroll
+            bg = _unpack_group(bins_ref[...], g)                 # (1, T)
+            oh_ref[g * B:(g + 1) * B, :] = (biota == bg).astype(jnp.bfloat16)
+        # (G*B, T) @ (8, T)^T -> (G*B, 8); contraction over the lane (T) dim.
+        # Two bf16 passes reconstruct f32-accurate weight sums.
+        w_hi, w_lo = _wsplit(w_ref[...])
+        oh = oh_ref[...]
+        dot = functools.partial(jax.lax.dot_general,
+                                dimension_numbers=(((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        acc_ref[...] += dot(oh, w_hi) + dot(oh, w_lo)
+
+        @pl.when(last == 1)
+        def _():
+            out_ref[0] = acc_ref[...].T                          # (8, G*B)
+
+
+def _nibble_kernel(scalar_ref, bins_ref, w_ref, out_ref, acc_ref,
+                   *, T: int, G: int, HI: int):
+    b = pl.program_id(0)
+    slot = scalar_ref[b, 0]
+    first = scalar_ref[b, 1]
+    last = scalar_ref[b, 2]
 
     @pl.when(slot >= 0)
     def _():
-        cp_b.wait()
-        cp_w.wait()
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-        row_ok = ((lane >= row_lo) & (lane < row_hi)).astype(jnp.float32)  # (1, T)
-        w = w_vmem[0:3, :] * row_ok                               # (3, T)
+        @pl.when(first == 1)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        w_hi, w_lo = _wsplit(w_ref[0:3, :])                      # (3, T) each
         hi_iota = jax.lax.broadcasted_iota(jnp.int32, (HI, T), 0)
         lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, T), 0)
-
-        for g in range(G):                                        # static unroll
-            bg = bins_vmem[g:g + 1, :].astype(jnp.int32)          # (1, T)
+        dot = functools.partial(jax.lax.dot_general,
+                                dimension_numbers=(((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        for g in range(G):  # static unroll
+            bg = _unpack_group(bins_ref[...], g)                 # (1, T)
             hi = bg // LO
             lo = bg - hi * LO
-            oh_hi = (hi_iota == hi).astype(jnp.float32)           # (HI, T)
-            oh_lo = (lo_iota == lo).astype(jnp.float32)           # (LO, T)
+            oh_hi = (hi_iota == hi).astype(jnp.bfloat16)         # (HI, T)
+            oh_lo = (lo_iota == lo).astype(jnp.bfloat16)         # (LO, T)
             # A[c*HI+h, t] = w[c, t] * oh_hi[h, t] (sublane-merging reshape)
-            A = (w[:, None, :] * oh_hi[None, :, :]).reshape(3 * HI, T)
-            bh = jax.lax.dot_general(A, oh_lo, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32,
-                                     precision=jax.lax.Precision.HIGHEST)  # (3HI, LO)
-            acc_ref[:, g * LO:(g + 1) * LO] = bh
+            A = ((w_hi[:, None, :] * oh_hi[None, :, :]).reshape(3 * HI, T),
+                 (w_lo[:, None, :] * oh_hi[None, :, :]).reshape(3 * HI, T))
+            bh = dot(A[0], oh_lo) + dot(A[1], oh_lo)             # (3HI, LO)
+            acc_ref[:, g * LO:(g + 1) * LO] += bh
 
-        out_ref[0] += acc_ref[...]
+        @pl.when(last == 1)
+        def _():
+            out_ref[0] = acc_ref[...]
 
 
-@functools.partial(jax.jit, static_argnames=("num_slots", "max_group_bins",
-                                             "num_groups", "block_rows"))
-def hist_sorted_pallas(bins_sorted_T: jax.Array, w_sorted: jax.Array,
-                       block_scalars: jax.Array, counts: jax.Array,
-                       num_slots: int, max_group_bins: int, num_groups: int,
-                       block_rows: int = 4096) -> jax.Array:
-    """Histograms from slot-sorted rows.
-
-    bins_sorted_T: (G_pad, Nc) uint8 — sorted bin matrix, transposed, leading dim
-      padded to the sublane tile; padded by at least one block beyond the last real
-      row (blocks may over-read).
-    w_sorted: (8, Nc) float32 — sorted (grad, hess, cnt, 0...); zeros on invalid rows.
-    block_scalars: (NB, 5) int32 from ops.compact.plan_compaction.
-    counts: (S,) int32 rows per slot (empty slots produce zero histograms).
-
-    Returns (S, G, Bmax, 3) float32.
-    """
-    G_pad, Nc = bins_sorted_T.shape
-    assert G_pad % 8 == 0 and w_sorted.shape[0] == 8, \
-        "pad leading dims to the sublane tile before calling (see caller)"
-    G = num_groups
-    S = num_slots
-    T = block_rows
-    HI = -(-max_group_bins // LO)
-    NB = block_scalars.shape[0]
+@functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
+                                             "block_rows"))
+def _hist_direct(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
+                 block_rows):
+    GW, n_tot = bins_T.shape
+    S, T, G = num_slots, block_rows, num_groups
+    B = -(-bmax // 8) * 8                                        # sublane-pad bins
+    NB = scalars.shape[0]
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, T=T, G=G, HI=HI),
+        functools.partial(_direct_kernel, T=T, G=G, B=B),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(NB,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.ANY),
-                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec((GW, T), lambda b, sref: (0, b)),
+                pl.BlockSpec((8, T), lambda b, sref: (0, b)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 8, G * B), lambda b, sref: (jnp.maximum(sref[b, 0], 0), 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G * B, T), jnp.bfloat16),
+                pltpu.VMEM((G * B, 8), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, 8, G * B), jnp.float32),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(scalars, bins_T, w_T)
+
+    hist = out.reshape(S, 8, G, B)[:, :3, :, :bmax]              # (S, 3, G, Bmax)
+    hist = jnp.transpose(hist, (0, 2, 3, 1))                     # (S, G, Bmax, 3)
+    return jnp.where(counts[:, None, None, None] > 0, hist, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
+                                             "block_rows"))
+def _hist_nibble(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
+                 block_rows):
+    GW, n_tot = bins_T.shape
+    S, T, G = num_slots, block_rows, num_groups
+    HI = -(-bmax // LO)
+    NB = scalars.shape[0]
+
+    out = pl.pallas_call(
+        functools.partial(_nibble_kernel, T=T, G=G, HI=HI),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(NB,),
+            in_specs=[
+                pl.BlockSpec((GW, T), lambda b, sref: (0, b)),
+                pl.BlockSpec((8, T), lambda b, sref: (0, b)),
             ],
             out_specs=pl.BlockSpec(
                 (1, 3 * HI, G * LO),
-                lambda b, sref: (jnp.maximum(sref[b, 0], 0), 0, 0),
-            ),
+                lambda b, sref: (jnp.maximum(sref[b, 0], 0), 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((G_pad, T), jnp.uint8),
-                pltpu.VMEM((8, T), jnp.float32),
                 pltpu.VMEM((3 * HI, G * LO), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((S, 3 * HI, G * LO), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-    )(block_scalars, bins_sorted_T, w_sorted)
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(scalars, bins_T, w_T)
 
     # (S, 3, HI, G, LO) -> (S, G, HI*LO, 3), trimmed to Bmax; zero empty slots
     hist = out.reshape(S, 3, HI, G, LO).transpose(0, 3, 2, 4, 1)
-    hist = hist.reshape(S, G, HI * LO, 3)[:, :, :max_group_bins, :]
+    hist = hist.reshape(S, G, HI * LO, 3)[:, :, :bmax, :]
     return jnp.where(counts[:, None, None, None] > 0, hist, 0.0)
 
 
 def build_histograms_sorted(bins: jax.Array, slot: jax.Array, grad: jax.Array,
                             hess: jax.Array, cnt: jax.Array, num_slots: int,
-                            max_group_bins: int, block_rows: int = 4096) -> jax.Array:
-    """Drop-in replacement for ops.histogram.build_histograms using the sorted
-    Pallas path: plan compaction, gather rows into sorted order (fast row-major
-    gathers), and run the fused kernel."""
-    from ..ops.compact import plan_compaction
+                            max_group_bins: int, block_rows: int = 1024,
+                            bins_packed: jax.Array = None) -> jax.Array:
+    """Drop-in replacement for ops.histogram.build_histograms using the slot-sorted
+    Pallas path: plan blocks, gather packed block rows (invalid positions hit a
+    zero pad row), and run the fused kernel. Returns (S, G, Bmax, 3) float32.
 
+    bins_packed: optional precomputed pack_bins(bins) (N, ceil(G/4)) i32 — pass it
+    when bins are static across calls (training) to skip re-packing.
+    """
     n, G = bins.shape
-    g_pad = -(-G // 8) * 8
-    plan = plan_compaction(slot, num_slots, block_rows)
-    # sorted row payloads: row gathers along axis 0 are cheap on TPU
-    bins_sorted = jnp.take(bins, plan.perm, axis=0)               # (N, G)
-    w = jnp.stack([grad, hess, cnt], axis=1)                      # (N, 3)
-    w_sorted = jnp.take(w, plan.perm, axis=0)
-    # kernel layout: transpose, pad leading dim to the sublane tile (aligned DMA
-    # slices) and the row dim by one block of over-read slack
-    bins_T = jnp.pad(bins_sorted.T, ((0, g_pad - G), (0, block_rows)))
-    w_T = jnp.pad(w_sorted.T.astype(jnp.float32), ((0, 8 - 3), (0, block_rows)))
-    return hist_sorted_pallas(bins_T, w_T, plan.block_scalars, plan.counts,
-                              num_slots, max_group_bins, G, block_rows)
+    if bins_packed is None:
+        bins_packed = pack_bins(bins)
+    gw = bins_packed.shape[1]
+    gw_pad = -(-gw // 8) * 8                       # int32 sublane tile
+    if num_slots == 1:
+        plan = plan_single_slot(n, block_rows)
+    else:
+        plan = plan_blocks(slot, num_slots, block_rows)
+
+    bp_pad = jnp.concatenate([bins_packed,
+                              jnp.zeros((1, gw), jnp.int32)], axis=0)
+    w = jnp.stack([grad.astype(jnp.float32), hess.astype(jnp.float32),
+                   cnt.astype(jnp.float32)], axis=1)             # (N, 3)
+    w_pad = jnp.concatenate([w, jnp.zeros((1, 3), jnp.float32)], axis=0)
+
+    bb = jnp.take(bp_pad, plan.gather_idx, axis=0)               # (NB*T, GW)
+    wb = jnp.take(w_pad, plan.gather_idx, axis=0)                # (NB*T, 3)
+    bins_T = jnp.pad(bb.T, ((0, gw_pad - gw), (0, 0)))           # (GW_pad, NB*T)
+    w_T = jnp.pad(wb.T, ((0, 8 - 3), (0, 0)))                    # (8, NB*T)
+
+    if max_group_bins <= 128:
+        return _hist_direct(bins_T, w_T, plan.scalars, plan.counts,
+                            num_slots, max_group_bins, G, block_rows)
+    return _hist_nibble(bins_T, w_T, plan.scalars, plan.counts,
+                        num_slots, max_group_bins, G, block_rows)

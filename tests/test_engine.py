@@ -189,3 +189,30 @@ def test_feature_importance():
     assert imp_split.sum() > 0
     # informative features should dominate
     assert imp_gain[0] > imp_gain[5]
+
+
+def test_valid_names_length_mismatch_raises():
+    X, y = make_synthetic_regression()
+    train_set = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(X[:200], label=y[:200], reference=train_set)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({"objective": "regression", "verbosity": -1}, train_set,
+                  num_boost_round=2, valid_sets=[vs, vs], valid_names=["only_one"])
+
+
+def test_trivial_tree_walk_resolves_leaf0():
+    # trivial tree (num_leaves=1, zero-filled children) must resolve every row to
+    # leaf 0, not gather padding at leaf_value[-1]
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import _walk_one_tree
+
+    X, y = make_synthetic_regression(n=300)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    dd = ds.device_data()
+    L = 8
+    Bmax = dd.max_bins
+    zeros = jnp.zeros(L, jnp.int32)
+    fields = (zeros, zeros, zeros, zeros, zeros, jnp.zeros((L, Bmax), bool))
+    leaf = _walk_one_tree(fields, dd.bins, dd.routing, L)
+    assert int(jnp.max(leaf)) == 0 and int(jnp.min(leaf)) == 0

@@ -69,3 +69,23 @@ def test_lambdarank_ranker_sklearn():
     pred = m.predict(X)
     qb = np.concatenate([[0], np.cumsum(sizes)])
     assert _ndcg_at(pred, y, qb) > 0.7
+
+
+def test_bagging_by_query():
+    X, y, sizes = make_synthetic_ranking(nq=60)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
+                     "bagging_by_query": True, "bagging_fraction": 0.5,
+                     "bagging_freq": 1, "num_leaves": 15}, ds,
+                    num_boost_round=8)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    scores = bst.predict(X)
+    assert _ndcg_at(scores, y, qb) > 0.5
+
+
+def test_cv_lambdarank_group_propagation():
+    X, y, sizes = make_synthetic_ranking(nq=60)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    res = lgb.cv({"objective": "lambdarank", "verbosity": -1, "num_leaves": 15},
+                 ds, num_boost_round=5, nfold=3)
+    assert any("ndcg" in k for k in res)
