@@ -408,7 +408,22 @@ class Booster:
         return self._loaded_trees.num_tree_per_iteration
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
-        data.construct()
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be a Dataset instance, "
+                            f"met {type(data).__name__}")
+        if data is not self.train_set:
+            if data.binned is None and data.reference is None:
+                # bin with the training mappers, like passing reference=train
+                data.reference = self.train_set
+            data.construct()
+            # reference behavior: GBDT::AddValidDataset fatals on mismatched
+            # bin mappers (src/boosting/gbdt.cpp CheckAlign)
+            if data.binned.bin_mappers is not \
+                    self.train_set.binned.bin_mappers:
+                raise LightGBMError(
+                    "cannot add validation data, since it has different bin "
+                    "mappers with training data (construct it with "
+                    "reference=train_set)")
         metrics = create_metrics(
             self.config,
             self.engine.objective.name if self.engine.objective else "none")

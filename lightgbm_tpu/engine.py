@@ -33,16 +33,21 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         params.setdefault("objective", "regression")
     first_metric_only = bool(params.get("first_metric_only", False))
 
-    if init_model is not None:
-        log_warning("init_model continued training is limited in this round: "
-                    "starting fresh trees on top of predicted scores")
-        if isinstance(init_model, (str,)):
-            init_model = Booster(model_file=init_model)
-        if train_set.raw_data is not None and train_set.init_score is None:
-            train_set.set_init_score(init_model.predict(train_set.raw_data,
-                                                        raw_score=True))
+    if init_model is not None and isinstance(init_model, str):
+        init_model = Booster(model_file=init_model)
 
     booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        # true continued training: load the trees into the engine and keep
+        # boosting (reference: boosting.cpp:42-90, gbdt.cpp:259-263); trees are
+        # deep-copied so DART rescaling cannot mutate the caller's booster
+        if init_model._engine is not None:
+            trees = copy.deepcopy(list(init_model.engine.models))
+            k = init_model.engine.num_tree_per_iteration
+        else:
+            trees = copy.deepcopy(list(init_model._loaded_trees.trees))
+            k = init_model._loaded_trees.num_tree_per_iteration
+        booster.engine.load_init_model(trees, k)
     if valid_sets:
         if valid_names is not None and len(valid_names) != len(valid_sets):
             raise LightGBMError(
@@ -71,6 +76,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    output_model = str(params.get("output_model", "LightGBM_model.txt"))
+
     evaluation_result_list: List = []
     for i in range(num_boost_round):
         for cb in callbacks_before:
@@ -78,6 +86,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                            begin_iteration=0, end_iteration=num_boost_round,
                            evaluation_result_list=[]))
         finished = booster.update()
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            # periodic checkpoint (reference: gbdt.cpp:259-263 Train snapshots)
+            booster.save_model(f"{output_model}.snapshot_iter_{i + 1}")
 
         evaluation_result_list: List = []
         if valid_sets is not None or feval is not None:

@@ -65,7 +65,14 @@ def plan_blocks(slot: jax.Array, num_slots: int, block_rows: int) -> BlockPlan:
     real = b < total_blocks
     first = real & (local == 0)
     last = real & (local == blocks_per_slot[s_of_b] - 1)
-    scalars = jnp.stack([jnp.where(real, s_of_b, -1),
+    # trailing pad blocks keep the LAST real block's slot (not -1 -> window 0):
+    # the Pallas output pipeline flushes the current VMEM buffer when the output
+    # block index changes or the grid ends, so pad blocks must stay on the last
+    # written window (their gather rows are all the zero pad row; first/last = 0
+    # means they neither reset nor rewrite the accumulator)
+    last_slot = jnp.max(jnp.where(blocks_per_slot > 0,
+                                  jnp.arange(S, dtype=i32), 0))
+    scalars = jnp.stack([jnp.where(real, s_of_b, last_slot),
                          first.astype(i32), last.astype(i32)], axis=1)
 
     # per-block gather indices into the original row order; out-of-run -> pad row n

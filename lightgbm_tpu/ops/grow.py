@@ -92,6 +92,12 @@ class _GrowState(NamedTuple):
     cnt: jax.Array
     depth: jax.Array
     leaf_parent: jax.Array
+    # constraint state (size-1 dummies when the feature is off — static branches)
+    out_lo: jax.Array           # (L,) f32 — monotone lower bound on leaf output
+    out_hi: jax.Array           # (L,) f32 — upper bound
+    leaf_out: jax.Array         # (L,) f32 — constrained/smoothed output of each leaf
+    used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
+    round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
     best_gain: jax.Array
     best_feat: jax.Array
     best_thr: jax.Array
@@ -122,16 +128,33 @@ def feature_local_bin(group_bin: jax.Array, feat: jax.Array,
 
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Array,
               col_mask: jax.Array, layout: FeatureLayout, routing: RoutingLayout,
-              params: GrowParams) -> Tuple[TreeArrays, jax.Array]:
+              params: GrowParams, monotone: Optional[jax.Array] = None,
+              interaction_groups: Optional[jax.Array] = None,
+              key: Optional[jax.Array] = None,
+              packed=None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
-    grad/hess must already include any bagging mask; cnt_w is the mask itself."""
+    grad/hess must already include any bagging mask; cnt_w is the mask itself.
+    monotone: (F,) i32 in {-1,0,1} (reference: monotone_constraints.hpp, basic method).
+    interaction_groups: (C, F) bool — allowed-feature groups (col_sampler.hpp).
+    key: PRNGKey for per-node feature sampling / extra_trees random thresholds.
+    packed: precomputed packed-bin layout (StreamLayout for the stream backend,
+    packed (N, GW) words for the sorted pallas backend) — bins never change, so
+    the engine packs once per training run instead of once per tree."""
     N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
     Bmax = layout.valid_mask.shape[1]
     F = layout.gather_idx.shape[0]
     f32, i32 = jnp.float32, jnp.int32
+
+    use_mono = params.has_monotone and monotone is not None
+    use_inter = params.has_interaction and interaction_groups is not None
+    use_smooth = params.path_smooth > 0.0
+    use_output = use_mono or use_smooth
+    use_bynode = params.bynode_fraction < 1.0 and key is not None
+    use_extra = params.extra_trees and key is not None
+    BIG = jnp.asarray(1e30, f32)
 
     find_splits = functools.partial(
         find_best_splits,
@@ -145,22 +168,81 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         max_cat_to_onehot=params.max_cat_to_onehot,
         min_data_per_group=params.min_data_per_group,
         enable_categorical=params.has_categorical,
+        monotone=monotone if use_mono else None,
+        monotone_penalty=params.monotone_penalty,
+        path_smooth=params.path_smooth,
     )
 
+    def node_col_mask(base_mask, used_feat_rows, rkey, rows):
+        """Per-node feature mask: tree-level sampling & interaction-allowed &
+        bynode sampling (reference: col_sampler.hpp GetByNode)."""
+        m = jnp.broadcast_to(base_mask, (rows, F))
+        if use_inter:
+            # allowed = union of constraint groups that contain the leaf's path set
+            contains = ~jnp.any(used_feat_rows[:, None, :]
+                                & ~interaction_groups[None, :, :], axis=-1)  # (R, C)
+            allowed = jnp.any(contains[:, :, None] & interaction_groups[None], axis=1)
+            m = m & allowed
+        if use_bynode:
+            # sample ceil(fraction * available) from the node's ALLOWED set
+            # (reference: col_sampler.hpp GetByNode samples from valid features)
+            u = jnp.where(m, jax.random.uniform(rkey, (rows, F)), -1.0)
+            avail = jnp.sum(m, axis=1, keepdims=True)
+            kcnt = jnp.maximum(
+                jnp.ceil(params.bynode_fraction * avail), 1.0).astype(jnp.int32)
+            order = jnp.argsort(-u, axis=1)
+            rank = jnp.argsort(order, axis=1)
+            m = m & (rank < kcnt)
+        return m
+
     # ---- root ----
+    use_stream = params.hist_backend == "stream"
     bins_packed = None
-    if params.hist_backend == "pallas":
-        from ..pallas.hist_kernel import pack_bins
-        bins_packed = pack_bins(bins)  # once per tree; bins are static
-    leaf_id = jnp.zeros(N, i32)
-    root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
-                                 backend=params.hist_backend,
-                                 bins_packed=bins_packed)
+    Bpad = -(-Bmax // 8) * 8
+    if use_stream:
+        from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
+                                            route_and_hist)
+        slay = packed if packed is not None else pack_bins_T(bins)
+        n_pad = slay.n_pad
+        w_T = jnp.zeros((8, n_pad), f32)
+        w_T = (w_T.at[0, :N].set(grad).at[1, :N].set(hess)
+                  .at[2, :N].set(cnt_w))
+        zL = jnp.zeros(L, i32)
+        tabs0 = build_route_tables(zL, zL, zL, zL, zL, zL, zL,
+                                   zL.at[0].set(1), routing, L)
+        bits0 = jnp.zeros((Bpad, L), jnp.bfloat16)
+        leaf_id = jnp.zeros(n_pad, i32)
+        _, root_hist = route_and_hist(
+            slay.bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
+            1, Bmax, G, L, has_cat=params.has_categorical)
+    else:
+        if params.hist_backend == "pallas":
+            if packed is not None:
+                bins_packed = packed
+            else:
+                from ..pallas.hist_kernel import pack_bins
+                bins_packed = pack_bins(bins)
+        leaf_id = jnp.zeros(N, i32)
+        root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
+                                     backend=params.hist_backend,
+                                     bins_packed=bins_packed)
     root_g = jnp.sum(grad)
     root_h = jnp.sum(hess)
     root_c = jnp.sum(cnt_w)
-    root_split = find_splits(root_hist, root_g[None], root_h[None], root_c[None],
-                             col_mask=col_mask[None, :])
+    root_out = leaf_output(root_g, root_h, params.lambda_l1, params.lambda_l2,
+                           params.max_delta_step)
+    used0 = jnp.zeros((L if use_inter else 1, F if use_inter else 1), bool)
+    root_mask = node_col_mask(col_mask[None, :],
+                              jnp.zeros((1, F), bool),
+                              jax.random.fold_in(key, 0) if key is not None else None,
+                              rows=1)
+    root_split = find_splits(
+        root_hist, root_g[None], root_h[None], root_c[None], col_mask=root_mask,
+        out_lo=(-BIG[None]) if use_output else None,
+        out_hi=(BIG[None]) if use_output else None,
+        slot_depth=jnp.zeros(1, i32) if use_mono else None,
+        parent_out=root_out[None] if use_output else None,
+        extra_key=jax.random.fold_in(key, 1) if use_extra else None)
 
     hist = jnp.zeros((L, G, Bmax, 3), f32).at[0].set(root_hist[0])
     state = _GrowState(
@@ -177,6 +259,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         cnt=jnp.zeros(L, f32).at[0].set(root_c),
         depth=jnp.zeros(L, i32),
         leaf_parent=jnp.full(L, -1, i32),
+        out_lo=jnp.full(L if use_output else 1, -BIG, f32),
+        out_hi=jnp.full(L if use_output else 1, BIG, f32),
+        leaf_out=(jnp.zeros(L, f32).at[0].set(root_out)
+                  if use_output else jnp.zeros(1, f32)),
+        used_feat=used0,
+        round_idx=jnp.asarray(0, i32),
         best_gain=jnp.full(L, NEG_INF, f32).at[0].set(root_split.gain[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(root_split.feature[0]),
         best_thr=jnp.zeros(L, i32).at[0].set(root_split.threshold[0]),
@@ -193,159 +281,252 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     def cond(st: _GrowState):
         return st.progressed & (st.num_leaves_cur < L)
 
-    def body(st: _GrowState) -> _GrowState:
-        cur = st.num_leaves_cur
-        remaining = L - cur
-        # ---- candidate selection: top-K splittable leaves by cached gain ----
-        depth_ok = (params.max_depth <= 0) | (st.depth < jnp.asarray(
-            params.max_depth if params.max_depth > 0 else 2**30, i32))
-        cand = jnp.where((st.best_gain > 0) & depth_ok, st.best_gain, NEG_INF)
-        order = jnp.argsort(-cand)                    # (L,) desc
-        k_budget = jnp.minimum(remaining, S)
-        ranks = jnp.arange(L)
-        sorted_gain = cand[order]
-        chosen_rank = (ranks < k_budget) & (sorted_gain > 0)
-        k = jnp.sum(chosen_rank.astype(i32))
+    def make_body(S: int):
+        """Round body with a static per-round split budget S. The streaming
+        kernel's MXU cost is linear in S, so early rounds (<= 2^r possible
+        splits) run cheaper specialized bodies (see the unrolled prefix
+        below); the reference's analog is growing leaf-by-leaf until the
+        histogram pool warms up (serial_tree_learner.cpp)."""
+      # noqa: E999 -- body below re-indented under the factory
+        def body(st: _GrowState) -> _GrowState:
+            cur = st.num_leaves_cur
+            remaining = L - cur
+            # ---- candidate selection: top-K splittable leaves by cached gain ----
+            depth_ok = (params.max_depth <= 0) | (st.depth < jnp.asarray(
+                params.max_depth if params.max_depth > 0 else 2**30, i32))
+            cand = jnp.where((st.best_gain > 0) & depth_ok, st.best_gain, NEG_INF)
+            order = jnp.argsort(-cand)                    # (L,) desc
+            k_budget = jnp.minimum(remaining, S)
+            ranks = jnp.arange(L)
+            sorted_gain = cand[order]
+            chosen_rank = (ranks < k_budget) & (sorted_gain > 0)
+            k = jnp.sum(chosen_rank.astype(i32))
 
-        # pair arrays over S slots (i = rank)
-        pair_valid = jnp.arange(S) < k                        # (S,)
-        pair_old = jnp.where(pair_valid, order[:S], 0)        # old leaf id (left child)
-        pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
-        pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
-        drop = jnp.asarray(2**30, i32)
-        node_idx = jnp.where(pair_valid, pair_node, drop)
-        new_idx = jnp.where(pair_valid, pair_new, drop)
-        old_idx = jnp.where(pair_valid, pair_old, drop)
+            # pair arrays over S slots (i = rank)
+            pair_valid = jnp.arange(S) < k                        # (S,)
+            pair_old = jnp.where(pair_valid, order[:S], 0)        # old leaf id (left child)
+            pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
+            pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+            drop = jnp.asarray(2**30, i32)
+            node_idx = jnp.where(pair_valid, pair_node, drop)
+            new_idx = jnp.where(pair_valid, pair_new, drop)
+            old_idx = jnp.where(pair_valid, pair_old, drop)
 
-        feat = st.best_feat[pair_old]
-        thr = st.best_thr[pair_old]
-        dirf = st.best_dir[pair_old]
-        gain = st.best_gain[pair_old]
-        pg, ph, pc = st.sum_g[pair_old], st.sum_h[pair_old], st.cnt[pair_old]
-        lg, lh, lc = (st.best_left_g[pair_old], st.best_left_h[pair_old],
-                      st.best_left_c[pair_old])
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
+            feat = st.best_feat[pair_old]
+            thr = st.best_thr[pair_old]
+            dirf = st.best_dir[pair_old]
+            gain = st.best_gain[pair_old]
+            pg, ph, pc = st.sum_g[pair_old], st.sum_h[pair_old], st.cnt[pair_old]
+            lg, lh, lc = (st.best_left_g[pair_old], st.best_left_h[pair_old],
+                          st.best_left_c[pair_old])
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-        # ---- categorical bitsets for the chosen splits ----
-        parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 3)
-        if params.has_categorical:
-            hf = gather_feature_histograms(parent_hist, layout, pg, ph, pc)
-            hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 3)
-            bitset = categorical_left_bitset(
-                hf_feat, thr, dirf, layout.valid_mask[feat],
-                params.cat_smooth, params.min_data_per_group)  # (S, Bmax)
-        else:
-            bitset = jnp.zeros((S, Bmax), bool)
+            # ---- categorical bitsets for the chosen splits ----
+            parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 3)
+            if params.has_categorical:
+                hf = gather_feature_histograms(parent_hist, layout, pg, ph, pc)
+                hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 3)
+                bitset = categorical_left_bitset(
+                    hf_feat, thr, dirf, layout.valid_mask[feat],
+                    params.cat_smooth, params.min_data_per_group)  # (S, Bmax)
+            else:
+                bitset = jnp.zeros((S, Bmax), bool)
 
-        # ---- node array updates ----
-        out = leaf_output(pg, ph, params.lambda_l1, params.lambda_l2,
-                          params.max_delta_step)
-        st2 = st._replace(
-            split_feature=st.split_feature.at[node_idx].set(feat, mode="drop"),
-            threshold_bin=st.threshold_bin.at[node_idx].set(thr, mode="drop"),
-            dir_flags=st.dir_flags.at[node_idx].set(dirf, mode="drop"),
-            split_gain=st.split_gain.at[node_idx].set(gain, mode="drop"),
-            internal_value=st.internal_value.at[node_idx].set(out, mode="drop"),
-            internal_weight=st.internal_weight.at[node_idx].set(ph, mode="drop"),
-            internal_count=st.internal_count.at[node_idx].set(pc, mode="drop"),
-            cat_bitset=st.cat_bitset.at[node_idx].set(bitset, mode="drop"),
-            left_child=st.left_child.at[node_idx].set(~pair_old, mode="drop"),
-            right_child=st.right_child.at[node_idx].set(~pair_new, mode="drop"),
-        )
-        # link parents: the split leaf was some node's (left|right) leaf child
-        parent_of_old = st.leaf_parent[pair_old]
-        was_left = (st2.left_child[jnp.where(parent_of_old >= 0, parent_of_old, 0)]
-                    == ~pair_old) & (parent_of_old >= 0)
-        lp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & was_left,
-                           parent_of_old, drop)
-        rp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & ~was_left,
-                           parent_of_old, drop)
-        st2 = st2._replace(
-            left_child=st2.left_child.at[lp_idx].set(pair_node, mode="drop"),
-            right_child=st2.right_child.at[rp_idx].set(pair_node, mode="drop"),
-            leaf_parent=(st2.leaf_parent
-                         .at[old_idx].set(pair_node, mode="drop")
-                         .at[new_idx].set(pair_node, mode="drop")),
-        )
+            # ---- node array updates ----
+            out = leaf_output(pg, ph, params.lambda_l1, params.lambda_l2,
+                              params.max_delta_step)
+            st2 = st._replace(
+                split_feature=st.split_feature.at[node_idx].set(feat, mode="drop"),
+                threshold_bin=st.threshold_bin.at[node_idx].set(thr, mode="drop"),
+                dir_flags=st.dir_flags.at[node_idx].set(dirf, mode="drop"),
+                split_gain=st.split_gain.at[node_idx].set(gain, mode="drop"),
+                internal_value=st.internal_value.at[node_idx].set(out, mode="drop"),
+                internal_weight=st.internal_weight.at[node_idx].set(ph, mode="drop"),
+                internal_count=st.internal_count.at[node_idx].set(pc, mode="drop"),
+                cat_bitset=st.cat_bitset.at[node_idx].set(bitset, mode="drop"),
+                left_child=st.left_child.at[node_idx].set(~pair_old, mode="drop"),
+                right_child=st.right_child.at[node_idx].set(~pair_new, mode="drop"),
+            )
+            # link parents: the split leaf was some node's (left|right) leaf child
+            parent_of_old = st.leaf_parent[pair_old]
+            was_left = (st2.left_child[jnp.where(parent_of_old >= 0, parent_of_old, 0)]
+                        == ~pair_old) & (parent_of_old >= 0)
+            lp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & was_left,
+                               parent_of_old, drop)
+            rp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & ~was_left,
+                               parent_of_old, drop)
+            st2 = st2._replace(
+                left_child=st2.left_child.at[lp_idx].set(pair_node, mode="drop"),
+                right_child=st2.right_child.at[rp_idx].set(pair_node, mode="drop"),
+                leaf_parent=(st2.leaf_parent
+                             .at[old_idx].set(pair_node, mode="drop")
+                             .at[new_idx].set(pair_node, mode="drop")),
+            )
 
-        # ---- route rows of chosen leaves ----
-        leaf_chosen = jnp.zeros(L, bool).at[old_idx].set(pair_valid, mode="drop")
-        leaf_new_id = jnp.zeros(L, i32).at[old_idx].set(pair_new, mode="drop")
-        leaf_feat = jnp.zeros(L, i32).at[old_idx].set(feat, mode="drop")
-        leaf_thr = jnp.zeros(L, i32).at[old_idx].set(thr, mode="drop")
-        leaf_dir = jnp.zeros(L, i32).at[old_idx].set(dirf, mode="drop")
-        leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset, mode="drop")
+            # ---- route rows of chosen leaves ----
+            leaf_chosen = jnp.zeros(L, bool).at[old_idx].set(pair_valid, mode="drop")
+            leaf_new_id = jnp.zeros(L, i32).at[old_idx].set(pair_new, mode="drop")
+            leaf_feat = jnp.zeros(L, i32).at[old_idx].set(feat, mode="drop")
+            leaf_thr = jnp.zeros(L, i32).at[old_idx].set(thr, mode="drop")
+            leaf_dir = jnp.zeros(L, i32).at[old_idx].set(dirf, mode="drop")
+            smaller_is_left = lc <= rc
 
-        r_chosen = leaf_chosen[st.leaf_id]
-        r_feat = leaf_feat[st.leaf_id]
-        r_grp = routing.feat_group[r_feat]
-        gb = jnp.take_along_axis(bins, r_grp[:, None].astype(jnp.int32),
-                                 axis=1)[:, 0]
-        fb = feature_local_bin(gb, r_feat, routing)
-        r_thr = leaf_thr[st.leaf_id]
-        r_dir = leaf_dir[st.leaf_id]
-        is_cat = (r_dir & 2) != 0
-        default_left = (r_dir & 1) != 0
-        is_nan = (routing.nan_bin[r_feat] >= 0) & (fb == routing.nan_bin[r_feat])
-        go_left_num = jnp.where(is_nan, default_left, fb <= r_thr)
-        # flat gather of one bit per row avoids materialising (N, Bmax)
-        go_left_cat = leaf_bits.reshape(-1)[st.leaf_id * Bmax + fb]
-        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
-        new_leaf_id = jnp.where(r_chosen & ~go_left,
-                                leaf_new_id[st.leaf_id], st.leaf_id)
+            if use_stream:
+                # fused route+hist streaming kernel: one sequential pass over rows
+                si1 = jnp.arange(S, dtype=i32) + 1
+                sl1 = jnp.zeros(L, i32).at[old_idx].set(
+                    jnp.where(smaller_is_left, si1, 0), mode="drop")
+                sr1 = jnp.zeros(L, i32).at[old_idx].set(
+                    jnp.where(smaller_is_left, 0, si1), mode="drop")
+                bits_l = jnp.zeros((L, Bpad), jnp.bfloat16).at[old_idx].set(
+                    jnp.pad(bitset, ((0, 0), (0, Bpad - Bmax))).astype(jnp.bfloat16),
+                    mode="drop")
+                tabs = build_route_tables(
+                    leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
+                    leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
+                new_leaf_row, hist_small = route_and_hist(
+                    slay.bins_T, st.leaf_id.reshape(1, -1), w_T, tabs, bits_l.T,
+                    S, Bmax, G, L, has_cat=params.has_categorical)
+                new_leaf_id = new_leaf_row.reshape(-1)
+            else:
+                leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset,
+                                                                       mode="drop")
+                r_chosen = leaf_chosen[st.leaf_id]
+                r_feat = leaf_feat[st.leaf_id]
+                r_grp = routing.feat_group[r_feat]
+                gb = jnp.take_along_axis(bins, r_grp[:, None].astype(jnp.int32),
+                                         axis=1)[:, 0]
+                fb = feature_local_bin(gb, r_feat, routing)
+                r_thr = leaf_thr[st.leaf_id]
+                r_dir = leaf_dir[st.leaf_id]
+                is_cat = (r_dir & 2) != 0
+                default_left = (r_dir & 1) != 0
+                is_nan = (routing.nan_bin[r_feat] >= 0) & (fb == routing.nan_bin[r_feat])
+                go_left_num = jnp.where(is_nan, default_left, fb <= r_thr)
+                # flat gather of one bit per row avoids materialising (N, Bmax)
+                go_left_cat = leaf_bits.reshape(-1)[st.leaf_id * Bmax + fb]
+                go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+                new_leaf_id = jnp.where(r_chosen & ~go_left,
+                                        leaf_new_id[st.leaf_id], st.leaf_id)
 
-        # ---- per-leaf stats for the children ----
-        st2 = st2._replace(
-            leaf_id=new_leaf_id,
-            sum_g=st2.sum_g.at[old_idx].set(lg, mode="drop")
-                          .at[new_idx].set(rg, mode="drop"),
-            sum_h=st2.sum_h.at[old_idx].set(lh, mode="drop")
-                          .at[new_idx].set(rh, mode="drop"),
-            cnt=st2.cnt.at[old_idx].set(lc, mode="drop")
-                      .at[new_idx].set(rc, mode="drop"),
-            depth=st2.depth.at[new_idx].set(st.depth[pair_old] + 1, mode="drop")
-                          .at[old_idx].set(st.depth[pair_old] + 1, mode="drop"),
-        )
+            # ---- per-leaf stats for the children ----
+            st2 = st2._replace(
+                leaf_id=new_leaf_id,
+                sum_g=st2.sum_g.at[old_idx].set(lg, mode="drop")
+                              .at[new_idx].set(rg, mode="drop"),
+                sum_h=st2.sum_h.at[old_idx].set(lh, mode="drop")
+                              .at[new_idx].set(rh, mode="drop"),
+                cnt=st2.cnt.at[old_idx].set(lc, mode="drop")
+                          .at[new_idx].set(rc, mode="drop"),
+                depth=st2.depth.at[new_idx].set(st.depth[pair_old] + 1, mode="drop")
+                              .at[old_idx].set(st.depth[pair_old] + 1, mode="drop"),
+            )
 
-        # ---- histograms: build smaller child, subtract for larger ----
-        smaller_is_left = lc <= rc
-        smaller_id = jnp.where(smaller_is_left, pair_old, pair_new)
-        larger_id = jnp.where(smaller_is_left, pair_new, pair_old)
-        slot_map = jnp.full(L, -1, i32).at[
-            jnp.where(pair_valid, smaller_id, drop)].set(jnp.arange(S), mode="drop")
-        slot = slot_map[new_leaf_id]
-        hist_small = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
-                                      backend=params.hist_backend,
-                                      bins_packed=bins_packed)
-        hist_large = parent_hist - hist_small
-        sm_idx = jnp.where(pair_valid, smaller_id, drop)
-        lg_idx = jnp.where(pair_valid, larger_id, drop)
-        new_hist = (st2.hist.at[sm_idx].set(hist_small, mode="drop")
-                           .at[lg_idx].set(hist_large, mode="drop"))
-        st2 = st2._replace(hist=new_hist)
+            # ---- constraint propagation (reference: BasicLeafConstraints::Update:
+            # mid = (left_out + right_out)/2; increasing: left.max=mid, right.min=mid) ----
+            if use_output:
+                lo_p = st.out_lo[pair_old]
+                hi_p = st.out_hi[pair_old]
+                po = st.leaf_out[pair_old]
+                ol, orr = constrained_child_outputs(
+                    lg, lh, lc, rg, rh, rc, params.lambda_l1, params.lambda_l2,
+                    lo_p, hi_p, params.path_smooth, po)
+                mid = (ol + orr) / 2.0
+                if use_mono:
+                    mt = monotone[feat]
+                    mt = jnp.where((dirf & 2) != 0, 0, mt)   # cat splits unconstrained
+                else:
+                    mt = jnp.zeros(S, i32)
+                l_hi = jnp.where(mt > 0, jnp.minimum(hi_p, mid), hi_p)
+                l_lo = jnp.where(mt < 0, jnp.maximum(lo_p, mid), lo_p)
+                r_lo = jnp.where(mt > 0, jnp.maximum(lo_p, mid), lo_p)
+                r_hi = jnp.where(mt < 0, jnp.minimum(hi_p, mid), hi_p)
+                st2 = st2._replace(
+                    out_lo=st2.out_lo.at[old_idx].set(l_lo, mode="drop")
+                                     .at[new_idx].set(r_lo, mode="drop"),
+                    out_hi=st2.out_hi.at[old_idx].set(l_hi, mode="drop")
+                                     .at[new_idx].set(r_hi, mode="drop"),
+                    leaf_out=st2.leaf_out.at[old_idx].set(ol, mode="drop")
+                                         .at[new_idx].set(orr, mode="drop"))
+            if use_inter:
+                fe_oh = jax.nn.one_hot(feat, F, dtype=jnp.int32).astype(bool)
+                new_used = st.used_feat[pair_old] | fe_oh       # (S, F)
+                st2 = st2._replace(
+                    used_feat=st2.used_feat.at[old_idx].set(new_used, mode="drop")
+                                           .at[new_idx].set(new_used, mode="drop"))
 
-        # ---- best splits for the 2S children ----
-        ids2 = jnp.concatenate([pair_old, pair_new])
-        valid2 = jnp.concatenate([pair_valid, pair_valid])
-        hist2 = new_hist[ids2]
-        res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2], st2.cnt[ids2],
-                          col_mask=st.col_mask[None, :])
-        ids2_m = jnp.where(valid2, ids2, drop)
-        st2 = st2._replace(
-            best_gain=st2.best_gain.at[ids2_m].set(res.gain, mode="drop"),
-            best_feat=st2.best_feat.at[ids2_m].set(res.feature, mode="drop"),
-            best_thr=st2.best_thr.at[ids2_m].set(res.threshold, mode="drop"),
-            best_dir=st2.best_dir.at[ids2_m].set(res.dir_flags, mode="drop"),
-            best_left_g=st2.best_left_g.at[ids2_m].set(res.left_sum_g, mode="drop"),
-            best_left_h=st2.best_left_h.at[ids2_m].set(res.left_sum_h, mode="drop"),
-            best_left_c=st2.best_left_c.at[ids2_m].set(res.left_count, mode="drop"),
-        )
-        return st2._replace(num_leaves_cur=cur + k, progressed=k > 0)
+            # ---- histograms: build smaller child, subtract for larger ----
+            smaller_id = jnp.where(smaller_is_left, pair_old, pair_new)
+            larger_id = jnp.where(smaller_is_left, pair_new, pair_old)
+            if not use_stream:   # stream path built hist_small in the fused kernel
+                slot_map = jnp.full(L, -1, i32).at[
+                    jnp.where(pair_valid, smaller_id, drop)].set(jnp.arange(S),
+                                                                 mode="drop")
+                slot = slot_map[new_leaf_id]
+                hist_small = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
+                                              backend=params.hist_backend,
+                                              bins_packed=bins_packed)
+            hist_large = parent_hist - hist_small
+            sm_idx = jnp.where(pair_valid, smaller_id, drop)
+            lg_idx = jnp.where(pair_valid, larger_id, drop)
+            new_hist = (st2.hist.at[sm_idx].set(hist_small, mode="drop")
+                               .at[lg_idx].set(hist_large, mode="drop"))
+            st2 = st2._replace(hist=new_hist)
 
-    final = jax.lax.while_loop(cond, body, state)
+            # ---- best splits for the 2S children ----
+            ids2 = jnp.concatenate([pair_old, pair_new])
+            valid2 = jnp.concatenate([pair_valid, pair_valid])
+            hist2 = new_hist[ids2]
+            rkey = (jax.random.fold_in(key, 2 + st.round_idx)
+                    if key is not None else None)
+            cmask2 = node_col_mask(st.col_mask[None, :],
+                                   st2.used_feat[ids2] if use_inter
+                                   else jnp.zeros((2 * S, F), bool),
+                                   rkey, rows=2 * S)
+            res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2], st2.cnt[ids2],
+                              col_mask=cmask2,
+                              out_lo=st2.out_lo[ids2] if use_output else None,
+                              out_hi=st2.out_hi[ids2] if use_output else None,
+                              slot_depth=st2.depth[ids2] if use_mono else None,
+                              parent_out=st2.leaf_out[ids2] if use_output else None,
+                              extra_key=(jax.random.fold_in(key, 100000 + st.round_idx)
+                                         if use_extra else None))
+            ids2_m = jnp.where(valid2, ids2, drop)
+            st2 = st2._replace(
+                best_gain=st2.best_gain.at[ids2_m].set(res.gain, mode="drop"),
+                best_feat=st2.best_feat.at[ids2_m].set(res.feature, mode="drop"),
+                best_thr=st2.best_thr.at[ids2_m].set(res.threshold, mode="drop"),
+                best_dir=st2.best_dir.at[ids2_m].set(res.dir_flags, mode="drop"),
+                best_left_g=st2.best_left_g.at[ids2_m].set(res.left_sum_g, mode="drop"),
+                best_left_h=st2.best_left_h.at[ids2_m].set(res.left_sum_h, mode="drop"),
+                best_left_c=st2.best_left_c.at[ids2_m].set(res.left_count, mode="drop"),
+            )
+            return st2._replace(num_leaves_cur=cur + k, progressed=k > 0,
+                                round_idx=st.round_idx + 1)
 
-    leaf_value = leaf_output(final.sum_g, final.sum_h, params.lambda_l1,
-                             params.lambda_l2, params.max_delta_step)
+        return body
+
+    # streaming rounds: round r can split at most 2^r leaves, and the
+    # fused kernel cost is linear in the slot budget S — run the first
+    # log2(S) rounds as specialized small-S bodies, then loop at full S
+    if use_stream and S > 1:
+        s_r = 1
+        while s_r < S:
+            body_r = make_body(s_r)
+            state = jax.lax.cond(cond(state), body_r, lambda s: s, state)
+            s_r *= 2
+    final = jax.lax.while_loop(cond, make_body(S), state)
+
+    if use_output:
+        # constrained/smoothed outputs were fixed at split time (reference:
+        # SerialTreeLearner::Split computes them with the leaf's bounds)
+        leaf_value = final.leaf_out
+        if params.max_delta_step > 0.0:
+            leaf_value = jnp.clip(leaf_value, -params.max_delta_step,
+                                  params.max_delta_step)
+    else:
+        leaf_value = leaf_output(final.sum_g, final.sum_h, params.lambda_l1,
+                                 params.lambda_l2, params.max_delta_step)
     # single-leaf tree edge case: value 0 (no boost)
     leaf_value = jnp.where(final.num_leaves_cur > 1, leaf_value, 0.0)
     tree = TreeArrays(
@@ -358,4 +539,4 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_parent=final.leaf_parent, num_leaves=final.num_leaves_cur,
         leaf_depth=final.depth,
     )
-    return tree, final.leaf_id
+    return tree, final.leaf_id[:N]

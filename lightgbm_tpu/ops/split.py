@@ -105,16 +105,44 @@ def constrained_child_outputs(lg, lh, lc, rg, rh, rc, l1, l2, lo, hi,
     return ol, orr
 
 
+def _layout_is_identity(layout: FeatureLayout, num_groups: int,
+                        bmax: int) -> bool:
+    """True when features map 1:1 onto groups with no EFB bundling, so the
+    per-feature gather is the identity (trace-time check on the concrete
+    layout constants; False if the layout is traced)."""
+    try:
+        idx = np.asarray(layout.gather_idx)
+    except Exception:
+        return False
+    F = idx.shape[0]
+    if F != num_groups or idx.shape[1] != bmax:
+        return False
+    expect = np.arange(F)[:, None] * bmax + np.arange(bmax)[None, :]
+    return bool(np.array_equal(idx, expect))
+
+
 def gather_feature_histograms(hist: jax.Array, layout: FeatureLayout,
                               parent_g: jax.Array, parent_h: jax.Array,
                               parent_c: jax.Array) -> jax.Array:
     """(S, G, Bmax, 3) group-padded hist -> (S, F, Bmax, 3) per-feature hist.
 
-    Fills EFB-bundle shared-default bins by residual: default = parent_total - others."""
-    s_dim = hist.shape[0]
-    flat = hist.reshape(s_dim, -1, 3)                     # (S, G*Bmax, 3)
-    hf = flat[:, layout.gather_idx, :]                    # (S, F, Bmax, 3)
-    hf = hf * layout.valid_mask[None, :, :, None]
+    Fills EFB-bundle shared-default bins by residual: default = parent_total -
+    others.  When the layout is the identity (no bundling — the common dense
+    case) the latency-bound (S*F*Bmax)-row gather is skipped entirely: on TPU
+    that gather costs ~10 ms per round and would dominate split finding."""
+    s_dim, num_groups, bmax, _ = hist.shape
+    if _layout_is_identity(layout, num_groups, bmax):
+        hf = hist * layout.valid_mask[None, :, :, None]
+    else:
+        flat = hist.reshape(s_dim, -1, 3)                 # (S, G*Bmax, 3)
+        hf = flat[:, layout.gather_idx, :]                # (S, F, Bmax, 3)
+        hf = hf * layout.valid_mask[None, :, :, None]
+    try:
+        any_resid = bool((np.asarray(layout.residual_pos) >= 0).any())
+    except Exception:
+        any_resid = True
+    if not any_resid:
+        return hf
     has_resid = layout.residual_pos >= 0                  # (F,)
     resid_oh = jax.nn.one_hot(jnp.maximum(layout.residual_pos, 0),
                               hf.shape[2], dtype=hf.dtype)          # (F, Bmax)

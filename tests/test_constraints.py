@@ -1,0 +1,137 @@
+"""Monotone / interaction constraints, bynode sampling, path smoothing, extra_trees.
+
+Mirrors the reference's tests/python_package_test/test_engine.py monotone- and
+interaction-constraint tests (is_increasing/is_non_monotone checks;
+src/treelearner/monotone_constraints.hpp basic method)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _monotone_data(n=2000, seed=3):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 3)
+    y = (5 * x[:, 0] + np.sin(10 * np.pi * x[:, 0])
+         - 5 * x[:, 1] - np.cos(10 * np.pi * x[:, 1])
+         + rs.rand(n) + 10 * x[:, 2])
+    return x, y
+
+
+def _is_increasing(bst, feat, n=200):
+    xs = np.linspace(0.01, 0.99, n)
+    X = np.full((n, 3), 0.5)
+    X[:, feat] = xs
+    p = bst.predict(X)
+    return np.all(np.diff(p) >= -1e-9)
+
+
+def _is_decreasing(bst, feat, n=200):
+    xs = np.linspace(0.01, 0.99, n)
+    X = np.full((n, 3), 0.5)
+    X[:, feat] = xs
+    p = bst.predict(X)
+    return np.all(np.diff(p) <= 1e-9)
+
+
+def test_monotone_constraints_basic():
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 5}
+    bst = lgb.train(params, ds, num_boost_round=30)
+    assert _is_increasing(bst, 0)
+    assert _is_decreasing(bst, 1)
+    # feature 2 is unconstrained and drives y: model must still fit reasonably
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_monotone_unconstrained_violates():
+    # sanity: without constraints the wiggly components break monotonicity
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=30)
+    assert not (_is_increasing(bst, 0) and _is_decreasing(bst, 1))
+
+
+def test_monotone_penalty_and_methods():
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "monotone_penalty": 2.0,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, ds, num_boost_round=20)
+    assert _is_increasing(bst, 0)
+    assert _is_decreasing(bst, 1)
+
+
+def test_interaction_constraints():
+    rs = np.random.RandomState(5)
+    n, f = 3000, 6
+    X = rs.rand(n, f)
+    y = X[:, 0] * X[:, 1] + X[:, 2] + 0.1 * rs.randn(n)
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "interaction_constraints": [[0, 1], [2, 3, 4, 5]],
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, ds, num_boost_round=20)
+    # every tree's feature set must lie inside one constraint group
+    dump = bst.dump_model()
+    groups = [{0, 1}, {2, 3, 4, 5}]
+
+    def path_feats(node, path, out):
+        if "split_feature" in node:
+            p2 = path | {node["split_feature"]}
+            path_feats(node["left_child"], p2, out)
+            path_feats(node["right_child"], p2, out)
+        else:
+            if path:
+                out.append(path)
+
+    for tinfo in dump["tree_info"]:
+        paths = []
+        path_feats(tinfo["tree_structure"], set(), paths)
+        for p in paths:
+            assert any(p <= g for g in groups), f"path {p} violates constraints"
+
+
+def test_feature_fraction_bynode():
+    rs = np.random.RandomState(6)
+    X = rs.rand(1500, 10)
+    y = X @ rs.rand(10) + 0.05 * rs.randn(1500)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "feature_fraction_bynode": 0.5, "verbosity": -1},
+                    ds, num_boost_round=10)
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_path_smooth_and_extra_trees():
+    rs = np.random.RandomState(7)
+    X = rs.rand(1500, 5)
+    y = X @ rs.rand(5) + 0.05 * rs.randn(1500)
+    ds = lgb.Dataset(X, label=y)
+    b1 = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "path_smooth": 10.0, "verbosity": -1}, ds,
+                   num_boost_round=10)
+    b2 = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "extra_trees": True, "verbosity": -1}, ds,
+                   num_boost_round=10)
+    for b in (b1, b2):
+        assert np.corrcoef(b.predict(X), y)[0, 1] > 0.7
+
+
+def test_unimplemented_params_raise():
+    X = np.random.rand(100, 3)
+    y = np.random.rand(100)
+    for bad in ({"linear_tree": True}, {"use_quantized_grad": True},
+                {"forcedsplits_filename": "f.json"},
+                {"cegb_penalty_split": 1.0}):
+        ds = lgb.Dataset(X, label=y)
+        params = {"objective": "regression", "verbosity": -1, **bad}
+        with pytest.raises(lgb.LightGBMError):
+            lgb.train(params, ds, num_boost_round=2)

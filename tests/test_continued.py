@@ -1,0 +1,90 @@
+"""Continued training (init_model) + periodic snapshots.
+
+Reference: src/boosting/boosting.cpp:42-90 (model continuation),
+src/boosting/gbdt.cpp:259-263 (snapshot_freq), engine.py init_model."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=1500, seed=4):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 8)
+    y = X @ rs.rand(8) + 0.1 * rs.randn(n)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5, "learning_rate": 0.1}
+
+
+def test_continued_training_matches_straight_run(tmp_path):
+    X, y = _data()
+    bst20 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20)
+
+    bst10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "m10.txt")
+    bst10.save_model(path)
+
+    # continue from file
+    bst_cont = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                         init_model=path)
+    assert bst_cont.num_trees() == 20
+    p20 = bst20.predict(X)
+    pc = bst_cont.predict(X)
+    # growth is deterministic given the same scores; thresholds requantize
+    # through the text model round-trip, so allow tiny drift
+    np.testing.assert_allclose(pc, p20, rtol=1e-4, atol=1e-4)
+
+    # continue from an in-memory Booster too
+    bst_cont2 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                          init_model=bst10)
+    np.testing.assert_allclose(bst_cont2.predict(X), p20, rtol=1e-4, atol=1e-4)
+    # the caller's booster must be untouched by continuation
+    np.testing.assert_allclose(bst10.predict(X),
+                               lgb.Booster(model_file=path).predict(X),
+                               rtol=1e-9)
+
+
+def test_continued_training_with_valid_sets():
+    X, y = _data()
+    Xv, yv = _data(400, seed=9)
+    bst10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    ev = {}
+    train_ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(PARAMS, train_ds, num_boost_round=5,
+                    valid_sets=[lgb.Dataset(Xv, label=yv, reference=train_ds)],
+                    valid_names=["v"], init_model=bst10,
+                    callbacks=[lgb.record_evaluation(ev)])
+    assert bst.num_trees() == 15
+    assert len(ev["v"]["l2"]) == 5
+    # valid metric must reflect the loaded trees (far better than from-scratch)
+    first_l2 = ev["v"]["l2"][0]
+    base_l2 = float(np.mean((yv - np.mean(y)) ** 2))
+    assert first_l2 < base_l2 * 0.8
+
+
+def test_num_leaves_budget_guard(tmp_path):
+    X, y = _data()
+    big = lgb.train({**PARAMS, "num_leaves": 31},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    path = str(tmp_path / "big.txt")
+    big.save_model(path)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({**PARAMS, "num_leaves": 8}, lgb.Dataset(X, label=y),
+                  num_boost_round=2, init_model=path)
+
+
+def test_snapshot_freq(tmp_path):
+    X, y = _data()
+    out = str(tmp_path / "model.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 3, "output_model": out},
+              lgb.Dataset(X, label=y), num_boost_round=7)
+    snaps = sorted(os.listdir(tmp_path))
+    assert f"{os.path.basename(out)}.snapshot_iter_3" in snaps
+    assert f"{os.path.basename(out)}.snapshot_iter_6" in snaps
+    loaded = lgb.Booster(model_file=out + ".snapshot_iter_6")
+    assert loaded.num_trees() == 6
