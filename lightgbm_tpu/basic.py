@@ -521,20 +521,79 @@ class Booster:
         n = X.shape[0]
         # init scores are folded into tree 0 at training time (AddBias), so a plain
         # sum over trees is the complete raw score
-        if k == 1:
-            score = np.zeros(n, np.float64)
-            for t in use:
-                score += t.predict_raw(X)
-        else:
-            score = np.zeros((n, k), np.float64)
-            for i, t in enumerate(use):
-                score[:, i % k] += t.predict_raw(X)
+        score = self._try_device_predict(X, use, k)
+        if score is None:
+            if k == 1:
+                score = np.zeros(n, np.float64)
+                for t in use:
+                    score += t.predict_raw(X)
+            else:
+                score = np.zeros((n, k), np.float64)
+                for i, t in enumerate(use):
+                    score[:, i % k] += t.predict_raw(X)
         if self._average_output() and len(use):
             score = score / max(len(use) // max(k, 1), 1)
         if raw_score:
             return score
         conv = self._convert_output_fn()
         return np.asarray(conv(score))
+
+    _DEVICE_PREDICT_MIN_ROWS = 20_000
+
+    def _try_device_predict(self, X, use, k):
+        """Batched on-device prediction (pallas/predict_kernel.py): bin the
+        raw matrix with the training mappers and walk all trees on-chip.
+        Returns None when the fast path does not apply (small batch, no
+        engine, categorical splits, CPU backend) — reference analog:
+        predictor.hpp picks per-row vs batch paths."""
+        import jax
+        if (self._engine is None or not use
+                or X.shape[0] < self._DEVICE_PREDICT_MIN_ROWS):
+            return None
+        if jax.default_backend() not in ("tpu", "axon"):
+            from .pallas import predict_kernel
+            if not predict_kernel._INTERPRET:
+                return None
+        L = max(max(t.num_leaves for t in use), 2)
+        if L > 2048:
+            return None
+        # the whole per-class table must stay VMEM-resident (~16 MB/core)
+        from .pallas.predict_kernel import ROWS_PER_TREE
+        per_class = -(-len(use) // max(k, 1))
+        if per_class * ROWS_PER_TREE * L * 4 > 10 * 2 ** 20:
+            return None
+        for t in use:
+            ni = max(t.num_leaves - 1, 0)
+            if ni and (np.asarray(t.decision_type[:ni]) & 1).any():
+                return None    # categorical splits: host path
+        from .binning import construct_binned
+        from .pallas.predict_kernel import (build_predict_tables,
+                                            predict_stream, tree_max_depth)
+        from .pallas.stream_kernel import pack_bins_T
+        import jax.numpy as jnp
+        eng = self.engine
+        tb = eng.train_data.binned
+        binned = construct_binned(np.asarray(X, np.float64), tb.bin_mappers,
+                                  tb.group_features)
+        slay = pack_bins_T(jnp.asarray(binned.bins))
+        r = eng.dd.routing
+        routing_np = {name: np.asarray(getattr(r, name))
+                      for name in ("feat_group", "span_start", "default_bin",
+                                   "bundled", "nan_bin", "num_bins")}
+        maxd = max(max(tree_max_depth(t) for t in use), 1)
+        n = X.shape[0]
+        outs = []
+        for c in range(k):
+            trees_c = [t for i, t in enumerate(use) if i % k == c]
+            tabs = build_predict_tables(trees_c, routing_np, L,
+                                        bin_mappers=tb.bin_mappers)
+            s = predict_stream(slay.bins_T, jnp.asarray(tabs), L,
+                               len(trees_c), maxd)
+            outs.append(s)
+        host = jax.device_get(outs)
+        if k == 1:
+            return np.asarray(host[0][:n], np.float64)
+        return np.stack([h[:n] for h in host], axis=1).astype(np.float64)
 
     def _average_output(self) -> bool:
         if self._engine is not None:

@@ -171,7 +171,8 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "quant_train_renew_leaf": [],
     "stochastic_rounding": [],
     # --- TPU-specific knobs (new in this framework) ---
-    "hist_backend": [],          # auto | segsum | onehot | pallas
+    "hist_backend": [],          # auto | segsum | onehot | pallas | stream
+    "hist_precision": [],        # auto | mixed (two-pass bf16, ~f32) | single
     "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
@@ -407,6 +408,9 @@ class Config:
 
     # --- TPU-native knobs ---
     hist_backend: str = "auto"
+    hist_precision: str = "auto"   # auto = single on the TPU stream
+                                   # backend (reference GPU default,
+                                   # gpu_use_dp=false); mixed = ~f32
     max_splits_per_round: int = 64
     mesh_shape: str = ""
     tpu_dtype: str = "f32"

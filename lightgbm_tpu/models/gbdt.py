@@ -68,7 +68,17 @@ class GBDT:
             bins = dd.bins
             if n_pad != bins.shape[0]:
                 bins = jnp.pad(bins, ((0, n_pad - bins.shape[0]), (0, 0)))
-            bins = jax.device_put(bins, bins_sharding(self.mesh, config.tree_learner))
+            sh = bins_sharding(self.mesh, config.tree_learner)
+            # feature sharding needs the group axis divisible by the mesh
+            # axis; padded groups hold bin 0 for every row and are never
+            # gathered by any feature (layout.gather_idx ignores them)
+            if len(sh.spec) > 1 and sh.spec[1] is not None:
+                ax = int(self.mesh.shape[sh.spec[1]])
+                g = bins.shape[1]
+                g_pad = -(-g // ax) * ax
+                if g_pad != g:
+                    bins = jnp.pad(bins, ((0, 0), (0, g_pad - g)))
+            bins = jax.device_put(bins, sh)
             dd = dd._replace(bins=bins)
             if config.tree_learner != "feature":
                 # rows are the sharded axis: keep every per-row array (score, grad,
@@ -106,17 +116,22 @@ class GBDT:
         self._grow_params = self._make_grow_params()
         packed = None
         if self._grow_params.hist_backend == "stream":
-            from ..pallas.stream_kernel import pack_bins_T
-            packed = pack_bins_T(dd.bins)
+            from ..pallas.stream_kernel import (pack_bins_T,
+                                               stream_block_rows)
+            packed = pack_bins_T(dd.bins,
+                                 stream_block_rows(dd.max_bins)).bins_T
         elif self._grow_params.hist_backend == "pallas":
             from ..pallas.hist_kernel import pack_bins
             packed = pack_bins(dd.bins)
+        # NOTE: `packed` must be a jit ARGUMENT, not a closure capture —
+        # captured arrays are embedded in the HLO as constants, and a 10M-row
+        # packed bin matrix (hundreds of MB) blows up compilation
+        self._packed = packed
         self._grow_fn = jax.jit(
             functools.partial(grow_tree, layout=dd.layout, routing=dd.routing,
                               params=self._grow_params,
                               monotone=self._monotone_array(),
-                              interaction_groups=self._interaction_group_masks(),
-                              packed=packed))
+                              interaction_groups=self._interaction_group_masks()))
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
         self._finished_check_every = (
@@ -213,6 +228,7 @@ class GBDT:
             has_interaction=self._interaction_group_masks() is not None,
             extra_trees=c.extra_trees,
             bynode_fraction=c.feature_fraction_bynode,
+            hist_two_pass=(c.hist_precision == "mixed"),
         )
 
     def _monotone_array(self) -> Optional[jax.Array]:
@@ -265,11 +281,17 @@ class GBDT:
         silently training a different model (reference behavior: config
         validation fatals; VERDICT r1 'silently ignored parameters')."""
         c = self.config
+        if c.hist_precision not in ("auto", "single", "mixed"):
+            raise LightGBMError(
+                f"hist_precision={c.hist_precision!r} is not one of "
+                "'auto', 'single', 'mixed'")
+
+        def _nonempty(v):
+            return v is not None and len(np.atleast_1d(v)) > 0
+
         if c.cegb_tradeoff != 1.0 or c.cegb_penalty_split != 0.0 or \
-                (c.cegb_penalty_feature_lazy and len(np.atleast_1d(
-                    c.cegb_penalty_feature_lazy))) or \
-                (c.cegb_penalty_feature_coupled and len(np.atleast_1d(
-                    c.cegb_penalty_feature_coupled))):
+                _nonempty(c.cegb_penalty_feature_lazy) or \
+                _nonempty(c.cegb_penalty_feature_coupled):
             raise LightGBMError(
                 "cegb_* (cost-effective gradient boosting) is not implemented in "
                 "lightgbm_tpu yet; remove the cegb_ parameters")
@@ -382,7 +404,7 @@ class GBDT:
                     (self.config.extra_seed or 3) * 1000003
                     + self.iter_ * (k + 1) + kk)
             arrays, leaf_id = self._grow_fn(self.dd.bins, g, h, mask, col_mask,
-                                            key=gkey)
+                                            key=gkey, packed=self._packed)
             arrays, leaf_id = self._post_grow(arrays, leaf_id, kk, mask)
             # score update: gather (reference: ScoreUpdater::AddScore);
             # single-leaf trees have leaf_value 0, so no branch is needed

@@ -61,6 +61,7 @@ class GrowParams(NamedTuple):
     has_interaction: bool = False
     extra_trees: bool = False
     bynode_fraction: float = 1.0
+    hist_two_pass: bool = True   # two-pass bf16 hist weights (f32-accurate)
 
 
 class RoutingLayout(NamedTuple):
@@ -201,9 +202,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     Bpad = -(-Bmax // 8) * 8
     if use_stream:
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
-                                            route_and_hist)
-        slay = packed if packed is not None else pack_bins_T(bins)
-        n_pad = slay.n_pad
+                                            route_and_hist,
+                                            stream_block_rows)
+        T_rows = stream_block_rows(Bmax)
+        if packed is None:
+            bins_T = pack_bins_T(bins, T_rows).bins_T
+        else:
+            # bare array (int metadata would turn into tracers as a jit arg)
+            bins_T = packed.bins_T if hasattr(packed, "bins_T") else packed
+        n_pad = bins_T.shape[1]
         w_T = jnp.zeros((8, n_pad), f32)
         w_T = (w_T.at[0, :N].set(grad).at[1, :N].set(hess)
                   .at[2, :N].set(cnt_w))
@@ -213,8 +220,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         bits0 = jnp.zeros((Bpad, L), jnp.bfloat16)
         leaf_id = jnp.zeros(n_pad, i32)
         _, root_hist = route_and_hist(
-            slay.bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
-            1, Bmax, G, L, has_cat=params.has_categorical)
+            bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
+            1, Bmax, G, L, block_rows=T_rows,
+            has_cat=params.has_categorical, two_pass=params.hist_two_pass)
     else:
         if params.hist_backend == "pallas":
             if packed is not None:
@@ -385,8 +393,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
                     leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
                 new_leaf_row, hist_small = route_and_hist(
-                    slay.bins_T, st.leaf_id.reshape(1, -1), w_T, tabs, bits_l.T,
-                    S, Bmax, G, L, has_cat=params.has_categorical)
+                    bins_T, st.leaf_id.reshape(1, -1), w_T, tabs, bits_l.T,
+                    S, Bmax, G, L, block_rows=T_rows,
+                    has_cat=params.has_categorical,
+                    two_pass=params.hist_two_pass)
                 new_leaf_id = new_leaf_row.reshape(-1)
             else:
                 leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset,
@@ -509,12 +519,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     # streaming rounds: round r can split at most 2^r leaves, and the
     # fused kernel cost is linear in the slot budget S — run the first
     # log2(S) rounds as specialized small-S bodies, then loop at full S
-    if use_stream and S > 1:
-        s_r = 1
-        while s_r < S:
-            body_r = make_body(s_r)
-            state = jax.lax.cond(cond(state), body_r, lambda s: s, state)
-            s_r *= 2
+    if use_stream and S > 4:
+        # round r can split at most 2^r leaves; run the first rounds with
+        # small static split budgets (kernel MXU cost is linear in S) while
+        # keeping the number of distinct compiled bodies at 2 (compile time)
+        prefix = [4, 4, 4] + ([16, 16] if S > 16 else [])
+        bodies = {}
+        for s_r in prefix:
+            s_eff = min(s_r, S)
+            if s_eff not in bodies:
+                bodies[s_eff] = make_body(s_eff)
+            state = jax.lax.cond(cond(state), bodies[s_eff],
+                                 lambda s: s, state)
     final = jax.lax.while_loop(cond, make_body(S), state)
 
     if use_output:

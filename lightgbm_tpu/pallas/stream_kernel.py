@@ -64,7 +64,7 @@ def _digits(v):
 
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, hist_ref, *, T, G, B, S, L, GW,
-                       has_cat: bool):
+                       has_cat: bool, two_pass: bool = True):
     b = pl.program_id(0)
     i32, bf16, f32 = jnp.int32, jnp.bfloat16, jnp.float32
 
@@ -137,16 +137,32 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     w3 = w_ref[0:3, :]                                       # (3, T) f32
     w_hi, w_lo = _wsplit(w3)
     A_hi = (w_hi[:, None, :] * slot_oh[None, :, :]).reshape(3 * S, T)
-    A_lo = (w_lo[:, None, :] * slot_oh[None, :, :]).reshape(3 * S, T)
     b_iota = jax.lax.broadcasted_iota(i32, (B, T), 0)
     dot = functools.partial(jax.lax.dot_general,
                             dimension_numbers=(((1,), (1,)), ((), ())),
                             preferred_element_type=f32)
-    for g in range(G):  # static unroll
-        word_g = bins_ref[g // 4:g // 4 + 1, :]
-        bg = jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF
-        oh = (b_iota == bg).astype(bf16)                     # (B, T)
-        hist_ref[g * B:(g + 1) * B, :] += dot(oh, A_hi) + dot(oh, A_lo)
+    if two_pass:
+        A_lo = (w_lo[:, None, :] * slot_oh[None, :, :]).reshape(3 * S, T)
+        for g in range(G):  # static unroll
+            word_g = bins_ref[g // 4:g // 4 + 1, :]
+            bg = jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF
+            oh = (b_iota == bg).astype(bf16)                 # (B, T)
+            hist_ref[g * B:(g + 1) * B, :] += dot(oh, A_hi) + dot(oh, A_lo)
+    else:
+        # single-precision weights (the reference's GPU default,
+        # gpu_use_dp=false): one bf16 pass, f32 accumulation
+        for g in range(G):  # static unroll
+            word_g = bins_ref[g // 4:g // 4 + 1, :]
+            bg = jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF
+            oh = (b_iota == bg).astype(bf16)                 # (B, T)
+            hist_ref[g * B:(g + 1) * B, :] += dot(oh, A_hi)
+
+
+def stream_block_rows(bmax: int) -> int:
+    """Rows per kernel block. Measured on v5e: 4096-row blocks REGRESS 5x at
+    Bmax=64 (VMEM pressure from the (L,T) leaf one-hot and (3S,T) weight
+    operands kills the pipeline), so stay at 1024."""
+    return 1024
 
 
 class StreamLayout(NamedTuple):
@@ -171,11 +187,11 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024) -> StreamLayout:
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
                                              "num_leaves", "block_rows",
-                                             "has_cat"))
+                                             "has_cat", "two_pass"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
-                   has_cat: bool = True):
+                   has_cat: bool = True, two_pass: bool = True):
     """One fused streaming pass: route rows through this round's splits and
     build the (S, G, Bmax, 3) histograms of the rows' NEW slots.
 
@@ -197,7 +213,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
 
     new_leaf, hist = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
-                          has_cat=has_cat),
+                          has_cat=has_cat, two_pass=two_pass),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),

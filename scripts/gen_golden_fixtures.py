@@ -1,0 +1,117 @@
+"""Generate golden interop fixtures against a stock LightGBM CLI binary.
+
+Usage: LGBM_CLI=/path/to/lightgbm python scripts/gen_golden_fixtures.py
+
+Produces, under tests/fixtures/:
+  - stock_{binary,regression_cat,multiclass}.model  — models trained by STOCK
+    LightGBM on the deterministic data below
+  - golden_X.csv / golden_y_{task}.csv              — the data
+  - stock_pred_{task}.txt                            — stock's predictions
+  - ours_{binary}.model + stock_pred_on_ours.txt     — a model trained by
+    lightgbm_tpu, verified to LOAD in stock LightGBM, with stock's
+    predictions on it (proves the reference grammar accepts our files;
+    reference: src/boosting/gbdt_model_text.cpp:315, src/io/tree.cpp)
+
+The fixtures are checked in; tests/test_golden.py never needs the binary.
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+ROOT = Path(__file__).resolve().parent.parent
+FIX = ROOT / "tests" / "fixtures"
+CLI = os.environ.get("LGBM_CLI", "/tmp/refsrc/lightgbm")
+
+
+def make_data(seed=42, n=600, f=6):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).round(4)
+    X[:, 4] = rs.randint(0, 5, n)            # categorical-able column
+    X[rs.rand(n) < 0.08, 0] = np.nan         # missing values
+    logit = X[:, 1] - 0.8 * np.nan_to_num(X[:, 0]) + (X[:, 4] == 2) * 1.5
+    y_bin = (rs.rand(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    y_reg = (X[:, 1] * 2 + np.nan_to_num(X[:, 0]) + (X[:, 4] == 3) * 2
+             + 0.05 * rs.randn(n)).round(5)
+    y_mc = (np.clip((X[:, 1] > 0).astype(int) + (X[:, 2] > 0.3), 0, 2))
+    return X, y_bin, y_reg, y_mc
+
+
+def write_csv(path, y, X):
+    data = np.column_stack([y, np.nan_to_num(X, nan=np.nan)])
+    with open(path, "w") as fh:
+        for row in data:
+            fh.write(",".join("" if np.isnan(v) else f"{v:.6g}" for v in row)
+                     + "\n")
+
+
+def run_cli(conf: dict, cwd):
+    args = [CLI] + [f"{k}={v}" for k, v in conf.items()]
+    r = subprocess.run(args, cwd=cwd, capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.exit(f"CLI failed: {args}\n{r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+def main():
+    FIX.mkdir(parents=True, exist_ok=True)
+    X, y_bin, y_reg, y_mc = make_data()
+    train_csv = FIX / "golden_train_binary.csv"
+    write_csv(train_csv, y_bin, X)
+    write_csv(FIX / "golden_train_reg.csv", y_reg, X)
+    write_csv(FIX / "golden_train_mc.csv", y_mc, X)
+    # prediction input: the training matrix without labels
+    with open(FIX / "golden_X.csv", "w") as fh:
+        for row in X:
+            fh.write(",".join("" if np.isnan(v) else f"{v:.6g}" for v in row)
+                     + "\n")
+
+    common = {"num_leaves": 15, "min_data_in_leaf": 5, "max_bin": 63,
+              "num_iterations": 10, "learning_rate": 0.1, "verbosity": -1,
+              "header": "false", "label_column": "0"}
+    tasks = [
+        ("binary", {"objective": "binary",
+                    "data": str(train_csv)}),
+        ("regression_cat", {"objective": "regression",
+                            "data": str(FIX / 'golden_train_reg.csv'),
+                            "categorical_feature": "4"}),
+        ("multiclass", {"objective": "multiclass", "num_class": "3",
+                        "data": str(FIX / 'golden_train_mc.csv')}),
+    ]
+    for name, extra in tasks:
+        model = FIX / f"stock_{name}.model"
+        run_cli({**common, **extra, "task": "train",
+                 "output_model": str(model)}, FIX)
+        run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+                 "input_model": str(model), "header": "false",
+                 "output_result": str(FIX / f"stock_pred_{name}.txt"),
+                 "predict_raw_score": "true", "verbosity": -1}, FIX)
+        print(f"generated stock_{name}.model")
+
+    # ---- reverse direction: OUR model must load in stock LightGBM ----
+    sys.path.insert(0, str(ROOT))
+    import lightgbm_tpu as lgb
+    ds = lgb.Dataset(X, label=y_bin.astype(float), categorical_feature=[4],
+                     params={"max_bin": 63, "verbosity": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 5, "max_bin": 63}, ds,
+                    num_boost_round=10)
+    ours = FIX / "ours_binary.model"
+    bst.save_model(str(ours))
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(ours), "header": "false",
+             "output_result": str(FIX / "stock_pred_on_ours.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    stock_on_ours = np.loadtxt(FIX / "stock_pred_on_ours.txt")
+    ours_pred = bst.predict(X, raw_score=True)
+    err = np.abs(stock_on_ours - ours_pred).max()
+    print(f"stock-on-ours max |diff| vs our predict: {err:.3e}")
+    if err > 1e-6:
+        sys.exit("our saved model predicts differently under stock LightGBM")
+    print("all fixtures generated")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Device batch-prediction kernel vs the host predictor (interpret mode).
+
+Reference analog: src/boosting/gbdt_prediction.cpp — batch predictions must
+match the per-row walk."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster
+from lightgbm_tpu.pallas import predict_kernel
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(predict_kernel, "_INTERPRET", True)
+    monkeypatch.setattr(Booster, "_DEVICE_PREDICT_MIN_ROWS", 100)
+    yield
+
+
+def _train(n=2000, f=8, seed=3, **params):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    X[rs.rand(n) < 0.1, 0] = np.nan
+    y = X[:, 1] * 2 + np.nan_to_num(X[:, 0]) + 0.1 * rs.randn(n)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5, **params},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    return bst, X
+
+
+def test_device_predict_matches_host():
+    bst, X = _train()
+    rs = np.random.RandomState(9)
+    Xt = rs.randn(500, X.shape[1])
+    Xt[rs.rand(500) < 0.1, 0] = np.nan
+    p_dev = bst.predict(Xt)                       # device path (min rows 100)
+    # force host path
+    big = Booster._DEVICE_PREDICT_MIN_ROWS
+    Booster._DEVICE_PREDICT_MIN_ROWS = 10 ** 9
+    try:
+        p_host = bst.predict(Xt)
+    finally:
+        Booster._DEVICE_PREDICT_MIN_ROWS = big
+    np.testing.assert_allclose(p_dev, p_host, rtol=1e-4, atol=1e-5)
+
+
+def test_device_predict_multiclass():
+    rs = np.random.RandomState(5)
+    X = rs.randn(1500, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y.astype(float)), num_boost_round=4)
+    p_dev = bst.predict(X)
+    big = Booster._DEVICE_PREDICT_MIN_ROWS
+    Booster._DEVICE_PREDICT_MIN_ROWS = 10 ** 9
+    try:
+        p_host = bst.predict(X)
+    finally:
+        Booster._DEVICE_PREDICT_MIN_ROWS = big
+    assert p_dev.shape == (1500, 3)
+    np.testing.assert_allclose(p_dev, p_host, rtol=1e-4, atol=1e-5)
+
+
+def test_categorical_model_falls_back():
+    rs = np.random.RandomState(6)
+    X = 0.01 * rs.randn(1200, 5)
+    X[:, 3] = rs.randint(0, 6, 1200)
+    y = 3.0 * np.isin(X[:, 3], [1, 4]).astype(float) + 0.01 * rs.randn(1200)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "max_cat_to_onehot": 1},
+                    lgb.Dataset(X, label=y, categorical_feature=[3]),
+                    num_boost_round=3)
+    use = bst._all_trees()
+    has_cat_split = any(
+        (np.asarray(t.decision_type[:max(t.num_leaves - 1, 0)]) & 1).any()
+        for t in use)
+    assert has_cat_split, "model should contain categorical splits"
+    assert bst._try_device_predict(X, use, 1) is None  # cat -> host fallback
+    p = bst.predict(X)
+    assert np.corrcoef(p, y)[0, 1] > 0.9
