@@ -1,0 +1,7 @@
+"""`python -m lightgbm_tpu` — the CLI application (reference:
+src/application/application.cpp via src/main.cpp)."""
+from .cli import main
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -76,9 +76,15 @@ class Dataset:
 
         if isinstance(data, (str, Path)):
             from .dataset_io import load_data_file
-            data, label_file = load_data_file(str(data), self.params)
+            data, label_file, extras = load_data_file(str(data), self.params)
             if label is None:
                 label = label_file
+            if weight is None:
+                weight = extras.get("weight")
+            if group is None:
+                group = extras.get("group")
+            if position is None:
+                position = extras.get("position")
         sp = _scipy_to_dense(data)
         if sp is not None:
             data = sp
@@ -563,6 +569,8 @@ class Booster:
         if per_class * ROWS_PER_TREE * L * 4 > 10 * 2 ** 20:
             return None
         for t in use:
+            if t.is_linear:
+                return None    # linear leaves: host path
             ni = max(t.num_leaves - 1, 0)
             if ni and (np.asarray(t.decision_type[:ni]) & 1).any():
                 return None    # categorical splits: host path

@@ -1,0 +1,146 @@
+"""Command-line application: config-file driven train/predict.
+
+Reference: src/application/application.cpp:217 (Application::Run dispatching
+task=train/predict), src/io/config.cpp (KV parsing: command-line pairs
+override the config file).
+
+Usage:
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu task=train data=train.csv objective=binary ...
+    python -m lightgbm_tpu task=predict data=test.csv input_model=model.txt
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import resolve_aliases
+from .engine import train as engine_train
+from .utils.log import LightGBMError, log_info
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """key = value lines; '#' comments (reference: config file format)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    cli: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            raise LightGBMError(f"unknown argument {tok!r} (expected key=value)")
+        k, _, v = tok.partition("=")
+        cli[k.strip()] = v.strip()
+    if "config" in cli:
+        params.update(parse_config_file(cli.pop("config")))
+    params.update(cli)   # command line overrides the config file
+    return params
+
+
+def _coerce(params: Dict[str, str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if isinstance(v, str):
+            low = v.lower()
+            if low in ("true", "false"):
+                out[k] = low == "true"
+                continue
+            try:
+                out[k] = int(v)
+                continue
+            except ValueError:
+                pass
+            try:
+                out[k] = float(v)
+                continue
+            except ValueError:
+                pass
+        out[k] = v
+    return out
+
+
+def run_train(params: Dict[str, Any]) -> None:
+    data_path = params.get("data")
+    if not data_path:
+        raise LightGBMError("task=train requires data=<file>")
+    ds = Dataset(str(data_path), params=dict(params))
+    valid_sets, valid_names = [], []
+    vspec = params.get("valid", params.get("valid_data", ""))
+    if vspec:
+        for vp in str(vspec).split(","):
+            vp = vp.strip()
+            if vp:
+                valid_sets.append(Dataset(vp, reference=ds,
+                                          params=dict(params)))
+                valid_names.append(vp.rsplit("/", 1)[-1])
+    num_rounds = int(params.get("num_iterations", 100))
+    bst = engine_train(params, ds, num_boost_round=num_rounds,
+                       valid_sets=valid_sets or None,
+                       valid_names=valid_names or None,
+                       init_model=params.get("input_model") or None)
+    out_model = str(params.get("output_model", "LightGBM_model.txt"))
+    bst.save_model(out_model)
+    log_info(f"Finished training; model saved to {out_model}")
+
+
+def run_predict(params: Dict[str, Any]) -> None:
+    data_path = params.get("data")
+    model_path = params.get("input_model")
+    if not data_path or not model_path:
+        raise LightGBMError("task=predict requires data=<file> and "
+                            "input_model=<file>")
+    from .dataset_io import load_data_file
+    X, label, _ = load_data_file(str(data_path), dict(params))
+    bst = Booster(model_file=str(model_path))
+    if X.shape[1] == bst.num_feature() - 1 and label is not None:
+        # the file carried no label column: undo the default label strip
+        # (reference predicts on files with the training-data format, label
+        # included and ignored; a label-less file is also accepted)
+        X = np.column_stack([label, X])
+    raw = bool(params.get("predict_raw_score", False))
+    leaf = bool(params.get("predict_leaf_index", False))
+    contrib = bool(params.get("predict_contrib", False))
+    pred = bst.predict(X, raw_score=raw, pred_leaf=leaf, pred_contrib=contrib)
+    out = str(params.get("output_result", "LightGBM_predict_result.txt"))
+    pred2 = np.atleast_2d(np.asarray(pred))
+    if pred2.shape[0] == 1 and np.asarray(pred).ndim == 1:
+        pred2 = pred2.T
+    with open(out, "w") as fh:
+        for row in pred2:
+            fh.write("\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n")
+    log_info(f"Finished prediction; results saved to {out}")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__)
+        return 1
+    params = _coerce(resolve_aliases(parse_args(list(argv))))
+    task = str(params.get("task", "train"))
+    if task == "train":
+        run_train(params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params)
+    elif task == "refit":
+        raise LightGBMError("task=refit is not implemented in the CLI yet; "
+                            "use Booster.refit from Python")
+    else:
+        raise LightGBMError(f"unknown task {task!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,9 +30,11 @@ def _detect_format(first_lines) -> str:
 
 
 def load_data_file(path: str, params: Dict[str, Any]
-                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-    """Load a data file; returns (features, label). First column is the label unless
-    label_column says otherwise (reference: dataset_loader.cpp label handling)."""
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict[str, Any]]:
+    """Load a data file; returns (features, label, extras) where extras may
+    hold 'weight' / 'group' / 'position' from the .weight/.query/.position
+    sidecar files (reference: dataset_loader.cpp:211 LoadQueryBoundaries,
+    metadata.cpp LoadWeights/LoadPositions) or libsvm qid tags."""
     if not os.path.exists(path):
         raise LightGBMError(f"data file {path} not found")
     with open(path) as f:
@@ -46,8 +48,24 @@ def load_data_file(path: str, params: Dict[str, Any]
     elif lc.isdigit():
         label_col = int(lc)
 
+    extras: Dict[str, Any] = {}
+    w = load_weight_file(path)
+    if w is not None:
+        extras["weight"] = w
+    qg = load_query_file(path)
+    if qg is not None:
+        extras["group"] = qg
+    pos = load_position_file(path)
+    if pos is not None:
+        extras["position"] = pos
     if fmt == "libsvm":
-        return _load_libsvm(path)
+        feats, label, qids = _load_libsvm(path)
+        if "group" not in extras and qids is not None:
+            # consecutive qid runs -> group sizes
+            change = np.flatnonzero(np.diff(qids)) + 1
+            bounds = np.concatenate([[0], change, [len(qids)]])
+            extras["group"] = np.diff(bounds)
+        return feats, label, extras
     delim = "," if fmt == "csv" else "\t"
     from .native import parse_csv as _native_parse
     data = _native_parse(path, delim=delim, skip_header=has_header)
@@ -58,12 +76,13 @@ def load_data_file(path: str, params: Dict[str, Any]
         data = data.reshape(-1, 1)
     label = data[:, label_col].copy()
     feats = np.delete(data, label_col, axis=1)
-    return feats, label
+    return feats, label, extras
 
 
-def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+def _load_libsvm(path: str):
     labels = []
     rows = []
+    qids = []
     max_idx = -1
     with open(path) as f:
         for line in f:
@@ -78,6 +97,7 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
                     continue
                 k, v = tok.split(":", 1)
                 if k == "qid":
+                    qids.append(int(v))
                     continue
                 ki = int(k)
                 kv.append((ki, float(v)))
@@ -88,7 +108,8 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
     for i, kv in enumerate(rows):
         for k, v in kv:
             out[i, k] = v
-    return out, np.asarray(labels, np.float64)
+    q = np.asarray(qids, np.int64) if len(qids) == n else None
+    return out, np.asarray(labels, np.float64), q
 
 
 def load_query_file(path: str) -> Optional[np.ndarray]:
@@ -103,4 +124,16 @@ def load_weight_file(path: str) -> Optional[np.ndarray]:
     wpath = path + ".weight"
     if os.path.exists(wpath):
         return np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+    return None
+
+
+def load_position_file(path: str) -> Optional[np.ndarray]:
+    """Load .position sidecar (one position id per row; reference:
+    metadata.cpp LoadPositions for position-debiased lambdarank)."""
+    ppath = path + ".position"
+    if os.path.exists(ppath):
+        raw = np.loadtxt(ppath, dtype=str).reshape(-1)
+        # positions may be arbitrary strings; map to dense int ids
+        _, inv = np.unique(raw, return_inverse=True)
+        return inv.astype(np.int32)
     return None

@@ -110,6 +110,26 @@ def tree_to_string(tree: Tree, index: int) -> str:
         lines.append("cat_boundaries=" + _join(tree.cat_boundaries.astype(int)))
         lines.append("cat_threshold=" + _join(tree.cat_threshold.astype(int)))
     lines.append(f"is_linear={1 if tree.is_linear else 0}")
+    if tree.is_linear and tree.leaf_const is not None:
+        # reference grammar: src/io/tree.cpp:384-408
+        lines.append("leaf_const=" + _join(tree.leaf_const,
+                                           lambda x: f"{x:.17g}"))
+        nf = [len(c) for c in (tree.leaf_coeff or [[]] * tree.num_leaves)]
+        lines.append("num_features=" + _join(np.asarray(nf)))
+        parts = []
+        for i in range(tree.num_leaves):
+            if nf[i] > 0:
+                parts.append(" ".join(str(int(f))
+                                      for f in tree.leaf_features[i]) + " ")
+            parts.append(" ")
+        lines.append("leaf_features=" + "".join(parts).rstrip())
+        parts = []
+        for i in range(tree.num_leaves):
+            if nf[i] > 0:
+                parts.append(" ".join(f"{c:.17g}"
+                                      for c in tree.leaf_coeff[i]) + " ")
+            parts.append(" ")
+        lines.append("leaf_coeff=" + "".join(parts).rstrip())
     lines.append(f"shrinkage={tree.shrinkage:g}")
     lines.append("")
     lines.append("")
@@ -299,7 +319,23 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
         leaf_count=_parse_array(block.get("leaf_count", ""), float),
         shrinkage=float(block.get("shrinkage", "1")),
         is_linear=bool(int(block.get("is_linear", "0"))),
+        leaf_const=(np.asarray([float(v) for v in
+                                block["leaf_const"].split()])
+                    if "leaf_const" in block else None),
     )
+    if t.is_linear and "num_features" in block:
+        nf = _parse_array(block.get("num_features", ""), int)
+        feats_flat = _parse_array(block.get("leaf_features", ""), int)
+        coeff_flat = _parse_array(block.get("leaf_coeff", ""), float)
+        lf, lc, pf, pc = [], [], 0, 0
+        for i in range(nl):
+            cnt = int(nf[i]) if i < len(nf) else 0
+            lf.append([int(v) for v in feats_flat[pf:pf + cnt]])
+            lc.append([float(v) for v in coeff_flat[pc:pc + cnt]])
+            pf += cnt
+            pc += cnt
+        t.leaf_features = lf
+        t.leaf_coeff = lc
     if num_cat > 0:
         t.cat_boundaries = _parse_array(block["cat_boundaries"], int).astype(np.int32)
         t.cat_threshold = _parse_array(block["cat_threshold"], int).astype(np.uint32)

@@ -23,6 +23,7 @@ from ..device_data import DeviceData, to_device
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
 from ..ops.grow import GrowParams, grow_tree
+from ..ops.split import leaf_output
 from ..ops.predict import StackedTrees, _walk_one_tree
 from ..tree import Tree, TreeArrays, finalize_tree
 from ..utils.log import LightGBMError, log_info, log_warning
@@ -131,7 +132,8 @@ class GBDT:
             functools.partial(grow_tree, layout=dd.layout, routing=dd.routing,
                               params=self._grow_params,
                               monotone=self._monotone_array(),
-                              interaction_groups=self._interaction_group_masks()))
+                              interaction_groups=self._interaction_group_masks(),
+                              forced=self._parse_forced_splits()))
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
         self._finished_check_every = (
@@ -231,6 +233,65 @@ class GBDT:
             hist_two_pass=(c.hist_precision == "mixed"),
         )
 
+    def _parse_forced_splits(self):
+        """forcedsplits_filename JSON -> static per-level split spec
+        (reference: serial_tree_learner.cpp:628 ForceSplits; config
+        forcedsplits_filename). Numeric splits only."""
+        fn = self.config.forcedsplits_filename
+        if not fn:
+            return None
+        import json
+        try:
+            with open(fn) as fh:
+                spec = json.load(fh)
+        except FileNotFoundError:
+            raise LightGBMError(f"forcedsplits_filename {fn!r} not found")
+        except json.JSONDecodeError as e:
+            raise LightGBMError(
+                f"forcedsplits_filename {fn!r} is not valid JSON: {e}")
+        if not spec:
+            return None
+        mappers = self.train_data.bin_mappers()
+        L = max(self.config.num_leaves, 2)
+        levels = []
+        frontier = [(spec, 0)]
+        cur_count = 1
+        total = 0
+        while frontier:
+            start = cur_count
+            leaves, feats, thrs, dls = [], [], [], []
+            nxt = []
+            for idx, (node, leaf) in enumerate(frontier):
+                f = int(node["feature"])
+                if not 0 <= f < len(mappers):
+                    raise LightGBMError(
+                        f"forced split feature {f} out of range")
+                if mappers[f].bin_type == 1:
+                    raise LightGBMError(
+                        "categorical forced splits are not supported")
+                tb = int(np.searchsorted(mappers[f].upper_bounds,
+                                         float(node["threshold"]),
+                                         side="left"))
+                leaves.append(int(leaf))
+                feats.append(f)
+                thrs.append(tb)
+                dls.append(bool(node.get("default_left", False)))
+                right_id = start + idx
+                if node.get("left"):
+                    nxt.append((node["left"], leaf))
+                if node.get("right"):
+                    nxt.append((node["right"], right_id))
+            cur_count = start + len(frontier)
+            total += len(frontier)
+            if cur_count > L:
+                raise LightGBMError(
+                    f"forced splits need {cur_count} leaves but num_leaves="
+                    f"{L}")
+            levels.append((tuple(leaves), tuple(feats), tuple(thrs),
+                           tuple(dls)))
+            frontier = nxt
+        return tuple(levels)
+
     def _monotone_array(self) -> Optional[jax.Array]:
         """(F,) i32 in {-1,0,1} or None (reference: config monotone_constraints;
         monotone_constraints.hpp basic method)."""
@@ -295,15 +356,14 @@ class GBDT:
             raise LightGBMError(
                 "cegb_* (cost-effective gradient boosting) is not implemented in "
                 "lightgbm_tpu yet; remove the cegb_ parameters")
-        if c.forcedsplits_filename:
+        if c.linear_tree and self.boosting_type in ("dart", "rf"):
             raise LightGBMError(
-                "forcedsplits_filename is not implemented in lightgbm_tpu yet")
-        if c.linear_tree:
+                f"linear_tree is not supported with boosting="
+                f"{self.boosting_type}")
+        if c.linear_tree and self.train_data.raw_data is None:
             raise LightGBMError(
-                "linear_tree is not implemented in lightgbm_tpu yet")
-        if c.use_quantized_grad:
-            raise LightGBMError(
-                "use_quantized_grad is not implemented in lightgbm_tpu yet")
+                "linear_tree needs the raw feature matrix; construct the "
+                "Dataset with free_raw_data=False")
 
     def _compute_init_score(self) -> List[float]:
         k = self.num_tree_per_iteration
@@ -394,6 +454,9 @@ class GBDT:
 
         k = self.num_tree_per_iteration
         col_mask = self._feature_mask()
+        grad_raw, hess_raw = grad, hess
+        if self.config.use_quantized_grad:
+            grad, hess = self._quantize_gh(grad, hess)
         new_arrays = []
         for kk in range(k):
             g = grad if k == 1 else grad[:, kk]
@@ -405,33 +468,72 @@ class GBDT:
                     + self.iter_ * (k + 1) + kk)
             arrays, leaf_id = self._grow_fn(self.dd.bins, g, h, mask, col_mask,
                                             key=gkey, packed=self._packed)
+            if self.config.use_quantized_grad and \
+                    self.config.quant_train_renew_leaf:
+                arrays = self._renew_leaves_exact(arrays, leaf_id, grad_raw,
+                                                  hess_raw, kk)
             arrays, leaf_id = self._post_grow(arrays, leaf_id, kk, mask)
-            # score update: gather (reference: ScoreUpdater::AddScore);
-            # single-leaf trees have leaf_value 0, so no branch is needed
-            delta = arrays.leaf_value[leaf_id] * self._shrinkage_rate()
-            if k == 1:
-                self.score = self.score + delta
-            else:
-                self.score = self.score.at[:, kk].add(delta)
-            # tree finalization is DEFERRED (see `models` property); record the
-            # init-score bias to fold at materialization time so saved models
-            # stay self-contained (reference: gbdt.cpp:425)
             bias = 0.0
             if (self.iter_ == 0 or self._average_output) and \
                     self.init_scores[kk] != 0.0:
                 bias = self.init_scores[kk]
-            self._lazy_trees.append({"arrays": arrays,
-                                     "rate": self._shrinkage_rate(),
-                                     "bias": bias})
+            if self.config.linear_tree:
+                # host-synced path: fit linear leaf models on the raw features
+                # (reference: linear_tree_learner.cpp CalculateLinear, Eq 3 of
+                # arxiv 1802.05640) and apply their outputs to the scores
+                delta_np, tree = self._fit_linear_tree(arrays, leaf_id,
+                                                       grad_raw, hess_raw, kk)
+                if bias:
+                    tree.add_bias(bias)
+                self._flush_models()
+                self._models_list.append(tree)
+                n_pad_rows = self.dd.bins.shape[0]
+                delta = jnp.zeros(n_pad_rows, jnp.float32).at[
+                    :self.num_data].set(jnp.asarray(delta_np, jnp.float32))
+            else:
+                # score update: gather (reference: ScoreUpdater::AddScore);
+                # single-leaf trees have leaf_value 0, so no branch is needed
+                delta = arrays.leaf_value[leaf_id] * self._shrinkage_rate()
+                # tree finalization is DEFERRED (see `models` property);
+                # record the init-score bias to fold at materialization time
+                # so saved models stay self-contained (reference: gbdt.cpp:425)
+                self._lazy_trees.append({"arrays": arrays,
+                                         "rate": self._shrinkage_rate(),
+                                         "bias": bias})
+            if k == 1:
+                self.score = self.score + delta
+            else:
+                self.score = self.score.at[:, kk].add(delta)
             new_arrays.append(arrays)
 
         # update validation scores with the new trees
         for vi, vset in enumerate(self.valid_sets):
             dd = vset.device_data()
             score = self._valid_scores[vi]
-            for kk, arrays in enumerate(new_arrays):
-                score = self._add_tree_arrays_to_score(score, arrays, dd, kk,
-                                                       self._shrinkage_rate())
+            if self.config.linear_tree:
+                if vset.raw_data is None:
+                    raise LightGBMError(
+                        "linear_tree validation needs the raw feature matrix;"
+                        " construct the valid Dataset with "
+                        "free_raw_data=False")
+                for kk in range(k):
+                    tree = self._models_list[-k + kk]
+                    dv = np.asarray(tree.predict_raw(vset.raw_data))
+                    # add_valid already seeded valid scores with init_scores;
+                    # subtract the bias folded into the saved tree so it is
+                    # not double counted (non-linear path uses bias-free
+                    # device arrays)
+                    if (self.iter_ == 0 or self._average_output) and \
+                            self.init_scores[kk] != 0.0:
+                        dv = dv - self.init_scores[kk]
+                    pad = jnp.zeros(score.shape[0], jnp.float32).at[
+                        :len(dv)].set(jnp.asarray(dv, jnp.float32))
+                    score = (score + pad if score.ndim == 1
+                             else score.at[:, kk].add(pad))
+            else:
+                for kk, arrays in enumerate(new_arrays):
+                    score = self._add_tree_arrays_to_score(
+                        score, arrays, dd, kk, self._shrinkage_rate())
             self._valid_scores[vi] = score
 
         flags = [a.num_leaves <= 1 for a in new_arrays]
@@ -447,6 +549,132 @@ class GBDT:
 
     def _shrinkage_rate(self) -> float:
         return self.config.learning_rate
+
+    # ------------------------------------------------------------------
+    def _fit_linear_tree(self, arrays, leaf_id, grad_raw, hess_raw, kk):
+        """Fit per-leaf linear models on the raw features (reference:
+        linear_tree_learner.cpp CalculateLinear — weighted ridge on the
+        leaf's path features, Eq 3 of arxiv 1802.05640). Host-synced: linear
+        trees need the raw matrix and small per-leaf solves.
+
+        Returns (training score delta over the unpadded rows, host Tree)."""
+        k = self.num_tree_per_iteration
+        nd = self.num_data
+        got = jax.device_get((arrays, leaf_id,
+                              grad_raw if k == 1 else grad_raw[:, kk],
+                              hess_raw if k == 1 else hess_raw[:, kk]))
+        arrays_h, leaf_h, g_h, h_h = got
+        leaf_h = np.asarray(leaf_h)[:nd]
+        g_h = np.asarray(g_h)[:nd]
+        h_h = np.asarray(h_h)[:nd]
+        X = self.train_data.raw_data
+        mappers = self.train_data.bin_mappers()
+        tree = finalize_tree(arrays_h, mappers, None, learning_rate=1.0)
+        c = self.config
+        L = tree.num_leaves
+        ni = max(L - 1, 0)
+
+        # branch (path) features per leaf, numerical only
+        parent = np.full(ni, -1, np.int64)
+        leaf_parent = np.full(L, -1, np.int64)
+        for i in range(ni):
+            for ch in (int(tree.left_child[i]), int(tree.right_child[i])):
+                if ch >= 0:
+                    parent[ch] = i
+                else:
+                    leaf_parent[~ch] = i
+        leaf_feats: List[List[int]] = []
+        for ln in range(L):
+            feats = set()
+            node = leaf_parent[ln]
+            while node >= 0:
+                f = int(tree.split_feature[node])
+                if mappers[f].bin_type == 0:
+                    feats.add(f)
+                node = parent[node]
+            leaf_feats.append(sorted(feats))
+
+        tree.is_linear = True
+        tree.leaf_const = np.asarray(tree.leaf_value, np.float64).copy()
+        tree.leaf_features = [[] for _ in range(L)]
+        tree.leaf_coeff = [[] for _ in range(L)]
+        if self.iter_ > 0:   # reference: first tree stays constant
+            lam = float(c.linear_lambda)
+            for ln in range(L):
+                feats = leaf_feats[ln]
+                d = len(feats)
+                rows = np.flatnonzero(leaf_h == ln)
+                if d == 0 or len(rows) == 0:
+                    continue
+                A = np.column_stack([X[np.ix_(rows, feats)],
+                                     np.ones(len(rows))])
+                ok = ~np.isnan(A).any(axis=1)
+                if int(ok.sum()) < d + 1:
+                    continue
+                A = A[ok]
+                g = g_h[rows][ok]
+                h = h_h[rows][ok]
+                M = (A * h[:, None]).T @ A
+                M[np.arange(d), np.arange(d)] += lam
+                v = A.T @ g
+                try:
+                    coef = -np.linalg.solve(M, v)
+                except np.linalg.LinAlgError:
+                    coef = -np.linalg.pinv(M) @ v
+                keep = np.abs(coef[:d]) > 1e-35
+                tree.leaf_features[ln] = [f for f, kp in zip(feats, keep) if kp]
+                tree.leaf_coeff[ln] = [float(cf) for cf, kp
+                                       in zip(coef[:d], keep) if kp]
+                tree.leaf_const[ln] = float(coef[d])
+        rate = self._shrinkage_rate()
+        if rate != 1.0:
+            tree.shrink(rate)
+        delta = tree._linear_output(X, leaf_h)
+        return delta, tree
+
+    # ------------------------------------------------------------------
+    def _quantize_gh(self, grad, hess):
+        """Gradient/hessian discretization onto a symmetric integer grid of
+        num_grad_quant_bins levels with stochastic rounding (reference:
+        src/treelearner/gradient_discretizer.cpp). On TPU the histogram pass
+        is a bf16 contraction either way, so the value of quantization here is
+        behavioral parity (regularization-by-rounding + exact renewal below),
+        not a separate int8 code path."""
+        c = self.config
+        half = max(c.num_grad_quant_bins, 2) / 2.0
+        key = jax.random.PRNGKey((c.data_random_seed + 11) * 131071 + self.iter_)
+        kg, kh = jax.random.split(key)
+
+        def q(x, maxv, kq, lo):
+            scale = jnp.maximum(maxv, 1e-10) / half
+            if c.stochastic_rounding:
+                u = jax.random.uniform(kq, x.shape)
+            else:
+                u = 0.5
+            qi = jnp.clip(jnp.floor(x / scale + u), lo, half)
+            return qi * scale
+
+        gmax = jnp.max(jnp.abs(grad), axis=0)
+        hmax = jnp.max(hess, axis=0)
+        return q(grad, gmax, kg, -half), q(hess, hmax, kh, 0.0)
+
+    def _renew_leaves_exact(self, arrays: TreeArrays, leaf_id, grad_raw,
+                            hess_raw, kk: int) -> TreeArrays:
+        """Recompute leaf outputs from the UNquantized gradients (reference:
+        quant_train_renew_leaf, gradient_discretizer RenewIntGradTreeOutput)."""
+        k = self.num_tree_per_iteration
+        g = grad_raw if k == 1 else grad_raw[:, kk]
+        h = hess_raw if k == 1 else hess_raw[:, kk]
+        L = self._grow_params.num_leaves
+        lid = jnp.clip(leaf_id, 0, L - 1)
+        sg = jax.ops.segment_sum(g, lid, num_segments=L)
+        sh = jax.ops.segment_sum(h, lid, num_segments=L)
+        c = self.config
+        vals = leaf_output(sg, sh, c.lambda_l1, c.lambda_l2, c.max_delta_step)
+        keep = (jnp.arange(L) < arrays.num_leaves) & (arrays.leaf_count > 0)
+        vals = jnp.where(keep, vals, arrays.leaf_value)
+        vals = jnp.where(arrays.num_leaves > 1, vals, arrays.leaf_value)
+        return arrays._replace(leaf_value=vals)
 
     # ------------------------------------------------------------------
     def load_init_model(self, trees: List[Tree],

@@ -132,7 +132,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               params: GrowParams, monotone: Optional[jax.Array] = None,
               interaction_groups: Optional[jax.Array] = None,
               key: Optional[jax.Array] = None,
-              packed=None) -> Tuple[TreeArrays, jax.Array]:
+              packed=None, forced=None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
     grad/hess must already include any bagging mask; cnt_w is the mask itself.
@@ -141,7 +141,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     key: PRNGKey for per-node feature sampling / extra_trees random thresholds.
     packed: precomputed packed-bin layout (StreamLayout for the stream backend,
     packed (N, GW) words for the sorted pallas backend) — bins never change, so
-    the engine packs once per training run instead of once per tree."""
+    the engine packs once per training run instead of once per tree.
+    forced: static forced-split levels (reference: serial_tree_learner.cpp:628
+    ForceSplits) — tuple of (leaf_ids, feats, thr_bins, default_lefts) tuples
+    applied as unrolled rounds before gain-driven growth."""
     N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
@@ -289,45 +292,88 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     def cond(st: _GrowState):
         return st.progressed & (st.num_leaves_cur < L)
 
-    def make_body(S: int):
+    def make_body(S: int, forced_level=None):
         """Round body with a static per-round split budget S. The streaming
         kernel's MXU cost is linear in S, so early rounds (<= 2^r possible
         splits) run cheaper specialized bodies (see the unrolled prefix
         below); the reference's analog is growing leaf-by-leaf until the
-        histogram pool warms up (serial_tree_learner.cpp)."""
+        histogram pool warms up (serial_tree_learner.cpp).
+        forced_level: static (leaf_ids, feats, thr_bins, default_lefts) —
+        split exactly these leaves instead of the top-K by gain."""
       # noqa: E999 -- body below re-indented under the factory
         def body(st: _GrowState) -> _GrowState:
             cur = st.num_leaves_cur
             remaining = L - cur
-            # ---- candidate selection: top-K splittable leaves by cached gain ----
-            depth_ok = (params.max_depth <= 0) | (st.depth < jnp.asarray(
-                params.max_depth if params.max_depth > 0 else 2**30, i32))
-            cand = jnp.where((st.best_gain > 0) & depth_ok, st.best_gain, NEG_INF)
-            order = jnp.argsort(-cand)                    # (L,) desc
-            k_budget = jnp.minimum(remaining, S)
-            ranks = jnp.arange(L)
-            sorted_gain = cand[order]
-            chosen_rank = (ranks < k_budget) & (sorted_gain > 0)
-            k = jnp.sum(chosen_rank.astype(i32))
-
-            # pair arrays over S slots (i = rank)
-            pair_valid = jnp.arange(S) < k                        # (S,)
-            pair_old = jnp.where(pair_valid, order[:S], 0)        # old leaf id (left child)
-            pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
-            pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
             drop = jnp.asarray(2**30, i32)
-            node_idx = jnp.where(pair_valid, pair_node, drop)
-            new_idx = jnp.where(pair_valid, pair_new, drop)
-            old_idx = jnp.where(pair_valid, pair_old, drop)
+            if forced_level is not None:
+                # ---- forced splits (serial_tree_learner.cpp:628) ----
+                f_leaves, f_feats, f_thrs, f_dl = forced_level
+                nf = len(f_leaves)
+                assert nf <= S
+                k = jnp.asarray(nf, i32)
+                pair_valid = jnp.arange(S) < nf
+                pair_old = jnp.asarray(list(f_leaves) + [0] * (S - nf), i32)
+                pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
+                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+                node_idx = jnp.where(pair_valid, pair_node, drop)
+                new_idx = jnp.where(pair_valid, pair_new, drop)
+                old_idx = jnp.where(pair_valid, pair_old, drop)
+                feat = jnp.asarray(list(f_feats) + [0] * (S - nf), i32)
+                thr = jnp.asarray(list(f_thrs) + [0] * (S - nf), i32)
+                dirf = jnp.asarray([1 if d else 0 for d in f_dl]
+                                   + [0] * (S - nf), i32)
+                pg, ph, pc = (st.sum_g[pair_old], st.sum_h[pair_old],
+                              st.cnt[pair_old])
+                # left sums from the leaf histogram at the forced threshold
+                hf_f = gather_feature_histograms(st.hist[pair_old], layout,
+                                                 pg, ph, pc)
+                hsel = hf_f[jnp.arange(S), feat]             # (S, Bmax, 3)
+                bin_le = (jnp.arange(Bmax)[None, :] <= thr[:, None])
+                nanb = routing.nan_bin[feat]                 # (S,)
+                nan_part = jnp.where(
+                    (nanb >= 0)[:, None]
+                    & (jnp.arange(Bmax)[None, :] == nanb[:, None])
+                    & (dirf[:, None] == 1), True, False)
+                take = (bin_le & ~((nanb >= 0)[:, None]
+                                   & (jnp.arange(Bmax)[None, :]
+                                      == nanb[:, None]))) | nan_part
+                lg = jnp.sum(jnp.where(take, hsel[..., 0], 0.0), axis=1)
+                lh = jnp.sum(jnp.where(take, hsel[..., 1], 0.0), axis=1)
+                lc = jnp.sum(jnp.where(take, hsel[..., 2], 0.0), axis=1)
+                gain = jnp.zeros(S, f32)
+                rg, rh, rc = pg - lg, ph - lh, pc - lc
+            else:
+                # ---- candidate selection: top-K splittable leaves by gain ----
+                depth_ok = (params.max_depth <= 0) | (st.depth < jnp.asarray(
+                    params.max_depth if params.max_depth > 0 else 2**30, i32))
+                cand = jnp.where((st.best_gain > 0) & depth_ok, st.best_gain,
+                                 NEG_INF)
+                order = jnp.argsort(-cand)                    # (L,) desc
+                k_budget = jnp.minimum(remaining, S)
+                ranks = jnp.arange(L)
+                sorted_gain = cand[order]
+                chosen_rank = (ranks < k_budget) & (sorted_gain > 0)
+                k = jnp.sum(chosen_rank.astype(i32))
 
-            feat = st.best_feat[pair_old]
-            thr = st.best_thr[pair_old]
-            dirf = st.best_dir[pair_old]
-            gain = st.best_gain[pair_old]
-            pg, ph, pc = st.sum_g[pair_old], st.sum_h[pair_old], st.cnt[pair_old]
-            lg, lh, lc = (st.best_left_g[pair_old], st.best_left_h[pair_old],
-                          st.best_left_c[pair_old])
-            rg, rh, rc = pg - lg, ph - lh, pc - lc
+                # pair arrays over S slots (i = rank)
+                pair_valid = jnp.arange(S) < k                # (S,)
+                pair_old = jnp.where(pair_valid, order[:S], 0)
+                pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
+                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+                node_idx = jnp.where(pair_valid, pair_node, drop)
+                new_idx = jnp.where(pair_valid, pair_new, drop)
+                old_idx = jnp.where(pair_valid, pair_old, drop)
+
+                feat = st.best_feat[pair_old]
+                thr = st.best_thr[pair_old]
+                dirf = st.best_dir[pair_old]
+                gain = st.best_gain[pair_old]
+                pg, ph, pc = (st.sum_g[pair_old], st.sum_h[pair_old],
+                              st.cnt[pair_old])
+                lg, lh, lc = (st.best_left_g[pair_old],
+                              st.best_left_h[pair_old],
+                              st.best_left_c[pair_old])
+                rg, rh, rc = pg - lg, ph - lh, pc - lc
 
             # ---- categorical bitsets for the chosen splits ----
             parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 3)
@@ -515,6 +561,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                 round_idx=st.round_idx + 1)
 
         return body
+
+    # forced splits run first, one statically-unrolled round per level
+    # (reference: serial_tree_learner.cpp:628 ForceSplits)
+    if forced:
+        for level in forced:
+            state = make_body(max(len(level[0]), 1), forced_level=level)(state)
 
     # streaming rounds: round r can split at most 2^r leaves, and the
     # fused kernel cost is linear in the slot budget S — run the first

@@ -166,13 +166,27 @@ class LambdarankNDCG(ObjectiveFunction):
                          for ix in self.buckets.doc_index]
         self._dev_gain = [jnp.asarray(gains[np.maximum(ix, 0)], jnp.float32)
                           for ix in self.buckets.doc_index]
+        # position-debiased lambdarank (reference: rank_objective.hpp:44-66
+        # score adjustment + :303 UpdatePositionBiasFactors Newton step)
+        self._positions = None
         if position is not None:
-            log_warning("position bias debiasing is not yet applied "
-                        "(positions accepted; factors pending round 2)")
+            pos = np.asarray(position, np.int64).reshape(-1)
+            if len(pos) != n:
+                raise LightGBMError(
+                    f"position has {len(pos)} entries for {n} rows")
+            self.num_position_ids = int(pos.max()) + 1 if len(pos) else 0
+            self._positions = jnp.asarray(pos, jnp.int32)
+            self.pos_biases = jnp.zeros(self.num_position_ids, jnp.float32)
+            self._pos_counts = jnp.asarray(
+                np.bincount(pos, minlength=self.num_position_ids), jnp.float32)
+            self._pos_reg = float(c.lambdarank_position_bias_regularization)
+            self._pos_lr = float(c.learning_rate)
 
     def get_gradients(self, score):
         c = self.config
         n = score.shape[0]
+        if self._positions is not None:
+            score = score + self.pos_biases[self._positions]
         grad = jnp.zeros(n, jnp.float32)
         hess = jnp.zeros(n, jnp.float32)
         for bi in range(len(self.buckets.sizes)):
@@ -187,7 +201,22 @@ class LambdarankNDCG(ObjectiveFunction):
                                  idx.reshape(-1), n)
             grad = grad.at[flat_idx].add(g.reshape(-1), mode="drop")
             hess = hess.at[flat_idx].add(h.reshape(-1), mode="drop")
-        return self._apply_weight(grad, hess)
+        grad, hess = self._apply_weight(grad, hess)
+        if self._positions is not None:
+            self._update_position_bias(grad, hess)
+        return grad, hess
+
+    def _update_position_bias(self, grad, hess) -> None:
+        """Newton-Raphson step on the per-position bias factors (reference:
+        rank_objective.hpp:303 UpdatePositionBiasFactors); stays on device —
+        host readbacks are expensive on a tunneled TPU."""
+        P = self.num_position_ids
+        d1 = -jax.ops.segment_sum(grad, self._positions, num_segments=P)
+        d2 = -jax.ops.segment_sum(hess, self._positions, num_segments=P)
+        d1 = d1 - self.pos_biases * self._pos_reg * self._pos_counts
+        d2 = d2 - self._pos_reg * self._pos_counts
+        self.pos_biases = (self.pos_biases
+                           + self._pos_lr * d1 / (jnp.abs(d2) + 0.001))
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -141,6 +141,126 @@ def _node_weight(tree: Tree, node: int) -> float:
 _child_weight = _node_weight
 
 
+def _all_decisions(tree: Tree, X: np.ndarray) -> np.ndarray:
+    """(N, n_internal) bool — each row's decision at EVERY internal node
+    (vectorised _decision); TreeSHAP consults off-path nodes too."""
+    n = X.shape[0]
+    ni = max(tree.num_leaves - 1, 0)
+    dec = np.zeros((n, ni), bool)
+    for node in range(ni):
+        f = int(tree.split_feature[node])
+        v = X[:, f]
+        dt = int(tree.decision_type[node])
+        if dt & 1:  # categorical
+            iv = np.where(np.isnan(v) | (v < 0), -1, v).astype(np.int64)
+            kcat = int(tree.threshold_bin[node])
+            s, e = tree.cat_boundaries[kcat], tree.cat_boundaries[kcat + 1]
+            words = np.asarray(tree.cat_threshold[s:e], np.uint32)
+            word_idx = iv // 32
+            ok = (iv >= 0) & (word_idx < (e - s))
+            w = words[np.clip(word_idx, 0, max(e - s - 1, 0))]
+            dec[:, node] = ok & (((w >> (iv % 32).astype(np.uint32)) & 1) > 0)
+            continue
+        missing_type = (dt >> 2) & 3
+        nanv = np.isnan(v)
+        is_missing = nanv | ((missing_type == 1) & (np.abs(v) < 1e-35))
+        go = np.where(nanv, 0.0, v) <= tree.threshold[node]
+        if missing_type != 0:
+            go = np.where(is_missing, bool(dt & 2), go)
+        dec[:, node] = go
+    return dec
+
+
+def _tree_shap_batch(tree: Tree, dec: np.ndarray, phi: np.ndarray) -> None:
+    """Row-vectorised exact TreeSHAP: the recursion order over nodes is
+    row-independent; only the hot/cold assignment and the path fractions vary
+    per row, carried as (N,) vectors (same math as the scalar reference
+    implementation above / src/io/tree.cpp TreeSHAP)."""
+    n = dec.shape[0]
+    leaf_value = np.asarray(tree.leaf_value, np.float64)
+
+    def node_weight(node):
+        return (float(tree.leaf_count[~node]) if node < 0
+                else float(tree.internal_count[node]))
+
+    def recurse(node, feat_idx, zf, of, pw, pz, po, pf):
+        # copy-extend the path (reference copies the path per call)
+        d = len(feat_idx)
+        feat_idx = feat_idx + [pf]
+        zf = np.vstack([zf, pz[None, :]])
+        of = np.vstack([of, po[None, :]])
+        pw = np.vstack([pw, np.full((1, n), 1.0 if d == 0 else 0.0)])
+        for i in range(d - 1, -1, -1):
+            pw[i + 1] += po * pw[i] * (i + 1) / (d + 1)
+            pw[i] = pz * pw[i] * (d - i) / (d + 1)
+
+        if node < 0:  # leaf: unwound path sums -> phi
+            dd = len(feat_idx) - 1
+            for i in range(1, len(feat_idx)):
+                ofi, zfi = of[i], zf[i]
+                next_one = pw[dd].copy()
+                total = np.zeros(n)
+                for j in range(dd - 1, -1, -1):
+                    tmp = np.where(
+                        ofi != 0,
+                        next_one * (dd + 1) / ((j + 1) * np.where(ofi != 0,
+                                                                  ofi, 1.0)),
+                        0.0)
+                    safe_z = np.where(zfi != 0, zfi, 1.0)
+                    alt = np.where(zfi != 0,
+                                   (pw[j] / safe_z) / ((dd - j) / (dd + 1)),
+                                   0.0)
+                    total += np.where(ofi != 0, tmp, alt)
+                    next_one = pw[j] - tmp * zfi * ((dd - j) / (dd + 1))
+                phi[:, feat_idx[i]] += total * (ofi - zfi) * leaf_value[~node]
+            return
+
+        lc, rc = int(tree.left_child[node]), int(tree.right_child[node])
+        hot_is_left = dec[:, node]
+        w_node = node_weight(node)
+        w_l, w_r = node_weight(lc), node_weight(rc)
+        zl = w_l / w_node if w_node > 0 else 0.0
+        zr = w_r / w_node if w_node > 0 else 0.0
+        f = int(tree.split_feature[node])
+        inc_zero = np.ones(n)
+        inc_one = np.ones(n)
+        if f in feat_idx:
+            pi = feat_idx.index(f)
+            inc_zero = zf[pi].copy()
+            inc_one = of[pi].copy()
+            # unwind the previous occurrence of this feature
+            dd = len(feat_idx) - 1
+            ofi, zfi = of[pi], zf[pi]
+            next_one = pw[dd].copy()
+            for j in range(dd - 1, -1, -1):
+                tmp = pw[j].copy()
+                upd = np.where(ofi != 0,
+                               next_one * (dd + 1) / ((j + 1) * np.where(
+                                   ofi != 0, ofi, 1.0)),
+                               pw[j] * (dd + 1) / (np.where(zfi != 0, zfi,
+                                                            1.0) * (dd - j)))
+                pw[j] = upd
+                next_one = tmp - upd * zfi * (dd - j) / (dd + 1)
+            feat_idx = feat_idx[:pi] + feat_idx[pi + 1:]
+            zf = np.delete(zf, pi, axis=0)
+            of = np.delete(of, pi, axis=0)
+            pw = pw[:-1]
+
+        # zero fractions are child_weight/node_weight regardless of hot/cold;
+        # only the one fraction depends on the row's decision
+        z_left = zl * inc_zero
+        o_left = np.where(hot_is_left, inc_one, 0.0)
+        z_right = zr * inc_zero
+        o_right = np.where(hot_is_left, 0.0, inc_one)
+        recurse(lc, list(feat_idx), zf.copy(), of.copy(), pw.copy(),
+                z_left, o_left, f)
+        recurse(rc, list(feat_idx), zf.copy(), of.copy(), pw.copy(),
+                z_right, o_right, f)
+
+    recurse(0, [], np.zeros((0, n)), np.zeros((0, n)), np.zeros((0, n)),
+            np.ones(n), np.ones(n), -1)
+
+
 def predict_contrib(trees: List[Tree], X: np.ndarray, num_class: int) -> np.ndarray:
     n, nf = X.shape
     k = max(num_class, 1)
@@ -150,12 +270,15 @@ def predict_contrib(trees: List[Tree], X: np.ndarray, num_class: int) -> np.ndar
         if tree.num_leaves <= 1:
             out[:, kk, nf] += tree.leaf_value[0] if len(tree.leaf_value) else 0.0
             continue
-        expected = tree.expected_value()
-        out[:, kk, nf] += expected
-        for r in range(n):
-            phi = np.zeros(nf + 1, np.float64)
-            _tree_shap(tree, X[r], phi, 0, [], 1.0, 1.0, -1)
-            out[r, kk, :nf] += phi[:nf]
+        out[:, kk, nf] += tree.expected_value()
+        # chunk rows: the batched recursion keeps O(depth^2 * chunk) copies
+        # of the path arrays alive along the DFS
+        for s in range(0, n, 16384):
+            e = min(s + 16384, n)
+            dec = _all_decisions(tree, X[s:e])
+            phi = np.zeros((e - s, nf + 1), np.float64)
+            _tree_shap_batch(tree, dec, phi)
+            out[s:e, kk, :nf] += phi[:, :nf]
     if k == 1:
         return out[:, 0, :]
     return out.reshape(n, k * (nf + 1))

@@ -66,6 +66,10 @@ class Tree:
     cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
     shrinkage: float = 1.0
     is_linear: bool = False
+    # linear-tree fields (reference: tree.h leaf_const_/leaf_coeff_/leaf_features_)
+    leaf_const: Optional[np.ndarray] = None        # (num_leaves,) float64
+    leaf_features: Optional[List[List[int]]] = None
+    leaf_coeff: Optional[List[List[float]]] = None
 
     # LightGBM decision_type bit layout (reference: tree.h kCategoricalMask etc.)
     _CAT_MASK = 1
@@ -89,12 +93,17 @@ class Tree:
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs] for cs in self.leaf_coeff]
 
     def add_bias(self, bias: float) -> None:
         """Fold a constant into the tree (reference: Tree::AddBias, used by
         boost_from_average so saved models are self-contained, gbdt.cpp:425)."""
         self.leaf_value = self.leaf_value + bias
         self.internal_value = self.internal_value + bias
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const = self.leaf_const + bias
 
     # ------------------------------------------------------------------
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
@@ -144,7 +153,26 @@ class Tree:
             node[sel] = nxt
             active = node >= 0
         out_leaf = np.where(out_leaf < 0, 0, out_leaf)
+        if self.is_linear and self.leaf_const is not None:
+            return self._linear_output(X, out_leaf)
         return self.leaf_value[out_leaf]
+
+    def _linear_output(self, X: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+        """Linear-leaf prediction: const + coeff . x; rows with NaN in any
+        used feature fall back to the regular constant leaf output
+        (reference: Tree::Predict linear branch, tree.h)."""
+        out = self.leaf_const[leaf].astype(np.float64).copy()
+        for ln in range(self.num_leaves):
+            feats = self.leaf_features[ln] if self.leaf_features else []
+            rows = np.where(leaf == ln)[0]
+            if len(rows) == 0 or not feats:
+                continue
+            sub = X[np.ix_(rows, feats)]
+            nan_rows = np.isnan(sub).any(axis=1)
+            lin = sub @ np.asarray(self.leaf_coeff[ln], np.float64)
+            out[rows] = np.where(nan_rows, self.leaf_value[ln],
+                                 out[rows] + lin)
+        return out
 
     def predict_leaf_raw(self, X: np.ndarray) -> np.ndarray:
         """Leaf index per row (pred_leaf path)."""

@@ -1,0 +1,54 @@
+"""Forced splits from a JSON file (reference: serial_tree_learner.cpp:628
+ForceSplits, config forcedsplits_filename)."""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=2000, seed=12):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 5)
+    y = X[:, 0] + 2 * X[:, 1] + 0.1 * rs.randn(n)
+    return X, y
+
+
+def test_forced_splits_applied(tmp_path):
+    X, y = _data()
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps({
+        "feature": 3, "threshold": 0.0,
+        "left": {"feature": 4, "threshold": 0.5},
+    }))
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "forcedsplits_filename": str(fs)},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in bst._all_trees():
+        # node 0 must split feature 3 at ~0.0; its left child splits feature 4
+        assert int(t.split_feature[0]) == 3
+        assert abs(float(t.threshold[0])) < 0.2
+        lc = int(t.left_child[0])
+        assert lc >= 0 and int(t.split_feature[lc]) == 4
+        assert abs(float(t.threshold[lc]) - 0.5) < 0.25
+    # model still fits despite the forced structure
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
+
+
+def test_forced_splits_too_deep_raises(tmp_path):
+    X, y = _data()
+    node = {"feature": 0, "threshold": 0.0}
+    root = node
+    for _ in range(5):
+        child = {"feature": 0, "threshold": 0.0}
+        node["left"] = child
+        node["right"] = {"feature": 1, "threshold": 0.0}
+        node = child
+    fs = tmp_path / "deep.json"
+    fs.write_text(json.dumps(root))
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({"objective": "regression", "num_leaves": 4,
+                   "verbosity": -1, "forcedsplits_filename": str(fs)},
+                  lgb.Dataset(X, label=y), num_boost_round=1)

@@ -1,0 +1,65 @@
+"""Linear trees (reference: src/treelearner/linear_tree_learner.cpp,
+arxiv 1802.05640 Eq 3; model grammar src/io/tree.cpp:384-408)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_data(n=2000, seed=4):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 5)
+    # piecewise-linear target: trees with linear leaves fit this much better
+    y = np.where(X[:, 0] > 0, 3.0 * X[:, 1] + 1.0, -2.0 * X[:, 1]) \
+        + 0.05 * rs.randn(n)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 20, "learning_rate": 0.2}
+
+
+def test_linear_tree_beats_constant_leaves():
+    X, y = _linear_data()
+    d1 = lgb.Dataset(X, label=y)
+    const = lgb.train(PARAMS, d1, num_boost_round=10)
+    d2 = lgb.Dataset(X, label=y)
+    lin = lgb.train({**PARAMS, "linear_tree": True}, d2, num_boost_round=10)
+    mse_c = float(np.mean((const.predict(X) - y) ** 2))
+    mse_l = float(np.mean((lin.predict(X) - y) ** 2))
+    assert mse_l < mse_c * 0.7, (mse_l, mse_c)
+    # trees after the first carry real linear models
+    trees = lin._all_trees()
+    assert trees[0].is_linear
+    assert any(any(len(c) > 0 for c in t.leaf_coeff) for t in trees[1:])
+
+
+def test_linear_tree_model_roundtrip(tmp_path):
+    X, y = _linear_data(seed=6)
+    bst = lgb.train({**PARAMS, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    p1 = bst.predict(X)
+    path = str(tmp_path / "linear.txt")
+    bst.save_model(path)
+    txt = open(path).read()
+    assert "is_linear=1" in txt
+    assert "leaf_const=" in txt and "leaf_coeff=" in txt
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), p1, rtol=1e-6, atol=1e-8)
+
+
+def test_linear_tree_nan_fallback():
+    X, y = _linear_data(seed=8)
+    Xn = X.copy()
+    bst = lgb.train({**PARAMS, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    Xn[:50, 1] = np.nan
+    p = bst.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+def test_linear_tree_guards():
+    X, y = _linear_data(seed=9)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({**PARAMS, "linear_tree": True, "boosting": "dart"},
+                  lgb.Dataset(X, label=y), num_boost_round=2)

@@ -1,0 +1,53 @@
+"""Quantized-gradient training (reference: gradient_discretizer.cpp,
+use_quantized_grad / num_grad_quant_bins / quant_train_renew_leaf /
+stochastic_rounding config)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=3000, seed=8):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 8)
+    logit = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2]
+    y = (rs.rand(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+        "min_data_in_leaf": 5, "max_bin": 63}
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    r = np.empty(len(p))
+    r[order] = np.arange(len(p))
+    npos = y.sum()
+    return (r[y > 0.5].sum() - npos * (npos - 1) / 2) / (npos * (len(y) - npos))
+
+
+def test_quantized_close_to_fp32():
+    X, y = _data()
+    b_fp = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=20)
+    b_q = lgb.train({**BASE, "use_quantized_grad": True},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    auc_fp = _auc(y, b_fp.predict(X))
+    auc_q = _auc(y, b_q.predict(X))
+    assert auc_q > auc_fp - 0.01, (auc_q, auc_fp)
+
+
+def test_quantized_renew_leaf():
+    X, y = _data(seed=9)
+    b = lgb.train({**BASE, "use_quantized_grad": True,
+                   "quant_train_renew_leaf": True},
+                  lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _auc(y, b.predict(X)) > 0.8
+
+
+def test_quantized_bins_and_rounding_params():
+    X, y = _data(seed=10)
+    for extra in ({"num_grad_quant_bins": 16},
+                  {"stochastic_rounding": False}):
+        b = lgb.train({**BASE, "use_quantized_grad": True, **extra},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+        assert _auc(y, b.predict(X)) > 0.75
