@@ -134,6 +134,30 @@ class GBDT:
                               monotone=self._monotone_array(),
                               interaction_groups=self._interaction_group_masks(),
                               forced=self._parse_forced_splits()))
+        self._voting = False
+        if config.tree_learner == "voting" and self.mesh is not None:
+            from ..parallel.voting import (grow_tree_voting,
+                                           make_voting_splitter,
+                                           voting_supported)
+            if voting_supported(dd.layout, dd.routing) and \
+                    not self._grow_params.has_categorical:
+                gp = self._grow_params
+                S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+                sp_root = make_voting_splitter(self.mesh, 1, dd.max_bins,
+                                               config.top_k, config)
+                sp = make_voting_splitter(self.mesh, 2 * S, dd.max_bins,
+                                          config.top_k, config)
+
+                def _vote_fn(bins, g, h, mask, colm, key=None, packed=None):
+                    return grow_tree_voting(bins, g, h, mask, colm,
+                                            sp_root, sp, gp)
+
+                self._grow_fn = jax.jit(_vote_fn)
+                self._voting = True
+            else:
+                log_warning(
+                    "tree_learner=voting requires a numeric, unbundled, "
+                    "NaN-free layout; falling back to data-parallel")
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
         self._finished_check_every = (
