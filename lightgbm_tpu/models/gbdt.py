@@ -133,12 +133,30 @@ class GBDT:
                               params=self._grow_params,
                               monotone=self._monotone_array(),
                               interaction_groups=self._interaction_group_masks(),
-                              forced=self._parse_forced_splits()))
+                              forced=self._parse_forced_splits(),
+                              cegb_coupled=self._cegb_coupled_array()))
+        self._cegb_used = (jnp.zeros(dd.num_features, bool)
+                           if self._grow_params.has_cegb else None)
         self._voting = False
         if config.tree_learner == "voting" and self.mesh is not None:
             from ..parallel.voting import (grow_tree_voting,
                                            make_voting_splitter,
                                            voting_supported)
+            gp0 = self._grow_params
+            incompatible = (gp0.has_monotone or gp0.has_interaction
+                            or gp0.has_cegb or gp0.extra_trees
+                            or gp0.bynode_fraction < 1.0
+                            or gp0.path_smooth > 0.0
+                            or self._parse_forced_splits() is not None)
+            if incompatible:
+                raise LightGBMError(
+                    "tree_learner=voting does not support monotone/"
+                    "interaction constraints, forced splits, path smoothing, "
+                    "extra_trees, feature_fraction_bynode, or cegb_*; remove "
+                    "those parameters or use tree_learner=data")
+            if config.top_k <= 0:
+                raise LightGBMError("top_k should be greater than 0, got "
+                                    f"{config.top_k}")
             if voting_supported(dd.layout, dd.routing) and \
                     not self._grow_params.has_categorical:
                 gp = self._grow_params
@@ -148,7 +166,8 @@ class GBDT:
                 sp = make_voting_splitter(self.mesh, 2 * S, dd.max_bins,
                                           config.top_k, config)
 
-                def _vote_fn(bins, g, h, mask, colm, key=None, packed=None):
+                def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
+                             cegb_used=None):
                     return grow_tree_voting(bins, g, h, mask, colm,
                                             sp_root, sp, gp)
 
@@ -255,7 +274,20 @@ class GBDT:
             extra_trees=c.extra_trees,
             bynode_fraction=c.feature_fraction_bynode,
             hist_two_pass=(c.hist_precision == "mixed"),
+            has_cegb=(c.cegb_penalty_split > 0.0
+                      or (c.cegb_penalty_feature_coupled is not None
+                          and len(np.atleast_1d(
+                              c.cegb_penalty_feature_coupled)) > 0)),
+            cegb_tradeoff=c.cegb_tradeoff,
+            cegb_penalty_split=c.cegb_penalty_split,
         )
+
+    def _cegb_coupled_array(self):
+        c = self.config
+        v = c.cegb_penalty_feature_coupled
+        if v is None or len(np.atleast_1d(v)) == 0:
+            return None
+        return jnp.asarray(np.atleast_1d(v), jnp.float32)
 
     def _parse_forced_splits(self):
         """forcedsplits_filename JSON -> static per-level split spec
@@ -374,12 +406,17 @@ class GBDT:
         def _nonempty(v):
             return v is not None and len(np.atleast_1d(v)) > 0
 
-        if c.cegb_tradeoff != 1.0 or c.cegb_penalty_split != 0.0 or \
-                _nonempty(c.cegb_penalty_feature_lazy) or \
-                _nonempty(c.cegb_penalty_feature_coupled):
+        if _nonempty(c.cegb_penalty_feature_lazy):
             raise LightGBMError(
-                "cegb_* (cost-effective gradient boosting) is not implemented in "
-                "lightgbm_tpu yet; remove the cegb_ parameters")
+                "cegb_penalty_feature_lazy (per-row on-demand feature costs) is "
+                "not implemented; cegb_penalty_split and "
+                "cegb_penalty_feature_coupled are supported")
+        if _nonempty(c.cegb_penalty_feature_coupled) and \
+                len(np.atleast_1d(c.cegb_penalty_feature_coupled)) != \
+                self.dd.num_features:
+            raise LightGBMError(
+                "cegb_penalty_feature_coupled should be the same size as the "
+                "feature count")
         if c.linear_tree and self.boosting_type in ("dart", "rf"):
             raise LightGBMError(
                 f"linear_tree is not supported with boosting="
@@ -491,7 +528,15 @@ class GBDT:
                     (self.config.extra_seed or 3) * 1000003
                     + self.iter_ * (k + 1) + kk)
             arrays, leaf_id = self._grow_fn(self.dd.bins, g, h, mask, col_mask,
-                                            key=gkey, packed=self._packed)
+                                            key=gkey, packed=self._packed,
+                                            cegb_used=self._cegb_used)
+            if self._cegb_used is not None:
+                L = self._grow_params.num_leaves
+                ni_mask = jnp.arange(L) < (arrays.num_leaves - 1)
+                f_oh = jax.nn.one_hot(arrays.split_feature,
+                                      self.dd.num_features, dtype=jnp.int32)
+                self._cegb_used = self._cegb_used | jnp.any(
+                    (f_oh > 0) & ni_mask[:, None], axis=0)
             if self.config.use_quantized_grad and \
                     self.config.quant_train_renew_leaf:
                 arrays = self._renew_leaves_exact(arrays, leaf_id, grad_raw,

@@ -62,6 +62,10 @@ class GrowParams(NamedTuple):
     extra_trees: bool = False
     bynode_fraction: float = 1.0
     hist_two_pass: bool = True   # two-pass bf16 hist weights (f32-accurate)
+    # cost-effective gradient boosting (cost_effective_gradient_boosting.hpp)
+    has_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 class RoutingLayout(NamedTuple):
@@ -98,6 +102,7 @@ class _GrowState(NamedTuple):
     out_hi: jax.Array           # (L,) f32 — upper bound
     leaf_out: jax.Array         # (L,) f32 — constrained/smoothed output of each leaf
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
+    cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
     round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
     best_gain: jax.Array
     best_feat: jax.Array
@@ -132,7 +137,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               params: GrowParams, monotone: Optional[jax.Array] = None,
               interaction_groups: Optional[jax.Array] = None,
               key: Optional[jax.Array] = None,
-              packed=None, forced=None) -> Tuple[TreeArrays, jax.Array]:
+              packed=None, forced=None, cegb_coupled=None,
+              cegb_used=None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
     grad/hess must already include any bagging mask; cnt_w is the mask itself.
@@ -157,6 +163,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     use_smooth = params.path_smooth > 0.0
     use_output = use_mono or use_smooth
     use_bynode = params.bynode_fraction < 1.0 and key is not None
+    use_cegb = params.has_cegb
     use_extra = params.extra_trees and key is not None
     BIG = jnp.asarray(1e30, f32)
 
@@ -176,6 +183,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         monotone_penalty=params.monotone_penalty,
         path_smooth=params.path_smooth,
     )
+
+    def cegb_pen(counts, used_mask):
+        """(R, F) CEGB gain penalty (DeltaGain, cegb hpp:80): tradeoff *
+        (penalty_split * n_leaf + coupled[f] * not-yet-used)."""
+        pen = params.cegb_tradeoff * params.cegb_penalty_split * counts[:, None]
+        if cegb_coupled is not None:
+            pen = pen + params.cegb_tradeoff * cegb_coupled[None, :] * \
+                (~used_mask)[None, :]
+        return jnp.broadcast_to(pen, (counts.shape[0], F))
 
     def node_col_mask(base_mask, used_feat_rows, rkey, rows):
         """Per-node feature mask: tree-level sampling & interaction-allowed &
@@ -247,8 +263,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               jnp.zeros((1, F), bool),
                               jax.random.fold_in(key, 0) if key is not None else None,
                               rows=1)
+    cegb_used0 = (cegb_used if cegb_used is not None
+                  else jnp.zeros(F, bool)) if use_cegb else None
     root_split = find_splits(
         root_hist, root_g[None], root_h[None], root_c[None], col_mask=root_mask,
+        cegb_penalty=cegb_pen(root_c[None], cegb_used0) if use_cegb else None,
         out_lo=(-BIG[None]) if use_output else None,
         out_hi=(BIG[None]) if use_output else None,
         slot_depth=jnp.zeros(1, i32) if use_mono else None,
@@ -275,6 +294,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_out=(jnp.zeros(L, f32).at[0].set(root_out)
                   if use_output else jnp.zeros(1, f32)),
         used_feat=used0,
+        cegb_used=(cegb_used if use_cegb and cegb_used is not None
+                   else jnp.zeros(F if use_cegb else 1, bool)),
         round_idx=jnp.asarray(0, i32),
         best_gain=jnp.full(L, NEG_INF, f32).at[0].set(root_split.gain[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(root_split.feature[0]),
@@ -510,6 +531,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 st2 = st2._replace(
                     used_feat=st2.used_feat.at[old_idx].set(new_used, mode="drop")
                                            .at[new_idx].set(new_used, mode="drop"))
+            if use_cegb:
+                f_m = jnp.where(pair_valid, feat, F + 1)
+                st2 = st2._replace(cegb_used=st2.cegb_used.at[f_m].set(
+                    True, mode="drop"))
 
             # ---- histograms: build smaller child, subtract for larger ----
             smaller_id = jnp.where(smaller_is_left, pair_old, pair_new)
@@ -546,7 +571,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               slot_depth=st2.depth[ids2] if use_mono else None,
                               parent_out=st2.leaf_out[ids2] if use_output else None,
                               extra_key=(jax.random.fold_in(key, 100000 + st.round_idx)
-                                         if use_extra else None))
+                                         if use_extra else None),
+                              cegb_penalty=(cegb_pen(st2.cnt[ids2],
+                                                     st2.cegb_used)
+                                            if use_cegb else None))
             ids2_m = jnp.where(valid2, ids2, drop)
             st2 = st2._replace(
                 best_gain=st2.best_gain.at[ids2_m].set(res.gain, mode="drop"),

@@ -178,6 +178,7 @@ def find_best_splits(
     path_smooth: float = 0.0,
     parent_out: Optional[jax.Array] = None,  # (S,) parent (smoothed) outputs
     extra_key: Optional[jax.Array] = None,   # PRNG key — extra_trees random thresholds
+    cegb_penalty: Optional[jax.Array] = None,  # (S, F) gain penalty (CEGB)
 ) -> SplitResult:
     """Monotone constraints use the reference's "basic" method
     (monotone_constraints.hpp BasicLeafConstraints): candidate outputs are clipped
@@ -264,6 +265,11 @@ def find_best_splits(
         # numeric-only fast path: much smaller compiled program (no per-bin argsort)
         best_t = jnp.argmax(num_rel, axis=-1)
         best_gain_f = jnp.take_along_axis(num_rel, best_t[..., None], -1)[..., 0]
+        if cegb_penalty is not None:
+            # cost-effective gradient boosting: subtract the split cost from
+            # every candidate's gain (cost_effective_gradient_boosting.hpp:80)
+            best_gain_f = jnp.where(best_gain_f > NEG_INF / 2,
+                                    best_gain_f - cegb_penalty, NEG_INF)
         if col_mask is not None:
             cm = jnp.broadcast_to(jnp.asarray(col_mask, bool), best_gain_f.shape)
             best_gain_f = jnp.where(cm, best_gain_f, NEG_INF)
@@ -345,6 +351,9 @@ def find_best_splits(
     gain_t = jnp.where(is_cat, cat_rel, num_rel)           # (S, F, Bmax) rel gains
     best_t = jnp.argmax(gain_t, axis=-1)                   # (S, F)
     best_gain_f = jnp.take_along_axis(gain_t, best_t[..., None], -1)[..., 0]
+    if cegb_penalty is not None:
+        best_gain_f = jnp.where(best_gain_f > NEG_INF / 2,
+                                best_gain_f - cegb_penalty, NEG_INF)
 
     if col_mask is not None:
         cm = jnp.broadcast_to(jnp.asarray(col_mask, bool), best_gain_f.shape)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.split import leaf_output, leaf_term
 from ..tree import TreeArrays
 from ..utils.log import log_warning
 from .mesh import DATA_AXIS
@@ -47,12 +48,10 @@ def _per_feature_best(hist, parent_g, parent_h, parent_c, lambda_l1, lambda_l2,
     ph = parent_h[:, None, None]
     pc = parent_c[:, None, None]
 
-    def term(g, h):
-        t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - lambda_l1, 0.0)
-        return t * t / (h + lambda_l2 + 1e-15)
-
     rg, rh, rc = pg - cg, ph - ch, pc - cc
-    gain = term(cg, ch) + term(rg, rh) - term(pg, ph)
+    gain = (leaf_term(cg, ch, lambda_l1, lambda_l2)
+            + leaf_term(rg, rh, lambda_l1, lambda_l2)
+            - leaf_term(pg, ph, lambda_l1, lambda_l2))
     ok = ((cc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
           (ch >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
     B = hist.shape[2]
@@ -204,8 +203,8 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
     f32, i32 = jnp.float32, jnp.int32
 
     def leaf_out(g, h):
-        t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - params.lambda_l1, 0.0)
-        return -t / (h + params.lambda_l2 + 1e-15)
+        return leaf_output(g, h, params.lambda_l1, params.lambda_l2,
+                           params.max_delta_step)
 
     root_g, root_h, root_c = jnp.sum(grad), jnp.sum(hess), jnp.sum(cnt_w)
     g0, f0, t0, lg0, lh0, lc0 = splitter_root(

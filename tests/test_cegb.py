@@ -1,0 +1,47 @@
+"""Cost-effective gradient boosting (reference:
+src/treelearner/cost_effective_gradient_boosting.hpp:80 DeltaGain —
+split-count and coupled feature-acquisition penalties)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+        "min_data_in_leaf": 5}
+
+
+def _data(seed=3, n=3000):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 10)
+    # features 5-9 carry real signal so the unpenalized model uses them
+    y = X[:, 0] * 2 + X[:, 1] + X[:, 5] + 0.5 * X[:, 6] + 0.1 * rs.randn(n)
+    return X, y
+
+
+def test_coupled_feature_penalty_suppresses_costly_features():
+    X, y = _data()
+    b0 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    b1 = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                    "cegb_penalty_feature_coupled": [0.0] * 5 + [1e6] * 5},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    imp0 = b0.feature_importance()
+    imp1 = b1.feature_importance()
+    assert imp0[5:].sum() > 0, "baseline should use the signal features"
+    assert imp1[5:].sum() < imp0[5:].sum()
+
+
+def test_split_penalty_shrinks_trees():
+    X, y = _data(seed=5)
+    b0 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    b1 = lgb.train({**BASE, "cegb_penalty_split": 2.0},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    l0 = sum(t.num_leaves for t in b0._all_trees())
+    l1 = sum(t.num_leaves for t in b1._all_trees())
+    assert l1 < l0
+
+
+def test_lazy_penalty_raises():
+    X, y = _data(seed=6)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({**BASE, "cegb_penalty_feature_lazy": [1.0] * 10},
+                  lgb.Dataset(X, label=y), num_boost_round=2)

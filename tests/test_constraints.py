@@ -128,11 +128,11 @@ def test_path_smooth_and_extra_trees():
 def test_unimplemented_params_raise():
     X = np.random.rand(100, 3)
     y = np.random.rand(100)
-    # linear_tree / use_quantized_grad / forcedsplits_filename are implemented
-    # now (see test_linear_tree / test_quantized / test_forced_splits); cegb
-    # remains unimplemented and must fail loudly, as must invalid enums and a
-    # missing forced-splits file
-    for bad in ({"cegb_penalty_split": 1.0},
+    # linear_tree / use_quantized_grad / forcedsplits_filename / cegb split+
+    # coupled penalties are implemented now (see their test files); the lazy
+    # cegb penalty remains unimplemented and must fail loudly, as must invalid
+    # enums and a missing forced-splits file
+    for bad in ({"cegb_penalty_feature_lazy": [1.0, 1.0, 1.0]},
                 {"hist_precision": "double"},
                 {"forcedsplits_filename": "/nonexistent/f.json"}):
         ds = lgb.Dataset(X, label=y)
