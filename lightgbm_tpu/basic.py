@@ -74,6 +74,33 @@ class Dataset:
         self._categorical_feature_arg = categorical_feature
         self._predictor = None
 
+        if isinstance(data, (str, Path)) and self._is_binary_file(data):
+            if reference is not None:
+                raise LightGBMError(
+                    "a binary dataset file carries its own bin mappers; "
+                    "reference= cannot be combined with it")
+            self.raw_data = None
+            self._pandas_names = None
+            self._pandas_cat_idx = []
+            self.binned = None
+            self._device = None
+            self._resolved_feature_names = None
+            self.label = self.weight = self.init_score = None
+            self.position = self.group = None
+            self._load_binary(str(data))
+            # explicit constructor arguments override the stored metadata,
+            # matching the non-binary path's semantics
+            if label is not None:
+                self.label = np.asarray(label, np.float64).reshape(-1)
+            if weight is not None:
+                self.weight = np.asarray(weight, np.float64).reshape(-1)
+            if init_score is not None:
+                self.init_score = np.asarray(init_score, np.float64)
+            if position is not None:
+                self.position = np.asarray(position, np.int32).reshape(-1)
+            if group is not None:
+                self.group = np.asarray(group, np.int64).reshape(-1)
+            return
         if isinstance(data, (str, Path)):
             from .dataset_io import load_data_file
             data, label_file, extras = load_data_file(str(data), self.params)
@@ -104,6 +131,14 @@ class Dataset:
         self.binned: Optional[BinnedData] = None
         self._device: Optional[DeviceData] = None
         self._resolved_feature_names: Optional[List[str]] = None
+
+    @classmethod
+    def _is_binary_file(cls, path) -> bool:
+        try:
+            with open(path, "rb") as f:
+                return f.read(len(cls._BINARY_MAGIC)) == cls._BINARY_MAGIC
+        except OSError:
+            return False
 
     # ------------------------------------------------------------------
     def _resolve_categorical(self) -> List[int]:
@@ -304,15 +339,46 @@ class Dataset:
             params=params or self.params)
         return sub
 
+    _BINARY_MAGIC = b"LGBTPU.BIN.v1\n"
+
     def save_binary(self, filename: str) -> "Dataset":
-        """Serialize the binned dataset (reference: Dataset::SaveBinaryFile)."""
+        """Serialize the binned dataset (reference: Dataset::SaveBinaryFile);
+        load it back by passing the file path to Dataset().
+
+        SECURITY: the format is a Python pickle — loading executes code from
+        the file. Only open binary dataset files you created yourself (the
+        same trust model as loading any pickle)."""
         import pickle
         self.construct()
         with open(filename, "wb") as f:
+            f.write(self._BINARY_MAGIC)
             pickle.dump({"binned": self.binned, "label": self.label,
                          "weight": self.weight, "group": self.group,
+                         "position": self.position,
+                         "num_data": self.num_data_,
+                         "num_feature": self.num_feature_,
+                         "feature_names": self.feature_name(),
                          "init_score": self.init_score}, f)
         return self
+
+    def _load_binary(self, path: str) -> None:
+        """Restore a save_binary file (reference: DatasetLoader::
+        LoadFromBinFile) — the raw matrix is NOT stored; prediction-time
+        rebinning is unavailable, training works as usual."""
+        import pickle
+        with open(path, "rb") as f:
+            f.read(len(self._BINARY_MAGIC))
+            blob = pickle.load(f)
+        self.binned = blob["binned"]
+        self.label = blob["label"]
+        self.weight = blob["weight"]
+        self.group = blob["group"]
+        self.position = blob.get("position")
+        self.init_score = blob["init_score"]
+        self.num_data_ = blob["num_data"]
+        self.num_feature_ = blob["num_feature"]
+        self._resolved_feature_names = blob.get("feature_names")
+        self.raw_data = None
 
     def add_features_from(self, other: "Dataset") -> "Dataset":
         if self.raw_data is None or other.raw_data is None:

@@ -27,6 +27,7 @@ from ..ops.split import leaf_output
 from ..ops.predict import StackedTrees, _walk_one_tree
 from ..tree import Tree, TreeArrays, finalize_tree
 from ..utils.log import LightGBMError, log_info, log_warning
+from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
 
 
@@ -142,24 +143,22 @@ class GBDT:
             from ..parallel.voting import (grow_tree_voting,
                                            make_voting_splitter,
                                            voting_supported)
-            gp0 = self._grow_params
-            incompatible = (gp0.has_monotone or gp0.has_interaction
-                            or gp0.has_cegb or gp0.extra_trees
-                            or gp0.bynode_fraction < 1.0
-                            or gp0.path_smooth > 0.0
-                            or self._parse_forced_splits() is not None)
-            if incompatible:
-                raise LightGBMError(
-                    "tree_learner=voting does not support monotone/"
-                    "interaction constraints, forced splits, path smoothing, "
-                    "extra_trees, feature_fraction_bynode, or cegb_*; remove "
-                    "those parameters or use tree_learner=data")
-            if config.top_k <= 0:
-                raise LightGBMError("top_k should be greater than 0, got "
-                                    f"{config.top_k}")
             if voting_supported(dd.layout, dd.routing) and \
                     not self._grow_params.has_categorical:
                 gp = self._grow_params
+                if (gp.has_monotone or gp.has_interaction or gp.has_cegb
+                        or gp.extra_trees or gp.bynode_fraction < 1.0
+                        or gp.path_smooth > 0.0
+                        or self._parse_forced_splits() is not None):
+                    raise LightGBMError(
+                        "tree_learner=voting does not support monotone/"
+                        "interaction constraints, forced splits, path "
+                        "smoothing, extra_trees, feature_fraction_bynode, or "
+                        "cegb_*; remove those parameters or use "
+                        "tree_learner=data")
+                if config.top_k <= 0:
+                    raise LightGBMError(
+                        f"top_k should be greater than 0, got {config.top_k}")
                 S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
                 sp_root = make_voting_splitter(self.mesh, 1, dd.max_bins,
                                                config.top_k, config)
@@ -202,7 +201,8 @@ class GBDT:
             return
         pending = self._lazy_trees
         self._lazy_trees = []
-        got = jax.device_get([e["arrays"] for e in pending])
+        with global_timer.scope("GBDT::FinalizeTrees"):
+            got = jax.device_get([e["arrays"] for e in pending])
         mappers = self.train_data.bin_mappers()
         for e, arrays in zip(pending, got):
             tree = finalize_tree(arrays, mappers, None, learning_rate=e["rate"])
@@ -498,7 +498,8 @@ class GBDT:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
         Returns True if no further training is possible (all-zero trees)."""
         if grad is None or hess is None:
-            grad, hess = self._boost()
+            with global_timer.scope("GBDT::Boosting"):
+                grad, hess = self._boost()
         else:
             grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
             hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
@@ -527,9 +528,10 @@ class GBDT:
                 gkey = jax.random.PRNGKey(
                     (self.config.extra_seed or 3) * 1000003
                     + self.iter_ * (k + 1) + kk)
-            arrays, leaf_id = self._grow_fn(self.dd.bins, g, h, mask, col_mask,
-                                            key=gkey, packed=self._packed,
-                                            cegb_used=self._cegb_used)
+            with global_timer.scope("GBDT::TrainTree"):
+                arrays, leaf_id = self._grow_fn(
+                    self.dd.bins, g, h, mask, col_mask, key=gkey,
+                    packed=self._packed, cegb_used=self._cegb_used)
             if self._cegb_used is not None:
                 L = self._grow_params.num_leaves
                 ni_mask = jnp.arange(L) < (arrays.num_leaves - 1)

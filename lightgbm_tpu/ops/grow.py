@@ -225,7 +225,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                             stream_block_rows)
         T_rows = stream_block_rows(Bmax)
         if packed is None:
-            bins_T = pack_bins_T(bins, T_rows).bins_T
+            with jax.named_scope("pack_bins"):
+                bins_T = pack_bins_T(bins, T_rows).bins_T
         else:
             # bare array (int metadata would turn into tracers as a jit arg)
             bins_T = packed.bins_T if hasattr(packed, "bins_T") else packed
@@ -294,8 +295,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_out=(jnp.zeros(L, f32).at[0].set(root_out)
                   if use_output else jnp.zeros(1, f32)),
         used_feat=used0,
-        cegb_used=(cegb_used if use_cegb and cegb_used is not None
-                   else jnp.zeros(F if use_cegb else 1, bool)),
+        cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
         round_idx=jnp.asarray(0, i32),
         best_gain=jnp.full(L, NEG_INF, f32).at[0].set(root_split.gain[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(root_split.feature[0]),
@@ -459,11 +459,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 tabs = build_route_tables(
                     leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
                     leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
-                new_leaf_row, hist_small = route_and_hist(
-                    bins_T, st.leaf_id.reshape(1, -1), w_T, tabs, bits_l.T,
-                    S, Bmax, G, L, block_rows=T_rows,
-                    has_cat=params.has_categorical,
-                    two_pass=params.hist_two_pass)
+                with jax.named_scope("route_and_hist"):
+                    new_leaf_row, hist_small = route_and_hist(
+                        bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
+                        bits_l.T, S, Bmax, G, L, block_rows=T_rows,
+                        has_cat=params.has_categorical,
+                        two_pass=params.hist_two_pass)
                 new_leaf_id = new_leaf_row.reshape(-1)
             else:
                 leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset,
@@ -564,7 +565,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                    st2.used_feat[ids2] if use_inter
                                    else jnp.zeros((2 * S, F), bool),
                                    rkey, rows=2 * S)
-            res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2], st2.cnt[ids2],
+            with jax.named_scope("find_splits"):
+                res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
+                              st2.cnt[ids2],
                               col_mask=cmask2,
                               out_lo=st2.out_lo[ids2] if use_output else None,
                               out_hi=st2.out_hi[ids2] if use_output else None,
