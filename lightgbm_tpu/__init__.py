@@ -15,7 +15,7 @@ from .utils.log import LightGBMError, register_logger
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "train", "cv", "CVBooster",
+    "Dataset", "Booster", "train", "cv", "CVBooster", "init_distributed",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "LightGBMError", "register_logger",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
@@ -32,4 +32,7 @@ def __getattr__(name):
                 "plot_split_value_histogram"):
         from . import plotting as _pl
         return getattr(_pl, name)
+    if name == "init_distributed":
+        from .parallel.launcher import init_distributed
+        return init_distributed
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

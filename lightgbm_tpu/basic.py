@@ -24,11 +24,48 @@ from .utils.log import LightGBMError, log_info, log_warning, set_verbosity
 _LABEL_FIELDS = ("label", "weight", "group", "init_score", "position")
 
 
+def _mappers_compatible(a, b) -> bool:
+    """True when two bin-mapper lists bin identically (CheckAlign analog)."""
+    if a is b:
+        return True
+    if len(a) != len(b):
+        return False
+    for ma, mb in zip(a, b):
+        if ma.bin_type != mb.bin_type:
+            return False
+        ua, ub = np.asarray(ma.upper_bounds), np.asarray(mb.upper_bounds)
+        if ua.shape != ub.shape or not np.array_equal(ua, ub):
+            return False
+    return True
+
+
 def _to_2d_float(data) -> Tuple[np.ndarray, Optional[List[str]], List[int]]:
     """Coerce supported data containers to float64 ndarray; returns
-    (array, feature_names or None, pandas_categorical_indices)."""
+    (array, feature_names or None, pandas_categorical_indices).
+
+    Accepts ndarray/DataFrame, a LIST of row chunks (the reference's
+    ChunkedArray streaming-push ingestion, include/LightGBM/c_api.h
+    LGBM_DatasetCreateFromMats), and pyarrow Table/RecordBatch
+    (include/LightGBM/arrow.h)."""
     feature_names = None
     cat_idx: List[int] = []
+    if isinstance(data, (list, tuple)) and data and all(
+            (getattr(c, "ndim", 0) == 2) or hasattr(c, "columns")
+            for c in data):
+        # chunked 2-D row blocks (list-of-1-D stays the plain ndarray path)
+        converted = [_to_2d_float(c) for c in data]
+        names0, cats0 = converted[0][1], converted[0][2]
+        return np.vstack([c[0] for c in converted]), names0, cats0
+    t_name = type(data).__module__
+    if t_name.startswith("pyarrow"):
+        import pyarrow as pa
+        if isinstance(data, pa.RecordBatch):
+            data = pa.Table.from_batches([data])
+        if isinstance(data, pa.Table):
+            feature_names = [str(c) for c in data.column_names]
+            cols = [np.asarray(data.column(i).to_numpy(zero_copy_only=False),
+                               np.float64) for i in range(data.num_columns)]
+            return np.column_stack(cols), feature_names, []
     if hasattr(data, "dtypes") and hasattr(data, "columns"):  # pandas DataFrame
         import pandas as pd
         feature_names = [str(c) for c in data.columns]
@@ -100,6 +137,8 @@ class Dataset:
                 self.position = np.asarray(position, np.int32).reshape(-1)
             if group is not None:
                 self.group = np.asarray(group, np.int64).reshape(-1)
+            if isinstance(feature_name, list):
+                self._resolved_feature_names = [str(x) for x in feature_name]
             return
         if isinstance(data, (str, Path)):
             from .dataset_io import load_data_file
@@ -489,9 +528,10 @@ class Booster:
                 data.reference = self.train_set
             data.construct()
             # reference behavior: GBDT::AddValidDataset fatals on mismatched
-            # bin mappers (src/boosting/gbdt.cpp CheckAlign)
-            if data.binned.bin_mappers is not \
-                    self.train_set.binned.bin_mappers:
+            # bin mappers (src/boosting/gbdt.cpp CheckAlign); equality (not
+            # just identity) matters for datasets reloaded from binary files
+            if not _mappers_compatible(data.binned.bin_mappers,
+                                       self.train_set.binned.bin_mappers):
                 raise LightGBMError(
                     "cannot add validation data, since it has different bin "
                     "mappers with training data (construct it with "
@@ -591,18 +631,47 @@ class Booster:
             return predict_contrib(use, X, k)
 
         n = X.shape[0]
+        early_stop = bool(kwargs.get("pred_early_stop", False))
+        es_freq = int(kwargs.get("pred_early_stop_freq", 10))
+        es_margin = float(kwargs.get("pred_early_stop_margin", 10.0))
         # init scores are folded into tree 0 at training time (AddBias), so a plain
         # sum over trees is the complete raw score
-        score = self._try_device_predict(X, use, k)
+        score = None if early_stop else self._try_device_predict(X, use, k)
         if score is None:
             if k == 1:
                 score = np.zeros(n, np.float64)
-                for t in use:
-                    score += t.predict_raw(X)
+                active = np.ones(n, bool)
+                all_active = True
+                for i, t in enumerate(use):
+                    if early_stop and not all_active:
+                        score[active] += t.predict_raw(X[active])
+                    else:
+                        score += t.predict_raw(X)
+                    if early_stop and (i + 1) % es_freq == 0:
+                        # reference: prediction_early_stop.cpp CreateBinary —
+                        # rows whose margin 2|score| clears the threshold stop
+                        # accumulating further trees
+                        active &= ~(2.0 * np.abs(score) > es_margin)
+                        all_active = bool(active.all())
+                        if not active.any():
+                            break
             else:
                 score = np.zeros((n, k), np.float64)
+                active = np.ones(n, bool)
+                all_active = True
                 for i, t in enumerate(use):
-                    score[:, i % k] += t.predict_raw(X)
+                    if early_stop and not all_active:
+                        score[active, i % k] += t.predict_raw(X[active])
+                    else:
+                        score[:, i % k] += t.predict_raw(X)
+                    if early_stop and (i + 1) % (es_freq * k) == 0:
+                        # CreateMulticlass: top-1 minus top-2 margin
+                        part = np.partition(score, -2, axis=1)
+                        margin = part[:, -1] - part[:, -2]
+                        active &= ~(margin > es_margin)
+                        all_active = bool(active.all())
+                        if not active.any():
+                            break
         if self._average_output() and len(use):
             score = score / max(len(use) // max(k, 1), 1)
         if raw_score:

@@ -61,3 +61,34 @@ def test_efb_bundling_with_nan_matches_unbundled():
     mse_b = float(np.mean((pr_b - y) ** 2))
     mse_p = float(np.mean((pr_p - y) ** 2))
     assert mse_b < mse_p * 1.25 + 1e-3, (mse_b, mse_p)
+
+
+def test_binary_valid_set_workflow(tmp_path):
+    """save train.bin + valid.bin, reload BOTH, train with the reloaded
+    valid set (reference LoadFromBinFile parity)."""
+    rs = np.random.RandomState(9)
+    X = rs.randn(1200, 5)
+    y = X[:, 0] * 2 + 0.1 * rs.randn(1200)
+    Xv = rs.randn(300, 5)
+    yv = Xv[:, 0] * 2 + 0.1 * rs.randn(300)
+    tr = lgb.Dataset(X, label=y)
+    tr.save_binary(str(tmp_path / "train.bin"))
+    lgb.Dataset(Xv, label=yv, reference=tr).save_binary(
+        str(tmp_path / "valid.bin"))
+
+    tr2 = lgb.Dataset(str(tmp_path / "train.bin"))
+    va2 = lgb.Dataset(str(tmp_path / "valid.bin"))
+    ev = {}
+    lgb.train({"objective": "regression", "num_leaves": 15, "verbosity": -1,
+               "min_data_in_leaf": 5}, tr2, num_boost_round=5,
+              valid_sets=[va2], valid_names=["v"],
+              callbacks=[lgb.record_evaluation(ev)])
+    assert len(ev["v"]["l2"]) == 5
+    assert ev["v"]["l2"][-1] < ev["v"]["l2"][0]
+
+
+def test_chunk_list_of_1d_is_a_matrix():
+    """A list of 1-D arrays is a plain (rows, cols) matrix, NOT row chunks."""
+    X = [np.array([1.0, 2.0, 3.0]), np.array([4.0, 5.0, 6.0])]
+    ds = lgb.Dataset(X, label=[0.0, 1.0])
+    assert ds.num_data() == 2 and ds.num_feature() == 3

@@ -1,0 +1,87 @@
+"""Chunked/arrow ingestion, prediction early stop, distributed launcher.
+
+Reference: c_api.h LGBM_DatasetCreateFromMats (chunked mats),
+include/LightGBM/arrow.h, src/boosting/prediction_early_stop.cpp,
+python-package/lightgbm/dask.py (launcher analog)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _model(n=2000, seed=4, k=1):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    if k == 1:
+        y = (X[:, 0] + X[:, 1] > 0).astype(float)
+        obj = {"objective": "binary"}
+    else:
+        y = np.clip((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5), 0, k - 1)
+        obj = {"objective": "multiclass", "num_class": k}
+        y = y.astype(float)
+    bst = lgb.train({**obj, "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    return bst, X, y
+
+
+def test_chunked_ingestion_matches_single():
+    rs = np.random.RandomState(3)
+    X = rs.randn(1500, 5)
+    y = X @ rs.rand(5)
+    chunks = [X[:500], X[500:900], X[900:]]
+    p = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5}
+    b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+    b2 = lgb.train(p, lgb.Dataset(chunks, label=y), num_boost_round=5)
+    assert b1.model_to_string() == b2.model_to_string()
+
+
+def test_pyarrow_table_ingestion():
+    pa = pytest.importorskip("pyarrow")
+    rs = np.random.RandomState(5)
+    X = rs.randn(800, 4)
+    y = X[:, 0] * 2 + 0.1 * rs.randn(800)
+    table = pa.table({f"f{i}": X[:, i] for i in range(4)})
+    ds = lgb.Dataset(table, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=5)
+    assert ds.feature_name() == ["f0", "f1", "f2", "f3"]
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
+
+
+def test_pred_early_stop_binary():
+    bst, X, y = _model()
+    p_full = bst.predict(X, raw_score=True)
+    p_es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=5, pred_early_stop_margin=2.0)
+    # confident rows freeze early: same SIGN everywhere, close where margin
+    # is small, possibly different magnitude where it stopped early
+    assert ((p_es > 0) == (p_full > 0)).mean() > 0.98
+    small = np.abs(p_full) < 0.5
+    if small.any():
+        np.testing.assert_allclose(p_es[small], p_full[small], atol=1.0)
+    # a tiny margin must cut more tree evaluations than a huge one: proxy via
+    # difference from the full prediction
+    p_tiny = bst.predict(X, raw_score=True, pred_early_stop=True,
+                         pred_early_stop_freq=1, pred_early_stop_margin=0.01)
+    assert np.abs(p_tiny - p_full).mean() >= np.abs(p_es - p_full).mean()
+
+
+def test_pred_early_stop_multiclass():
+    bst, X, y = _model(k=3)
+    p_full = bst.predict(X)
+    p_es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=3,
+                       pred_early_stop_margin=3.0)
+    assert (p_full.argmax(1) == p_es.argmax(1)).mean() > 0.97
+
+
+def test_init_distributed_single_process():
+    # single-process call either succeeds (process_count 1 after init) or
+    # raises the library error — never an unhandled backend exception
+    try:
+        lgb.init_distributed(num_processes=1, process_id=0,
+                             coordinator_address="127.0.0.1:41999")
+    except lgb.LightGBMError:
+        pass
