@@ -65,6 +65,9 @@ def main():
         "verbosity": -1,
         "max_splits_per_round": 64,
     }
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
     ds = lgb.Dataset(X_tr, label=y_tr)
     bst = lgb.Booster(params, ds)
     # warmup: compile + first tree

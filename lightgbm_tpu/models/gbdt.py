@@ -180,6 +180,10 @@ class GBDT:
                                 or self._grow_params.extra_trees)
         self._finished_check_every = (
             16 if jax.default_backend() in ("tpu", "axon") else 1)
+        # Pallas leaf-value gather: single-device TPU only (a mesh shards the
+        # row axis; XLA partitions the plain gather there instead)
+        self._use_leaf_gather_kernel = (
+            jax.default_backend() in ("tpu", "axon") and self.mesh is None)
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         self._saved_state: Optional[Tuple] = None
 
@@ -239,15 +243,17 @@ class GBDT:
         return "pallas" if on_tpu else "segsum"
 
     def _stream_fits(self) -> bool:
-        """The fused streaming kernel keeps the whole (G*B, 3S) histogram block
+        """The fused streaming kernel keeps the whole (G*B, 2S) histogram block
         and the (L, T) leaf one-hot resident in VMEM (~16 MB/core)."""
         L = max(self.config.num_leaves, 2)
-        S = 3 * min(max(1, self.config.max_splits_per_round), max(L - 1, 1))
+        S = 2 * min(max(1, self.config.max_splits_per_round), max(L - 1, 1))
         G = self.dd.num_groups
         Bpad = -(-self.dd.max_bins // 8) * 8
         hist_bytes = G * Bpad * S * 4
+        onehot_bytes = G * Bpad * 1024 * 2      # (G*B, T) bf16 MXU operand
         return (L <= 2048 and G <= 512 and hist_bytes <= 8 * 2 ** 20
-                and S <= 3 * 255)   # slot ids must stay bf16-exact (<= 255)
+                and onehot_bytes <= 8 * 2 ** 20
+                and S <= 2 * 255)   # slot ids must stay bf16-exact (<= 255)
 
     def _make_grow_params(self) -> GrowParams:
         c = self.config
@@ -562,9 +568,16 @@ class GBDT:
                 delta = jnp.zeros(n_pad_rows, jnp.float32).at[
                     :self.num_data].set(jnp.asarray(delta_np, jnp.float32))
             else:
-                # score update: gather (reference: ScoreUpdater::AddScore);
+                # score update (reference: ScoreUpdater::AddScore);
                 # single-leaf trees have leaf_value 0, so no branch is needed
-                delta = arrays.leaf_value[leaf_id] * self._shrinkage_rate()
+                lv = arrays.leaf_value * self._shrinkage_rate()
+                if self._use_leaf_gather_kernel:
+                    from ..pallas.stream_kernel import leaf_gather
+                    # XLA's small-table row gather runs ~100M rows/s; the
+                    # streaming one-hot contraction runs at bandwidth
+                    delta = leaf_gather(leaf_id, lv)
+                else:
+                    delta = lv[leaf_id]
                 # tree finalization is DEFERRED (see `models` property);
                 # record the init-score bias to fold at materialization time
                 # so saved models stay self-contained (reference: gbdt.cpp:425)

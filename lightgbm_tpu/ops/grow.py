@@ -30,9 +30,10 @@ import numpy as np
 
 from ..tree import TreeArrays
 from .histogram import build_histograms
-from .split import (NEG_INF, FeatureLayout, SplitResult, categorical_left_bitset,
-                    constrained_child_outputs, find_best_splits,
-                    gather_feature_histograms, leaf_output, smooth_output)
+from .split import (NEG_INF, EPS_HESS, FeatureLayout, SplitResult,
+                    categorical_left_bitset, constrained_child_outputs,
+                    find_best_splits, gather_feature_histograms, leaf_output,
+                    round_int, smooth_output)
 
 
 class GrowParams(NamedTuple):
@@ -239,7 +240,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                    zL.at[0].set(1), routing, L)
         bits0 = jnp.zeros((Bpad, L), jnp.bfloat16)
         leaf_id = jnp.zeros(n_pad, i32)
-        _, root_hist = route_and_hist(
+        _, root_hist, _ = route_and_hist(
             bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
             1, Bmax, G, L, block_rows=T_rows,
             has_cat=params.has_categorical, two_pass=params.hist_two_pass)
@@ -253,7 +254,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_id = jnp.zeros(N, i32)
         root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
                                      backend=params.hist_backend,
-                                     bins_packed=bins_packed)
+                                     bins_packed=bins_packed)[..., :2]
     root_g = jnp.sum(grad)
     root_h = jnp.sum(hess)
     root_c = jnp.sum(cnt_w)
@@ -275,7 +276,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         parent_out=root_out[None] if use_output else None,
         extra_key=jax.random.fold_in(key, 1) if use_extra else None)
 
-    hist = jnp.zeros((L, G, Bmax, 3), f32).at[0].set(root_hist[0])
+    hist = jnp.zeros((L, G, Bmax, 2), f32).at[0].set(root_hist[0])
     state = _GrowState(
         leaf_id=leaf_id,
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
@@ -347,8 +348,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               st.cnt[pair_old])
                 # left sums from the leaf histogram at the forced threshold
                 hf_f = gather_feature_histograms(st.hist[pair_old], layout,
-                                                 pg, ph, pc)
-                hsel = hf_f[jnp.arange(S), feat]             # (S, Bmax, 3)
+                                                 pg, ph)
+                hsel = hf_f[jnp.arange(S), feat]             # (S, Bmax, 2)
                 bin_le = (jnp.arange(Bmax)[None, :] <= thr[:, None])
                 nanb = routing.nan_bin[feat]                 # (S,)
                 nan_part = jnp.where(
@@ -360,7 +361,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                       == nanb[:, None]))) | nan_part
                 lg = jnp.sum(jnp.where(take, hsel[..., 0], 0.0), axis=1)
                 lh = jnp.sum(jnp.where(take, hsel[..., 1], 0.0), axis=1)
-                lc = jnp.sum(jnp.where(take, hsel[..., 2], 0.0), axis=1)
+                lc = round_int(lh * pc / jnp.maximum(ph, EPS_HESS))
                 gain = jnp.zeros(S, f32)
                 rg, rh, rc = pg - lg, ph - lh, pc - lc
             else:
@@ -397,13 +398,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 rg, rh, rc = pg - lg, ph - lh, pc - lc
 
             # ---- categorical bitsets for the chosen splits ----
-            parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 3)
+            parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 2)
             if params.has_categorical:
-                hf = gather_feature_histograms(parent_hist, layout, pg, ph, pc)
-                hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 3)
+                hf = gather_feature_histograms(parent_hist, layout, pg, ph)
+                hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 2)
                 bitset = categorical_left_bitset(
                     hf_feat, thr, dirf, layout.valid_mask[feat],
-                    params.cat_smooth, params.min_data_per_group)  # (S, Bmax)
+                    params.cat_smooth, params.min_data_per_group,
+                    pc / jnp.maximum(ph, EPS_HESS))               # (S, Bmax)
             else:
                 bitset = jnp.zeros((S, Bmax), bool)
 
@@ -460,7 +462,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
                     leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
                 with jax.named_scope("route_and_hist"):
-                    new_leaf_row, hist_small = route_and_hist(
+                    new_leaf_row, hist_small, slot_cnt = route_and_hist(
                         bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
                         bits_l.T, S, Bmax, G, L, block_rows=T_rows,
                         has_cat=params.has_categorical,
@@ -487,6 +489,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 new_leaf_id = jnp.where(r_chosen & ~go_left,
                                         leaf_new_id[st.leaf_id], st.leaf_id)
 
+            # ---- histograms for the smaller children + EXACT slot counts ----
+            smaller_id_pre = jnp.where(smaller_is_left, pair_old, pair_new)
+            if not use_stream:   # stream path built these in the fused kernel
+                slot_map = jnp.full(L, -1, i32).at[
+                    jnp.where(pair_valid, smaller_id_pre, drop)].set(
+                        jnp.arange(S), mode="drop")
+                slot = slot_map[new_leaf_id]
+                hist3 = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
+                                         backend=params.hist_backend,
+                                         bins_packed=bins_packed)
+                hist_small = hist3[..., :2]
+                # any one group's bins partition the slot's rows, so group 0's
+                # count channel sums to the exact per-slot data count
+                slot_cnt = hist3[:, 0, :, 2].sum(axis=-1)
+
+            # exact child counts from the routed partition (reference:
+            # serial_tree_learner.cpp:798 overwrites the estimated SplitInfo
+            # counts with DataPartition::leaf_count after the split)
+            lc_x = jnp.where(smaller_is_left, slot_cnt, pc - slot_cnt)
+            rc_x = pc - lc_x
+
             # ---- per-leaf stats for the children ----
             st2 = st2._replace(
                 leaf_id=new_leaf_id,
@@ -494,8 +517,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               .at[new_idx].set(rg, mode="drop"),
                 sum_h=st2.sum_h.at[old_idx].set(lh, mode="drop")
                               .at[new_idx].set(rh, mode="drop"),
-                cnt=st2.cnt.at[old_idx].set(lc, mode="drop")
-                          .at[new_idx].set(rc, mode="drop"),
+                cnt=st2.cnt.at[old_idx].set(lc_x, mode="drop")
+                          .at[new_idx].set(rc_x, mode="drop"),
                 depth=st2.depth.at[new_idx].set(st.depth[pair_old] + 1, mode="drop")
                               .at[old_idx].set(st.depth[pair_old] + 1, mode="drop"),
             )
@@ -537,17 +560,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 st2 = st2._replace(cegb_used=st2.cegb_used.at[f_m].set(
                     True, mode="drop"))
 
-            # ---- histograms: build smaller child, subtract for larger ----
-            smaller_id = jnp.where(smaller_is_left, pair_old, pair_new)
+            # ---- histogram subtraction for the larger siblings ----
+            smaller_id = smaller_id_pre
             larger_id = jnp.where(smaller_is_left, pair_new, pair_old)
-            if not use_stream:   # stream path built hist_small in the fused kernel
-                slot_map = jnp.full(L, -1, i32).at[
-                    jnp.where(pair_valid, smaller_id, drop)].set(jnp.arange(S),
-                                                                 mode="drop")
-                slot = slot_map[new_leaf_id]
-                hist_small = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
-                                              backend=params.hist_backend,
-                                              bins_packed=bins_packed)
             hist_large = parent_hist - hist_small
             sm_idx = jnp.where(pair_valid, smaller_id, drop)
             lg_idx = jnp.where(pair_valid, larger_id, drop)
@@ -602,18 +617,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     # streaming rounds: round r can split at most 2^r leaves, and the
     # fused kernel cost is linear in the slot budget S — run the first
     # log2(S) rounds as specialized small-S bodies, then loop at full S
-    if use_stream and S > 4:
-        # round r can split at most 2^r leaves; run the first rounds with
-        # small static split budgets (kernel MXU cost is linear in S) while
-        # keeping the number of distinct compiled bodies at 2 (compile time)
-        prefix = [4, 4, 4] + ([16, 16] if S > 16 else [])
-        bodies = {}
-        for s_r in prefix:
-            s_eff = min(s_r, S)
-            if s_eff not in bodies:
-                bodies[s_eff] = make_body(s_eff)
-            state = jax.lax.cond(cond(state), bodies[s_eff],
-                                 lambda s: s, state)
+    if use_stream and S > 64:
+        # the kernel's MXU cost is quantized to 128-column tiles of the
+        # (T, 2S) operand, so any budget <= 64 costs one tile per round —
+        # rounds are only worth specializing down to a 64 budget.  Round r
+        # can split at most 2^r leaves, so 7 budget-64 rounds cover growth
+        # to 128 leaves before the full-S while_loop takes over.
+        b64 = make_body(64)
+        for _ in range(7):
+            state = jax.lax.cond(cond(state), b64, lambda s: s, state)
     final = jax.lax.while_loop(cond, make_body(S), state)
 
     if use_output:

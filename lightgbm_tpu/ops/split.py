@@ -121,21 +121,29 @@ def _layout_is_identity(layout: FeatureLayout, num_groups: int,
     return bool(np.array_equal(idx, expect))
 
 
+def round_int(x):
+    """Common::RoundInt (common.h:911) — the reference derives per-bin data
+    counts from hessian sums as RoundInt(hess * cnt_factor) rather than
+    storing a count channel (feature_histogram.hpp:529,544)."""
+    return jnp.floor(x + 0.5)
+
+
 def gather_feature_histograms(hist: jax.Array, layout: FeatureLayout,
-                              parent_g: jax.Array, parent_h: jax.Array,
-                              parent_c: jax.Array) -> jax.Array:
-    """(S, G, Bmax, 3) group-padded hist -> (S, F, Bmax, 3) per-feature hist.
+                              *parents: jax.Array) -> jax.Array:
+    """(S, G, Bmax, C) group-padded hist -> (S, F, Bmax, C) per-feature hist
+    (C = 2 grad/hess channels; parents = the matching per-slot totals).
 
     Fills EFB-bundle shared-default bins by residual: default = parent_total -
     others.  When the layout is the identity (no bundling — the common dense
     case) the latency-bound (S*F*Bmax)-row gather is skipped entirely: on TPU
     that gather costs ~10 ms per round and would dominate split finding."""
-    s_dim, num_groups, bmax, _ = hist.shape
+    s_dim, num_groups, bmax, num_ch = hist.shape
+    assert len(parents) == num_ch
     if _layout_is_identity(layout, num_groups, bmax):
         hf = hist * layout.valid_mask[None, :, :, None]
     else:
-        flat = hist.reshape(s_dim, -1, 3)                 # (S, G*Bmax, 3)
-        hf = flat[:, layout.gather_idx, :]                # (S, F, Bmax, 3)
+        flat = hist.reshape(s_dim, -1, num_ch)            # (S, G*Bmax, C)
+        hf = flat[:, layout.gather_idx, :]                # (S, F, Bmax, C)
         hf = hf * layout.valid_mask[None, :, :, None]
     try:
         any_resid = bool((np.asarray(layout.residual_pos) >= 0).any())
@@ -146,8 +154,8 @@ def gather_feature_histograms(hist: jax.Array, layout: FeatureLayout,
     has_resid = layout.residual_pos >= 0                  # (F,)
     resid_oh = jax.nn.one_hot(jnp.maximum(layout.residual_pos, 0),
                               hf.shape[2], dtype=hf.dtype)          # (F, Bmax)
-    parent = jnp.stack([parent_g, parent_h, parent_c], -1)          # (S, 3)
-    resid = parent[:, None, :] - hf.sum(axis=2)                     # (S, F, 3)
+    parent = jnp.stack(parents, -1)                                 # (S, C)
+    resid = parent[:, None, :] - hf.sum(axis=2)                     # (S, F, C)
     hf = hf + (resid_oh * has_resid[:, None])[None, :, :, None] * resid[:, :, None, :]
     return hf
 
@@ -188,8 +196,14 @@ def find_best_splits(
     restriction of monotone constraints to numerical features)."""
     S, G, Bmax, _ = hist.shape
     F = layout.gather_idx.shape[0]
-    hf = gather_feature_histograms(hist, layout, parent_g, parent_h, parent_c)
-    hg, hh, hc = hf[..., 0], hf[..., 1], hf[..., 2]       # (S, F, Bmax)
+    hf = gather_feature_histograms(hist, layout, parent_g, parent_h)
+    hg, hh = hf[..., 0], hf[..., 1]                       # (S, F, Bmax)
+    # per-bin data counts are ESTIMATED from hessians exactly like the
+    # reference (feature_histogram.hpp:529,544: cnt_factor = num_data /
+    # sum_hessian; cnt = RoundInt(hess * cnt_factor)) — histograms carry
+    # only grad/hess channels
+    cnt_factor = parent_c / jnp.maximum(parent_h, EPS_HESS)
+    hc = round_int(hh * cnt_factor[:, None, None])        # (S, F, Bmax)
 
     pg = parent_g[:, None, None]
     ph = parent_h[:, None, None]
@@ -411,13 +425,16 @@ def find_best_splits(
 
 def categorical_left_bitset(hist_f: jax.Array, threshold: jax.Array,
                             dir_flags: jax.Array, valid_mask: jax.Array,
-                            cat_smooth: float, min_data_per_group: int) -> jax.Array:
+                            cat_smooth: float, min_data_per_group: int,
+                            cnt_factor: jax.Array) -> jax.Array:
     """Recompute the left-side bin membership mask (Bmax,) for a chosen categorical split.
 
     For one-hot splits the mask is a single bin; for sorted-subset splits it is the
     first/last k bins of the g/(h+cat_smooth) ordering (reference: feature_histogram.hpp
-    categorical best-subset selection)."""
-    hg, hh, hc = hist_f[..., 0], hist_f[..., 1], hist_f[..., 2]
+    categorical best-subset selection). cnt_factor (per slot) estimates bin counts
+    from hessians, as the reference does."""
+    hg, hh = hist_f[..., 0], hist_f[..., 1]
+    hc = round_int(hh * cnt_factor[..., None])
     Bmax = hg.shape[-1]
     eligible = valid_mask & (hc >= min_data_per_group)
     ratio = jnp.where(eligible, hg / (hh + cat_smooth), 1e10)

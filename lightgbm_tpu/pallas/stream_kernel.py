@@ -49,6 +49,20 @@ from .hist_kernel import _wsplit  # shared f32 -> (hi, lo) bf16 split
 NUM_TAB = 24          # per-leaf table rows (padded to a sublane multiple)
 MAX_SLOTS = 255       # slot table rows are single bf16 digits (exact <= 256)
 _INTERPRET = False    # flipped by tests to run on CPU in interpret mode
+import os as _os
+# Perf-ablation probes (dev only): additive variants that double one kernel
+# phase so its cost can be measured through the real bench. Several modes
+# deliberately CORRUPT results — never set this for real training.
+_ABLATE = _os.environ.get("LGBTPU_KABLATE", "")
+_KNOWN_ABLATE = ("", "nohist", "constoh", "dblcon", "dblroute", "dblA",
+                 "dbldot", "dbldot_i8")
+if _ABLATE not in _KNOWN_ABLATE:
+    raise ValueError(f"unknown LGBTPU_KABLATE={_ABLATE!r}; one of "
+                     f"{_KNOWN_ABLATE[1:]}")
+if _ABLATE:
+    import sys as _sys
+    print(f"WARNING: LGBTPU_KABLATE={_ABLATE} perf probe active — training "
+          "results may be intentionally wrong", file=_sys.stderr)
 
 # table row indices
 (T_CHOSEN, T_NEWID_LO, T_NEWID_HI, T_WORD_LO, T_WORD_HI, T_SHIFT, T_SPAN,
@@ -63,7 +77,7 @@ def _digits(v):
 
 
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
-                       newleaf_ref, hist_ref, *, T, G, B, S, L, GW,
+                       newleaf_ref, hist_ref, cnt_ref, *, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True):
     b = pl.program_id(0)
     i32, bf16, f32 = jnp.int32, jnp.bfloat16, jnp.float32
@@ -124,44 +138,87 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     new_lid = jnp.where(chosen_i * (1 - go_left_i) > 0, newid, lid)  # (1, T)
     slot1 = jnp.where(chosen_i > 0,
                       jnp.where(go_left_i > 0, slot_l1, slot_r1), slot_k1)
+    if _ABLATE == "dblroute":    # perf probe: one extra route gather
+        leaf_oh2 = (l_iota == lid + L).astype(bf16)
+        vals2 = jax.lax.dot_general(
+            tabs_ref[...], leaf_oh2, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
+        new_lid = new_lid + vals2[0:1, :].astype(i32)
     newleaf_ref[0:1, :] = new_lid
 
     # ---------------- histogram ----------------
     @pl.when(b == 0)
     def _():
         hist_ref[...] = jnp.zeros_like(hist_ref)
+        cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
     slot = slot1 - 1
     s_iota = jax.lax.broadcasted_iota(i32, (S, T), 0)
     slot_oh = (s_iota == slot).astype(bf16)                  # (S, T)
-    w3 = w_ref[0:3, :]                                       # (3, T) f32
-    w_hi, w_lo = _wsplit(w3)
-    A_hi = (w_hi[:, None, :] * slot_oh[None, :, :]).reshape(3 * S, T)
-    b_iota = jax.lax.broadcasted_iota(i32, (B, T), 0)
+    w2 = w_ref[0:2, :]                                       # (2, T) f32
+    w_hi, w_lo = _wsplit(w2)
+
+    # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot; the reference's
+    # analog is DataPartition leaf counts, serial_tree_learner.cpp:798).
+    # Histograms themselves carry only grad/hess — per-bin counts are
+    # estimated from hessians at split-find time like the reference.
+    cnt_row = w_ref[2:3, :]                                  # (1, T) f32
+    cnt_ref[0:1, :] += jax.lax.dot_general(
+        cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32)                          # (1, S)
+
+    def build_A(w):
+        # (1, T) x (S, T) broadcast-multiplies + sublane concat; the 3-D
+        # broadcast form lowers to a much slower relayout
+        return jnp.concatenate([w[c:c + 1, :] * slot_oh for c in range(2)],
+                               axis=0)                       # (2S, T)
+
+    A_hi = build_A(w_hi)
+    if _ABLATE == "dblA":        # perf probe: one extra A-operand build
+        A_hi = A_hi + build_A(w_lo) * bf16(0.0)
     dot = functools.partial(jax.lax.dot_general,
                             dimension_numbers=(((1,), (1,)), ((), ())),
                             preferred_element_type=f32)
+    # ONE (G*B, T) @ (T, 3S) contraction per block: per-group (B, T) dots
+    # have M=B=64 — half an MXU tile — so merging groups into a single
+    # one-hot doubles MXU utilisation (the dominant cost of training).
+    rows = []
+    for g in range(G):  # static unroll
+        word_g = bins_ref[g // 4:g // 4 + 1, :]
+        rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
+    bins_G = jnp.concatenate(rows, axis=0)                   # (G, T)
+    b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
+    oh = (bins_G[:, None, :] == b_iota3).astype(bf16).reshape(G * B, T)
+    if _ABLATE == "dblcon":      # perf probe: one extra (never-hit) construct
+        oh2 = (bins_G[:, None, :] == b_iota3 + B).astype(bf16)
+        oh = oh + oh2.reshape(G * B, T)
+    if _ABLATE == "nohist":      # fixed costs only (route + A + writes)
+        hist_ref[...] += jnp.sum(A_hi, axis=1)[None, :]
+        return
+    if _ABLATE == "constoh":     # dot with a constant operand (no one-hot)
+        oh = jnp.full((G * B, T), 0.5, bf16)
+    if _ABLATE == "dbldot":      # perf probe: one extra bf16 dot
+        hist_ref[...] += dot(oh, build_A(w_lo)) * 1e-30
+    if _ABLATE == "dbldot_i8":   # perf probe: one extra int8 dot
+        oh_i8 = (bins_G[:, None, :] == b_iota3).astype(jnp.int8)
+        a_i8 = build_A(w_lo).astype(jnp.int8)
+        d2 = jax.lax.dot_general(oh_i8.reshape(G * B, T), a_i8,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.int32)
+        hist_ref[...] += d2.astype(f32) * 1e-30
     if two_pass:
-        A_lo = (w_lo[:, None, :] * slot_oh[None, :, :]).reshape(3 * S, T)
-        for g in range(G):  # static unroll
-            word_g = bins_ref[g // 4:g // 4 + 1, :]
-            bg = jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF
-            oh = (b_iota == bg).astype(bf16)                 # (B, T)
-            hist_ref[g * B:(g + 1) * B, :] += dot(oh, A_hi) + dot(oh, A_lo)
+        A_lo = build_A(w_lo)
+        hist_ref[...] += dot(oh, A_hi) + dot(oh, A_lo)
     else:
         # single-precision weights (the reference's GPU default,
         # gpu_use_dp=false): one bf16 pass, f32 accumulation
-        for g in range(G):  # static unroll
-            word_g = bins_ref[g // 4:g // 4 + 1, :]
-            bg = jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF
-            oh = (b_iota == bg).astype(bf16)                 # (B, T)
-            hist_ref[g * B:(g + 1) * B, :] += dot(oh, A_hi)
+        hist_ref[...] += dot(oh, A_hi)
 
 
 def stream_block_rows(bmax: int) -> int:
     """Rows per kernel block. Measured on v5e: 4096-row blocks REGRESS 5x at
-    Bmax=64 (VMEM pressure from the (L,T) leaf one-hot and (3S,T) weight
-    operands kills the pipeline), so stay at 1024."""
+    Bmax=64 (VMEM pressure from the (L,T) leaf one-hot and weight operands
+    kills the pipeline), so stay at 1024."""
     return 1024
 
 
@@ -193,14 +250,15 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
                    has_cat: bool = True, two_pass: bool = True):
     """One fused streaming pass: route rows through this round's splits and
-    build the (S, G, Bmax, 3) histograms of the rows' NEW slots.
+    build grad/hess histograms and exact data counts of the rows' NEW slots.
 
     bins_T: (GW_pad, N_pad) i32 from pack_bins_T.
     leaf_id: (1, N_pad) i32 current leaf per row.
     w_T: (8, N_pad) f32, rows 0..2 = grad, hess, cnt (bagging mask applied).
     tabs: (NUM_TAB, L) f32 per-leaf split tables (see build_route_tables).
     bits: (L, Bpad) bf16 categorical left-side bitsets (dummy when !has_cat).
-    Returns (new_leaf_id (1, N_pad) i32, hist (S, G, Bmax, 3) f32).
+    Returns (new_leaf_id (1, N_pad) i32, hist (S, G, Bmax, 2) f32 grad/hess,
+    slot_cnt (S,) f32 exact per-slot data counts).
     """
     GW, n_pad = bins_T.shape
     T = block_rows
@@ -211,7 +269,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                          f"histogram slots per round, got {S}")
     B = -(-bmax // 8) * 8
 
-    new_leaf, hist = pl.pallas_call(
+    new_leaf, hist, cnt = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
                           has_cat=has_cat, two_pass=two_pass),
         grid=(NB,),
@@ -224,20 +282,65 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         ],
         out_specs=[
             pl.BlockSpec((1, T), lambda b: (0, b)),
-            pl.BlockSpec((G * B, 3 * S), lambda b: (0, 0)),
+            pl.BlockSpec((G * B, 2 * S), lambda b: (0, 0)),
+            pl.BlockSpec((1, S), lambda b: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((G * B, 3 * S), jnp.float32),
+            jax.ShapeDtypeStruct((G * B, 2 * S), jnp.float32),
+            jax.ShapeDtypeStruct((1, S), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_INTERPRET,
     )(bins_T, leaf_id, w_T, tabs, bits)
 
-    # (G*B, 3S) -> (S, G, Bmax, 3)
-    hist4 = hist.reshape(G, B, 3, S).transpose(3, 0, 1, 2)[:, :, :bmax, :]
-    return new_leaf, hist4
+    # (G*B, 2S) -> (S, G, Bmax, 2)
+    hist4 = hist.reshape(G, B, 2, S).transpose(3, 0, 1, 2)[:, :, :bmax, :]
+    return new_leaf, hist4, cnt.reshape(-1)
+
+
+def _leaf_gather_kernel(lid_ref, val_ref, out_ref, *, T, L):
+    i32, f32 = jnp.int32, jnp.float32
+    lid = lid_ref[0:1, :]
+    l_iota = jax.lax.broadcasted_iota(i32, (L, T), 0)
+    oh = (l_iota == lid).astype(f32)                         # (L, T)
+    # exactly one nonzero (1.0 * v) term per output column, so the f32 dot
+    # is BIT-EXACT — and at M=1 it is far off the critical path
+    out_ref[0:1, :] = jax.lax.dot_general(
+        val_ref[0:1, :], oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows",))
+def leaf_gather(leaf_id: jax.Array, values: jax.Array,
+                block_rows: int = 1024) -> jax.Array:
+    """values[leaf_id] as a streaming one-hot contraction (bit-exact).
+
+    XLA lowers small-table gathers over millions of rows to its generic
+    (slow, ~100M rows/s) gather; a (1, L) @ (L, T) one-hot dot runs at
+    streaming bandwidth instead.  Each output picks exactly one 1.0*value
+    product, so the f32 contraction reproduces values[leaf_id] exactly.
+    Reference analog: ScoreUpdater::AddScore (score_updater.hpp)."""
+    N = leaf_id.shape[0]
+    L = values.shape[0]
+    T = block_rows
+    n_pad = -(-N // T) * T
+    lid = jnp.pad(leaf_id.astype(jnp.int32), (0, n_pad - N)).reshape(1, -1)
+    out = pl.pallas_call(
+        functools.partial(_leaf_gather_kernel, T=T, L=L),
+        grid=(n_pad // T,),
+        in_specs=[
+            pl.BlockSpec((1, T), lambda b: (0, b)),
+            pl.BlockSpec((1, L), lambda b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T), lambda b: (0, b)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(lid, values.reshape(1, L).astype(jnp.float32))
+    return out.reshape(-1)[:N]
 
 
 def build_route_tables(leaf_chosen, leaf_feat, leaf_thr, leaf_dir, leaf_newid,
