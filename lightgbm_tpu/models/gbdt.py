@@ -31,6 +31,30 @@ from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
 
 
+def quantize_gh(grad, hess, key, num_bins: int, stochastic: bool):
+    """Gradient/hessian discretization onto a symmetric integer grid of
+    num_bins levels with stochastic rounding (reference:
+    src/treelearner/gradient_discretizer.cpp). Returns the grid-valued
+    grads/hessians plus the stacked (grad_scale, hess_scale) pair; on the
+    stream backend the integer grid feeds an int8 MXU contraction with exact
+    int32 histogram accumulation (the reference's int8/int16
+    quantized-histogram path, dense_bin.hpp)."""
+    half = max(num_bins, 2) / 2.0
+    kg, kh = jax.random.split(key)
+
+    def q(x, maxv, kq, lo):
+        scale = jnp.maximum(maxv, 1e-10) / half
+        u = jax.random.uniform(kq, x.shape) if stochastic else 0.5
+        qi = jnp.clip(jnp.floor(x / scale + u), lo, half)
+        return qi * scale, scale
+
+    gmax = jnp.max(jnp.abs(grad), axis=0)
+    hmax = jnp.max(hess, axis=0)
+    gq, gs = q(grad, gmax, kg, -half)
+    hq, hs = q(hess, hmax, kh, 0.0)
+    return gq, hq, jnp.stack([gs, hs])
+
+
 class GBDT:
     """The main booster (reference: src/boosting/gbdt.h GBDT class)."""
 
@@ -121,7 +145,8 @@ class GBDT:
             from ..pallas.stream_kernel import (pack_bins_T,
                                                stream_block_rows)
             packed = pack_bins_T(dd.bins,
-                                 stream_block_rows(dd.max_bins)).bins_T
+                                 stream_block_rows(dd.max_bins,
+                                                   dd.num_groups)).bins_T
         elif self._grow_params.hist_backend == "pallas":
             from ..pallas.hist_kernel import pack_bins
             packed = pack_bins(dd.bins)
@@ -166,7 +191,7 @@ class GBDT:
                                           config.top_k, config)
 
                 def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
-                             cegb_used=None):
+                             cegb_used=None, gh_scales=None):
                     return grow_tree_voting(bins, g, h, mask, colm,
                                             sp_root, sp, gp)
 
@@ -181,11 +206,16 @@ class GBDT:
         self._finished_check_every = (
             16 if jax.default_backend() in ("tpu", "axon") else 1)
         # Pallas leaf-value gather: single-device TPU only (a mesh shards the
-        # row axis; XLA partitions the plain gather there instead)
+        # row axis; XLA partitions the plain gather there instead). The
+        # kernel holds an (L, T) one-hot in VMEM, so bound L like the stream
+        # kernel does.
         self._use_leaf_gather_kernel = (
-            jax.default_backend() in ("tpu", "axon") and self.mesh is None)
+            jax.default_backend() in ("tpu", "axon") and self.mesh is None
+            and max(self.config.num_leaves, 2) <= 2048)
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         self._saved_state: Optional[Tuple] = None
+        self._grad_fn = None
+        self._score_add_fn = None
 
     # ------------------------------------------------------------------
     @property
@@ -280,6 +310,15 @@ class GBDT:
             extra_trees=c.extra_trees,
             bynode_fraction=c.feature_fraction_bynode,
             hist_two_pass=(c.hist_precision == "mixed"),
+            # int8 operand range, exact int32 accumulation bounds, and an
+            # even level count (odd counts clip to a non-integer +half grid
+            # value that the int8 kernel could not represent)
+            int_hist=(c.use_quantized_grad
+                      and self._resolve_hist_backend() == "stream"
+                      and c.num_grad_quant_bins <= 254
+                      and c.num_grad_quant_bins % 2 == 0
+                      and (c.num_grad_quant_bins / 2)
+                      * self.dd.bins.shape[0] < 2 ** 31),
             has_cegb=(c.cegb_penalty_split > 0.0
                       or (c.cegb_penalty_feature_coupled is not None
                           and len(np.atleast_1d(
@@ -499,32 +538,97 @@ class GBDT:
         pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, pad)
 
+    def _boost_padded(self):
+        """Gradients + pad masking as ONE compiled program. Eagerly, the
+        ~10-op gradient chain costs one runtime launch each (~0.5 ms fixed
+        overhead per launch on a tunneled TPU); fused it is one launch.
+        The objective's captured label/weight are rebound to jit arguments
+        during tracing (closure-captured device arrays embed as HLO
+        constants, which breaks remote compilation at 10M rows)."""
+        if self._grad_fn is None:
+            objective, num_data = self.objective, self.num_data
+            quant = self.config.use_quantized_grad
+            qbins = self.config.num_grad_quant_bins
+            qstoch = self.config.stochastic_rounding
+
+            def _fn(score, label, weight, pad_mask, qkey):
+                old_l = objective.label
+                old_w = getattr(objective, "weight", None)
+                objective.label = label
+                if hasattr(objective, "weight"):
+                    objective.weight = weight
+                try:
+                    g, h = objective.get_gradients(score[:num_data])
+                finally:
+                    objective.label = old_l
+                    if hasattr(objective, "weight"):
+                        objective.weight = old_w
+                n = score.shape[0]
+                if n != num_data:
+                    pad = [(0, n - num_data)] + [(0, 0)] * (g.ndim - 1)
+                    g, h = jnp.pad(g, pad), jnp.pad(h, pad)
+                pm = pad_mask if g.ndim == 1 else pad_mask[:, None]
+                g, h = g * pm, h * pm
+                if quant:
+                    gq, hq, sc = quantize_gh(g, h, qkey, qbins, qstoch)
+                    return g, h, gq, hq, sc
+                return g, h, g, h, None
+
+            self._grad_fn = jax.jit(_fn)
+        qkey = jax.random.PRNGKey(
+            (self.config.data_random_seed + 11) * 131071 + self.iter_)
+        return self._grad_fn(self.score, self.objective.label,
+                             getattr(self.objective, "weight", None),
+                             self._pad_mask, qkey)
+
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
         Returns True if no further training is possible (all-zero trees)."""
-        if grad is None or hess is None:
+        # ranking objectives close over O(n) per-bucket device arrays that a
+        # fused jit would embed as HLO constants (breaking remote compilation
+        # at scale), so they keep the eager gradient path
+        fast_path = (grad is None and hess is None
+                     and self.objective is not None
+                     and self.objective.jit_safe_gradients
+                     and not self.objective.is_ranking
+                     and not self.sample_strategy.is_active()
+                     and self._row_sharding is None)
+        quant_done = False
+        if fast_path:
+            # no bagging: the in-bag mask IS the pad mask, and the gradient
+            # chain (incl. quantization) runs as one fused program
             with global_timer.scope("GBDT::Boosting"):
-                grad, hess = self._boost()
+                (graw, hraw, grad, hess, q_scales) = self._boost_padded()
+            mask = self._pad_mask
+            quant_done = True
         else:
-            grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
-            hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
-        mask, grad, hess = self.sample_strategy.sample(self.iter_, grad, hess)
-        mask = self._shard_row_array(mask) * self._pad_mask
-        grad = self._shard_row_array(grad)
-        hess = self._shard_row_array(hess)
-        if grad.ndim == 2:
-            grad = grad * self._pad_mask[:, None]
-            hess = hess * self._pad_mask[:, None]
-        else:
-            grad = grad * self._pad_mask
-            hess = hess * self._pad_mask
+            if grad is None or hess is None:
+                with global_timer.scope("GBDT::Boosting"):
+                    grad, hess = self._boost()
+            else:
+                grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
+                hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
+            mask, grad, hess = self.sample_strategy.sample(self.iter_, grad, hess)
+            mask = self._shard_row_array(mask) * self._pad_mask
+            grad = self._shard_row_array(grad)
+            hess = self._shard_row_array(hess)
+            if grad.ndim == 2:
+                grad = grad * self._pad_mask[:, None]
+                hess = hess * self._pad_mask[:, None]
+            else:
+                grad = grad * self._pad_mask
+                hess = hess * self._pad_mask
 
         k = self.num_tree_per_iteration
         col_mask = self._feature_mask()
-        grad_raw, hess_raw = grad, hess
-        if self.config.use_quantized_grad:
-            grad, hess = self._quantize_gh(grad, hess)
+        if quant_done:
+            grad_raw, hess_raw, gh_scales = graw, hraw, q_scales
+        else:
+            grad_raw, hess_raw = grad, hess
+            gh_scales = None
+            if self.config.use_quantized_grad:
+                grad, hess, gh_scales = self._quantize_gh(grad, hess)
         new_arrays = []
         for kk in range(k):
             g = grad if k == 1 else grad[:, kk]
@@ -534,10 +638,14 @@ class GBDT:
                 gkey = jax.random.PRNGKey(
                     (self.config.extra_seed or 3) * 1000003
                     + self.iter_ * (k + 1) + kk)
+            sc = None
+            if gh_scales is not None:
+                sc = gh_scales if k == 1 else gh_scales[:, kk]
             with global_timer.scope("GBDT::TrainTree"):
                 arrays, leaf_id = self._grow_fn(
                     self.dd.bins, g, h, mask, col_mask, key=gkey,
-                    packed=self._packed, cegb_used=self._cegb_used)
+                    packed=self._packed, cegb_used=self._cegb_used,
+                    gh_scales=sc)
             if self._cegb_used is not None:
                 L = self._grow_params.num_leaves
                 ni_mask = jnp.arange(L) < (arrays.num_leaves - 1)
@@ -570,14 +678,27 @@ class GBDT:
             else:
                 # score update (reference: ScoreUpdater::AddScore);
                 # single-leaf trees have leaf_value 0, so no branch is needed
+                if self._use_leaf_gather_kernel and k == 1:
+                    # one fused launch: XLA's small-table row gather runs
+                    # ~100M rows/s; the streaming one-hot contraction runs
+                    # at bandwidth
+                    if self._score_add_fn is None:
+                        from ..pallas.stream_kernel import leaf_gather
+
+                        def _sadd(score, lid, lv, rate):
+                            return score + leaf_gather(lid, lv * rate)
+
+                        self._score_add_fn = jax.jit(_sadd)
+                    self.score = self._score_add_fn(
+                        self.score, leaf_id, arrays.leaf_value,
+                        jnp.float32(self._shrinkage_rate()))
+                    self._lazy_trees.append({"arrays": arrays,
+                                             "rate": self._shrinkage_rate(),
+                                             "bias": bias})
+                    new_arrays.append(arrays)
+                    continue
                 lv = arrays.leaf_value * self._shrinkage_rate()
-                if self._use_leaf_gather_kernel:
-                    from ..pallas.stream_kernel import leaf_gather
-                    # XLA's small-table row gather runs ~100M rows/s; the
-                    # streaming one-hot contraction runs at bandwidth
-                    delta = leaf_gather(leaf_id, lv)
-                else:
-                    delta = lv[leaf_id]
+                delta = lv[leaf_id]
                 # tree finalization is DEFERRED (see `models` property);
                 # record the init-score bias to fold at materialization time
                 # so saved models stay self-contained (reference: gbdt.cpp:425)
@@ -718,29 +839,10 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _quantize_gh(self, grad, hess):
-        """Gradient/hessian discretization onto a symmetric integer grid of
-        num_grad_quant_bins levels with stochastic rounding (reference:
-        src/treelearner/gradient_discretizer.cpp). On TPU the histogram pass
-        is a bf16 contraction either way, so the value of quantization here is
-        behavioral parity (regularization-by-rounding + exact renewal below),
-        not a separate int8 code path."""
-        c = self.config
-        half = max(c.num_grad_quant_bins, 2) / 2.0
-        key = jax.random.PRNGKey((c.data_random_seed + 11) * 131071 + self.iter_)
-        kg, kh = jax.random.split(key)
-
-        def q(x, maxv, kq, lo):
-            scale = jnp.maximum(maxv, 1e-10) / half
-            if c.stochastic_rounding:
-                u = jax.random.uniform(kq, x.shape)
-            else:
-                u = 0.5
-            qi = jnp.clip(jnp.floor(x / scale + u), lo, half)
-            return qi * scale
-
-        gmax = jnp.max(jnp.abs(grad), axis=0)
-        hmax = jnp.max(hess, axis=0)
-        return q(grad, gmax, kg, -half), q(hess, hmax, kh, 0.0)
+        key = jax.random.PRNGKey(
+            (self.config.data_random_seed + 11) * 131071 + self.iter_)
+        return quantize_gh(grad, hess, key, self.config.num_grad_quant_bins,
+                           self.config.stochastic_rounding)
 
     def _renew_leaves_exact(self, arrays: TreeArrays, leaf_id, grad_raw,
                             hess_raw, kk: int) -> TreeArrays:

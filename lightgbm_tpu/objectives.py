@@ -27,6 +27,9 @@ class ObjectiveFunction:
     num_model_per_iteration = 1
     is_ranking = False
     need_renew_leaf = False
+    # False when get_gradients does host-side (numpy) work and therefore
+    # cannot be traced inside a fused jit (e.g. position-debias lambdarank)
+    jit_safe_gradients = True
 
     def __init__(self, config: Config):
         self.config = config

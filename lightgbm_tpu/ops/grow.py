@@ -63,6 +63,7 @@ class GrowParams(NamedTuple):
     extra_trees: bool = False
     bynode_fraction: float = 1.0
     hist_two_pass: bool = True   # two-pass bf16 hist weights (f32-accurate)
+    int_hist: bool = False       # int8 quantized-gradient histograms (stream)
     # cost-effective gradient boosting (cost_effective_gradient_boosting.hpp)
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
@@ -139,7 +140,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               interaction_groups: Optional[jax.Array] = None,
               key: Optional[jax.Array] = None,
               packed=None, forced=None, cegb_coupled=None,
-              cegb_used=None) -> Tuple[TreeArrays, jax.Array]:
+              cegb_used=None,
+              gh_scales: Optional[jax.Array] = None
+              ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
     grad/hess must already include any bagging mask; cnt_w is the mask itself.
@@ -224,7 +227,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
                                             stream_block_rows)
-        T_rows = stream_block_rows(Bmax)
+        T_rows = stream_block_rows(Bmax, G)
         if packed is None:
             with jax.named_scope("pack_bins"):
                 bins_T = pack_bins_T(bins, T_rows).bins_T
@@ -232,8 +235,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             # bare array (int metadata would turn into tracers as a jit arg)
             bins_T = packed.bins_T if hasattr(packed, "bins_T") else packed
         n_pad = bins_T.shape[1]
+        use_int = params.int_hist and gh_scales is not None
+        if use_int:
+            # integer-valued rows for the int8 contraction; histograms come
+            # back as exact int32 sums and are unscaled to the usual
+            # grid-valued f32 (reference: gradient_discretizer.cpp)
+            inv_g = 1.0 / jnp.maximum(gh_scales[0], 1e-30)
+            inv_h = 1.0 / jnp.maximum(gh_scales[1], 1e-30)
+            w_grad, w_hess = grad * inv_g, hess * inv_h
+            hscale = gh_scales                                # (2,)
+        else:
+            w_grad, w_hess = grad, hess
         w_T = jnp.zeros((8, n_pad), f32)
-        w_T = (w_T.at[0, :N].set(grad).at[1, :N].set(hess)
+        w_T = (w_T.at[0, :N].set(w_grad).at[1, :N].set(w_hess)
                   .at[2, :N].set(cnt_w))
         zL = jnp.zeros(L, i32)
         tabs0 = build_route_tables(zL, zL, zL, zL, zL, zL, zL,
@@ -243,7 +257,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         _, root_hist, _ = route_and_hist(
             bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
             1, Bmax, G, L, block_rows=T_rows,
-            has_cat=params.has_categorical, two_pass=params.hist_two_pass)
+            has_cat=params.has_categorical, two_pass=params.hist_two_pass,
+            int_weights=use_int)
+        if use_int:
+            root_hist = root_hist.astype(f32) * hscale
     else:
         if params.hist_backend == "pallas":
             if packed is not None:
@@ -466,7 +483,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
                         bits_l.T, S, Bmax, G, L, block_rows=T_rows,
                         has_cat=params.has_categorical,
-                        two_pass=params.hist_two_pass)
+                        two_pass=params.hist_two_pass,
+                        int_weights=use_int)
+                if use_int:
+                    hist_small = hist_small.astype(f32) * hscale
                 new_leaf_id = new_leaf_row.reshape(-1)
             else:
                 leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset,

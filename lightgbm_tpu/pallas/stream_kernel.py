@@ -78,7 +78,8 @@ def _digits(v):
 
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, hist_ref, cnt_ref, *, T, G, B, S, L, GW,
-                       has_cat: bool, two_pass: bool = True):
+                       has_cat: bool, two_pass: bool = True,
+                       int_weights: bool = False):
     b = pl.program_id(0)
     i32, bf16, f32 = jnp.int32, jnp.bfloat16, jnp.float32
 
@@ -158,6 +159,36 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     w2 = w_ref[0:2, :]                                       # (2, T) f32
     w_hi, w_lo = _wsplit(w2)
 
+    if int_weights:
+        # Quantized-gradient histograms (reference: gradient_discretizer.cpp
+        # + the int8/int16 ConstructHistogram variants, dense_bin.hpp): the
+        # grow layer passes integer-valued grad/hess rows, the contraction
+        # runs on the int8 MXU (~25% faster than bf16 at these shapes), and
+        # int32 accumulation makes the histogram sums EXACT.
+        cnt_row = w_ref[2:3, :]
+        cnt_ref[0:1, :] += jax.lax.dot_general(
+            cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=f32)
+        # build A in i32 (Mosaic cannot legalize i8*i8 multiplies), then
+        # convert the (2S, T) operand to int8 once
+        slot_oh_i = (s_iota == slot).astype(i32)
+        w_i = jnp.round(w2).astype(i32)                      # int-valued rows
+        A_i8 = jnp.concatenate(
+            [w_i[c:c + 1, :] * slot_oh_i for c in range(2)],
+            axis=0).astype(jnp.int8)
+        rows = []
+        for g in range(G):  # static unroll
+            word_g = bins_ref[g // 4:g // 4 + 1, :]
+            rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8)
+                        & 0xFF)
+        bins_G = jnp.concatenate(rows, axis=0)               # (G, T)
+        b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
+        oh_i8 = (bins_G[:, None, :] == b_iota3).astype(jnp.int8)
+        hist_ref[...] += jax.lax.dot_general(
+            oh_i8.reshape(G * B, T), A_i8, (((1,), (1,)), ((), ())),
+            preferred_element_type=i32)
+        return
+
     # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot; the reference's
     # analog is DataPartition leaf counts, serial_tree_learner.cpp:798).
     # Histograms themselves carry only grad/hess — per-bin counts are
@@ -215,11 +246,20 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         hist_ref[...] += dot(oh, A_hi)
 
 
-def stream_block_rows(bmax: int) -> int:
-    """Rows per kernel block. Measured on v5e: 4096-row blocks REGRESS 5x at
-    Bmax=64 (VMEM pressure from the (L,T) leaf one-hot and weight operands
-    kills the pipeline), so stay at 1024."""
-    return 1024
+def stream_block_rows(bmax: int, num_groups: int = 28) -> int:
+    """Rows per kernel block. 2048 measures ~2% faster than 1024 on v5e when
+    the (G*B, T) bf16 one-hot operand stays within ~8 MB of VMEM; 4096
+    REGRESSES 5x (VMEM pressure kills the pipeline)."""
+    import os
+    env = os.environ.get("LGBTPU_BLOCK_ROWS")
+    if env:
+        return int(env)
+    if jax.default_backend() not in ("tpu", "axon"):
+        # CPU interpret mode: 2048-wide bf16 dots cross XLA:CPU's threshold
+        # into its Eigen DotThunk, which rejects bf16
+        return 1024
+    B = -(-bmax // 8) * 8
+    return 2048 if num_groups * B * 2048 * 2 <= 8 * 2 ** 20 else 1024
 
 
 class StreamLayout(NamedTuple):
@@ -244,11 +284,13 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024) -> StreamLayout:
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
                                              "num_leaves", "block_rows",
-                                             "has_cat", "two_pass"))
+                                             "has_cat", "two_pass",
+                                             "int_weights"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
-                   has_cat: bool = True, two_pass: bool = True):
+                   has_cat: bool = True, two_pass: bool = True,
+                   int_weights: bool = False):
     """One fused streaming pass: route rows through this round's splits and
     build grad/hess histograms and exact data counts of the rows' NEW slots.
 
@@ -269,9 +311,11 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                          f"histogram slots per round, got {S}")
     B = -(-bmax // 8) * 8
 
+    hist_dtype = jnp.int32 if int_weights else jnp.float32
     new_leaf, hist, cnt = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
-                          has_cat=has_cat, two_pass=two_pass),
+                          has_cat=has_cat, two_pass=two_pass,
+                          int_weights=int_weights),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),
@@ -287,7 +331,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((G * B, 2 * S), jnp.float32),
+            jax.ShapeDtypeStruct((G * B, 2 * S), hist_dtype),
             jax.ShapeDtypeStruct((1, S), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -295,7 +339,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         interpret=_INTERPRET,
     )(bins_T, leaf_id, w_T, tabs, bits)
 
-    # (G*B, 2S) -> (S, G, Bmax, 2)
+    # (G*B, 2S) -> (S, G, Bmax, 2); int histograms are unscaled by the caller
     hist4 = hist.reshape(G, B, 2, S).transpose(3, 0, 1, 2)[:, :, :bmax, :]
     return new_leaf, hist4, cnt.reshape(-1)
 

@@ -170,6 +170,9 @@ class LambdarankNDCG(ObjectiveFunction):
         # score adjustment + :303 UpdatePositionBiasFactors Newton step)
         self._positions = None
         if position is not None:
+            # stateful per-iteration bias update -> not traceable in a
+            # fused-gradient jit
+            self.jit_safe_gradients = False
             pos = np.asarray(position, np.int64).reshape(-1)
             if len(pos) != n:
                 raise LightGBMError(
@@ -243,6 +246,7 @@ class RankXENDCG(ObjectiveFunction):
     """reference: rank_objective.hpp:385 (XE-NDCG, arxiv 1911.09798)."""
     name = "rank_xendcg"
     is_ranking = True
+    jit_safe_gradients = False   # fresh host RNG draw every iteration
 
     def init(self, label, weight, query_boundaries=None, position=None, n=0):
         super().init(label, weight)

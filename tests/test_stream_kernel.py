@@ -160,6 +160,41 @@ def test_root_pass_matches_segsum():
     np.testing.assert_allclose(np.asarray(slot_cnt), [float(N)], atol=1e-6)
 
 
+def test_int8_hist_exact():
+    """int_weights path: integer grad/hess rows accumulate EXACTLY (int32)."""
+    ds, X, y = _dataset(n=1500, seed=5)
+    dd = ds.device_data()
+    bins = dd.bins
+    N, G = bins.shape
+    Bmax = dd.max_bins
+    L = 8
+    rs = np.random.RandomState(0)
+    gi = rs.randint(-32, 33, N).astype(np.float32)   # integer-valued
+    hi = rs.randint(0, 33, N).astype(np.float32)
+    cnt = jnp.ones(N, jnp.float32)
+
+    slay = pack_bins_T(bins)
+    n_pad = slay.n_pad
+    w_T = jnp.zeros((8, n_pad), jnp.float32)
+    w_T = (w_T.at[0, :N].set(jnp.asarray(gi)).at[1, :N].set(jnp.asarray(hi))
+              .at[2, :N].set(cnt))
+    zL = jnp.zeros(L, jnp.int32)
+    tabs = build_route_tables(zL, zL, zL, zL, zL, zL, zL, zL.at[0].set(1),
+                              dd.routing, L)
+    Bpad = -(-Bmax // 8) * 8
+    bits = jnp.zeros((Bpad, L), jnp.bfloat16)
+    leaf_row = jnp.zeros((1, n_pad), jnp.int32)
+    _, hist, slot_cnt = route_and_hist(slay.bins_T, leaf_row, w_T, tabs,
+                                       bits, 1, Bmax, G, L, has_cat=True,
+                                       int_weights=True)
+    hist_ref = _hist_segsum(bins, jnp.zeros(N, jnp.int32), jnp.asarray(gi),
+                            jnp.asarray(hi), cnt, 1, Bmax)
+    assert hist.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(hist, np.float64),
+                                  np.asarray(hist_ref[..., :2], np.float64))
+    np.testing.assert_allclose(np.asarray(slot_cnt), [float(N)], atol=1e-6)
+
+
 def test_stream_end_to_end_close():
     """Full training with the stream backend matches segsum predictions to
     bf16-accumulation tolerance."""
