@@ -9,6 +9,7 @@ code ports by changing the import.
 from __future__ import annotations
 
 import copy
+import os
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -175,7 +176,8 @@ class Dataset:
     def _is_binary_file(cls, path) -> bool:
         try:
             with open(path, "rb") as f:
-                return f.read(len(cls._BINARY_MAGIC)) == cls._BINARY_MAGIC
+                magic = f.read(len(cls._BINARY_MAGIC))
+                return magic in (cls._BINARY_MAGIC, cls._BINARY_MAGIC_V1)
         except OSError:
             return False
 
@@ -378,45 +380,115 @@ class Dataset:
             params=params or self.params)
         return sub
 
-    _BINARY_MAGIC = b"LGBTPU.BIN.v1\n"
+    _BINARY_MAGIC = b"LGBTPU.BIN.v2\n"
+    _BINARY_MAGIC_V1 = b"LGBTPU.BIN.v1\n"
 
     def save_binary(self, filename: str) -> "Dataset":
         """Serialize the binned dataset (reference: Dataset::SaveBinaryFile);
         load it back by passing the file path to Dataset().
 
-        SECURITY: the format is a Python pickle — loading executes code from
-        the file. Only open binary dataset files you created yourself (the
-        same trust model as loading any pickle)."""
-        import pickle
+        The format is non-executing — a JSON header plus an npz archive of
+        plain arrays (loaded with allow_pickle=False), like the reference's
+        binary format. NOT portable across releases or to stock LightGBM."""
+        import json
+        import struct
         self.construct()
+        b = self.binned
+        mappers = b.bin_mappers
+        arrays = {
+            "bins": b.bins,
+            "group_offsets": np.asarray(b.group_offsets, np.int64),
+            "group_bin_counts": np.asarray(b.group_bin_counts, np.int64),
+            "feature_offsets": np.asarray(b.feature_offsets, np.int64),
+            "feature_num_bins": np.asarray(b.feature_num_bins, np.int64),
+            "mapper_ub": (np.concatenate(
+                [np.asarray(m.upper_bounds, np.float64).reshape(-1)
+                 for m in mappers]) if mappers else np.zeros(0)),
+            "mapper_ub_len": np.asarray(
+                [np.asarray(m.upper_bounds).size for m in mappers], np.int64),
+            "mapper_cats": (np.concatenate(
+                [np.asarray(m.categories, np.int64).reshape(-1)
+                 for m in mappers]) if mappers else np.zeros(0, np.int64)),
+            "mapper_cats_len": np.asarray(
+                [np.asarray(m.categories).size for m in mappers], np.int64),
+        }
+        for field in ("label", "weight", "group", "position", "init_score"):
+            v = getattr(self, field)
+            if v is not None:
+                arrays[field] = np.asarray(v)
+        meta = {
+            "num_data": int(self.num_data_),
+            "num_feature": int(self.num_feature_),
+            "feature_names": self.feature_name(),
+            "group_features": [list(map(int, g)) for g in b.group_features],
+            "mappers": [[int(m.bin_type), int(m.missing_type),
+                         int(m.num_bins), int(m.default_bin),
+                         int(m.most_freq_bin)] for m in mappers],
+        }
+        meta_b = json.dumps(meta).encode()
         with open(filename, "wb") as f:
             f.write(self._BINARY_MAGIC)
-            pickle.dump({"binned": self.binned, "label": self.label,
-                         "weight": self.weight, "group": self.group,
-                         "position": self.position,
-                         "num_data": self.num_data_,
-                         "num_feature": self.num_feature_,
-                         "feature_names": self.feature_name(),
-                         "init_score": self.init_score}, f)
+            f.write(struct.pack("<Q", len(meta_b)))
+            f.write(meta_b)
+            np.savez(f, **arrays)
         return self
 
     def _load_binary(self, path: str) -> None:
         """Restore a save_binary file (reference: DatasetLoader::
         LoadFromBinFile) — the raw matrix is NOT stored; prediction-time
         rebinning is unavailable, training works as usual."""
-        import pickle
-        with open(path, "rb") as f:
-            f.read(len(self._BINARY_MAGIC))
-            blob = pickle.load(f)
-        self.binned = blob["binned"]
-        self.label = blob["label"]
-        self.weight = blob["weight"]
-        self.group = blob["group"]
-        self.position = blob.get("position")
-        self.init_score = blob["init_score"]
-        self.num_data_ = blob["num_data"]
-        self.num_feature_ = blob["num_feature"]
-        self._resolved_feature_names = blob.get("feature_names")
+        import json
+        import struct
+        from .binning import BinMapper, BinnedData
+        try:
+            file_size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                magic = f.read(len(self._BINARY_MAGIC))
+                if magic == self._BINARY_MAGIC_V1:
+                    raise LightGBMError(
+                        "this binary dataset uses the deprecated v1 pickle "
+                        "format, which is unsafe to load; re-save it with "
+                        "Dataset.save_binary() from this release")
+                header = f.read(8)
+                if len(header) != 8:
+                    raise LightGBMError(f"truncated binary dataset: {path}")
+                (meta_len,) = struct.unpack("<Q", header)
+                if meta_len > file_size:
+                    raise LightGBMError(f"corrupt binary dataset: {path}")
+                meta = json.loads(f.read(meta_len).decode())
+                blob = np.load(f, allow_pickle=False)
+                blob = {k: blob[k] for k in blob.files}
+        except LightGBMError:
+            raise
+        except Exception as exc:  # struct/json/zipfile errors → one clear type
+            raise LightGBMError(
+                f"failed to load binary dataset {path}: {exc}") from exc
+        mappers = []
+        ub_off = cat_off = 0
+        for i, (bt, mt, nb, db, mfb) in enumerate(meta["mappers"]):
+            ub_n = int(blob["mapper_ub_len"][i])
+            cat_n = int(blob["mapper_cats_len"][i])
+            mappers.append(BinMapper(
+                upper_bounds=blob["mapper_ub"][ub_off:ub_off + ub_n],
+                bin_type=bt, missing_type=mt,
+                categories=blob["mapper_cats"][cat_off:cat_off + cat_n],
+                num_bins=nb, default_bin=db, most_freq_bin=mfb))
+            ub_off += ub_n
+            cat_off += cat_n
+        self.binned = BinnedData(
+            bins=blob["bins"],
+            group_features=meta["group_features"],
+            group_offsets=blob["group_offsets"],
+            group_bin_counts=blob["group_bin_counts"],
+            feature_offsets=blob["feature_offsets"],
+            feature_num_bins=blob["feature_num_bins"],
+            bin_mappers=mappers,
+            num_data=meta["num_data"], num_features=meta["num_feature"])
+        for field in ("label", "weight", "group", "position", "init_score"):
+            setattr(self, field, blob.get(field))
+        self.num_data_ = meta["num_data"]
+        self.num_feature_ = meta["num_feature"]
+        self._resolved_feature_names = meta["feature_names"]
         self.raw_data = None
 
     def add_features_from(self, other: "Dataset") -> "Dataset":

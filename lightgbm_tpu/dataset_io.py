@@ -133,7 +133,11 @@ def load_position_file(path: str) -> Optional[np.ndarray]:
     ppath = path + ".position"
     if os.path.exists(ppath):
         raw = np.loadtxt(ppath, dtype=str).reshape(-1)
-        # positions may be arbitrary strings; map to dense int ids
-        _, inv = np.unique(raw, return_inverse=True)
-        return inv.astype(np.int32)
+        # positions may be arbitrary strings; map to dense int ids in order
+        # of FIRST APPEARANCE (reference: metadata.cpp LoadPositions), not
+        # lexicographic order, so learned pos_biases line up with stock
+        _, first_idx, inv = np.unique(raw, return_index=True,
+                                      return_inverse=True)
+        rank_of_unique = np.argsort(np.argsort(first_idx))
+        return rank_of_unique[inv].astype(np.int32)
     return None

@@ -550,19 +550,20 @@ class GBDT:
             quant = self.config.use_quantized_grad
             qbins = self.config.num_grad_quant_bins
             qstoch = self.config.stochastic_rounding
+            self._grad_attr_names = [
+                a for a in objective.data_bound_attrs()
+                if getattr(objective, a, None) is not None]
+            attr_names = self._grad_attr_names
 
-            def _fn(score, label, weight, pad_mask, qkey):
-                old_l = objective.label
-                old_w = getattr(objective, "weight", None)
-                objective.label = label
-                if hasattr(objective, "weight"):
-                    objective.weight = weight
+            def _fn(score, bound, pad_mask, qkey):
+                old = {a: getattr(objective, a) for a in attr_names}
+                for a in attr_names:
+                    setattr(objective, a, bound[a])
                 try:
                     g, h = objective.get_gradients(score[:num_data])
                 finally:
-                    objective.label = old_l
-                    if hasattr(objective, "weight"):
-                        objective.weight = old_w
+                    for a in attr_names:
+                        setattr(objective, a, old[a])
                 n = score.shape[0]
                 if n != num_data:
                     pad = [(0, n - num_data)] + [(0, 0)] * (g.ndim - 1)
@@ -577,9 +578,9 @@ class GBDT:
             self._grad_fn = jax.jit(_fn)
         qkey = jax.random.PRNGKey(
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
-        return self._grad_fn(self.score, self.objective.label,
-                             getattr(self.objective, "weight", None),
-                             self._pad_mask, qkey)
+        bound = {a: getattr(self.objective, a)
+                 for a in self._grad_attr_names}
+        return self._grad_fn(self.score, bound, self._pad_mask, qkey)
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
@@ -678,20 +679,24 @@ class GBDT:
             else:
                 # score update (reference: ScoreUpdater::AddScore);
                 # single-leaf trees have leaf_value 0, so no branch is needed
-                if self._use_leaf_gather_kernel and k == 1:
+                if self._use_leaf_gather_kernel:
                     # one fused launch: XLA's small-table row gather runs
                     # ~100M rows/s; the streaming one-hot contraction runs
                     # at bandwidth
                     if self._score_add_fn is None:
                         from ..pallas.stream_kernel import leaf_gather
 
-                        def _sadd(score, lid, lv, rate):
-                            return score + leaf_gather(lid, lv * rate)
+                        def _sadd(score, lid, lv, rate, col):
+                            delta = leaf_gather(lid, lv * rate)
+                            if score.ndim == 1:
+                                return score + delta
+                            return score.at[:, col].add(delta)
 
-                        self._score_add_fn = jax.jit(_sadd)
+                        self._score_add_fn = jax.jit(
+                            _sadd, static_argnums=(4,))
                     self.score = self._score_add_fn(
                         self.score, leaf_id, arrays.leaf_value,
-                        jnp.float32(self._shrinkage_rate()))
+                        jnp.float32(self._shrinkage_rate()), kk)
                     self._lazy_trees.append({"arrays": arrays,
                                              "rate": self._shrinkage_rate(),
                                              "bias": bias})
@@ -746,11 +751,40 @@ class GBDT:
                               else jnp.all(jnp.stack(flags)))
         self.iter_ += 1
         # reading the finished flag is a device->host sync (~90 ms over a
-        # tunneled TPU), so poll it only periodically there; a few trailing
-        # single-leaf trees are no-ops (leaf_value 0)
+        # tunneled TPU), so poll it only periodically there; the trailing
+        # single-leaf trees accumulated between polls are dropped on stop so
+        # num_trees()/model files match the reference's immediate stop
         if self.iter_ % self._finished_check_every == 0:
-            return bool(self._finished_dev)
+            if bool(self._finished_dev):
+                self._trim_trailing_trivial()
+                return True
         return False
+
+    def _trim_trailing_trivial(self) -> None:
+        """Drop trailing no-op iterations (every class tree single-leaf with
+        zero output) appended between finished-flag polls (reference:
+        gbdt.cpp:436-447 stops without keeping the splitless tree)."""
+        k = self.num_tree_per_iteration
+        while self.iter_ > 0:
+            if len(self._lazy_trees) >= k:
+                tail = self._lazy_trees[-k:]
+                got = jax.device_get(
+                    [(e["arrays"].num_leaves, e["arrays"].leaf_value[0])
+                     for e in tail])
+                if all(int(nl) <= 1 and float(lv) == 0.0 and not e["bias"]
+                       for (nl, lv), e in zip(got, tail)):
+                    del self._lazy_trees[-k:]
+                    self.iter_ -= 1
+                    continue
+            elif not self._lazy_trees and len(self._models_list) >= k:
+                tail = self._models_list[-k:]
+                if all(t.num_leaves <= 1 and
+                       all(v == 0.0 for v in t.leaf_value)
+                       for t in tail):
+                    del self._models_list[-k:]
+                    self.iter_ -= 1
+                    continue
+            break
 
     def _shrinkage_rate(self) -> float:
         return self.config.learning_rate

@@ -67,6 +67,12 @@ class ObjectiveFunction:
     def renew_leaf_values(self, score, leaf_id, num_leaves, sample_mask):
         raise NotImplementedError
 
+    # names of captured per-row device arrays a fused jit must rebind as
+    # arguments — closure-captured arrays embed as HLO constants, which
+    # breaks remote compilation at scale (see GBDT._boost_padded)
+    def data_bound_attrs(self) -> Tuple[str, ...]:
+        return ("label", "weight")
+
 
 class RegressionL2(ObjectiveFunction):
     """reference: regression_objective.hpp:94"""
@@ -205,6 +211,9 @@ class MAPE(ObjectiveFunction):
         hess = self._mape_w
         return grad, hess
 
+    def data_bound_attrs(self):
+        return ("label", "weight", "_mape_w")
+
     def boost_from_score(self):
         return _weighted_percentile(self.label, self._mape_w, 0.5)
 
@@ -324,6 +333,9 @@ class MulticlassSoftmax(ObjectiveFunction):
     def convert_output(self, raw):
         return jax.nn.softmax(raw, axis=-1)
 
+    def data_bound_attrs(self):
+        return ("label", "weight", "_onehot")
+
 
 class MulticlassOVA(ObjectiveFunction):
     """reference: multiclass_objective.hpp:187 — K independent binary problems."""
@@ -349,6 +361,9 @@ class MulticlassOVA(ObjectiveFunction):
     def convert_output(self, raw):
         p = jax.nn.sigmoid(self.config.sigmoid * raw)
         return p / jnp.sum(p, axis=-1, keepdims=True)
+
+    def data_bound_attrs(self):
+        return ("label", "weight", "_onehot")
 
 
 class CrossEntropy(ObjectiveFunction):

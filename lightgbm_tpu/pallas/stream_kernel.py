@@ -159,6 +159,16 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     w2 = w_ref[0:2, :]                                       # (2, T) f32
     w_hi, w_lo = _wsplit(w2)
 
+    # unpack the 4-per-word packed group bins and build the (G, B, T)
+    # bin-match mask shared by the int and float contraction paths
+    rows = []
+    for g in range(G):  # static unroll
+        word_g = bins_ref[g // 4:g // 4 + 1, :]
+        rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
+    bins_G = jnp.concatenate(rows, axis=0)                   # (G, T)
+    b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
+    oh_match = bins_G[:, None, :] == b_iota3                 # (G, B, T) bool
+
     if int_weights:
         # Quantized-gradient histograms (reference: gradient_discretizer.cpp
         # + the int8/int16 ConstructHistogram variants, dense_bin.hpp): the
@@ -176,14 +186,7 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         A_i8 = jnp.concatenate(
             [w_i[c:c + 1, :] * slot_oh_i for c in range(2)],
             axis=0).astype(jnp.int8)
-        rows = []
-        for g in range(G):  # static unroll
-            word_g = bins_ref[g // 4:g // 4 + 1, :]
-            rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8)
-                        & 0xFF)
-        bins_G = jnp.concatenate(rows, axis=0)               # (G, T)
-        b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
-        oh_i8 = (bins_G[:, None, :] == b_iota3).astype(jnp.int8)
+        oh_i8 = oh_match.astype(jnp.int8)
         hist_ref[...] += jax.lax.dot_general(
             oh_i8.reshape(G * B, T), A_i8, (((1,), (1,)), ((), ())),
             preferred_element_type=i32)
@@ -213,13 +216,7 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # ONE (G*B, T) @ (T, 3S) contraction per block: per-group (B, T) dots
     # have M=B=64 — half an MXU tile — so merging groups into a single
     # one-hot doubles MXU utilisation (the dominant cost of training).
-    rows = []
-    for g in range(G):  # static unroll
-        word_g = bins_ref[g // 4:g // 4 + 1, :]
-        rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
-    bins_G = jnp.concatenate(rows, axis=0)                   # (G, T)
-    b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
-    oh = (bins_G[:, None, :] == b_iota3).astype(bf16).reshape(G * B, T)
+    oh = oh_match.astype(bf16).reshape(G * B, T)
     if _ABLATE == "dblcon":      # perf probe: one extra (never-hit) construct
         oh2 = (bins_G[:, None, :] == b_iota3 + B).astype(bf16)
         oh = oh + oh2.reshape(G * B, T)

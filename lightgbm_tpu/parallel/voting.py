@@ -98,8 +98,12 @@ def voting_split_round(bins_s, slot_s, grad_s, hess_s, cnt_s, parent_g,
 
     # ---- vote: local top-k features per slot (GlobalVoting, :104) ----
     k = min(top_k, F)
-    _, local_top = jax.lax.top_k(gain_loc, k)             # (S, k)
-    votes = jnp.zeros((S, F)).at[jnp.arange(S)[:, None], local_top].add(1.0)
+    top_gain, local_top = jax.lax.top_k(gain_loc, k)      # (S, k)
+    # masked / splitless features carry NEG_INF gain; they must not receive
+    # votes (the reference only proposes valid local splits)
+    vote_w = (top_gain > NEG_INF / 2).astype(jnp.float32)
+    votes = jnp.zeros((S, F)).at[
+        jnp.arange(S)[:, None], local_top].add(vote_w)
     votes = jax.lax.psum(votes, axis)
 
     # ---- elect global top-2k and reduce ONLY their columns (:396) ----

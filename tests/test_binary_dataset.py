@@ -3,6 +3,7 @@
 Reference: src/io/dataset.cpp SaveBinaryFile / dataset_loader.cpp
 LoadFromBinFile; EFB: include/LightGBM/dataset.h feature groups."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -92,3 +93,22 @@ def test_chunk_list_of_1d_is_a_matrix():
     X = [np.array([1.0, 2.0, 3.0]), np.array([4.0, 5.0, 6.0])]
     ds = lgb.Dataset(X, label=[0.0, 1.0])
     assert ds.num_data() == 2 and ds.num_feature() == 3
+
+
+def test_binary_v1_pickle_rejected(tmp_path):
+    """The deprecated pickle format must not be loadable (code execution)."""
+    p = tmp_path / "old.bin"
+    p.write_bytes(b"LGBTPU.BIN.v1\njunk")
+    with pytest.raises(lgb.LightGBMError, match="v1 pickle"):
+        lgb.Dataset(str(p)).construct()
+
+
+def test_binary_file_is_not_a_pickle(tmp_path):
+    """v2 files load with allow_pickle=False; no pickle opcodes involved."""
+    X = np.random.RandomState(0).randn(80, 4)
+    ds = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float))
+    p = tmp_path / "ds.bin"
+    ds.save_binary(str(p))
+    blob = p.read_bytes()
+    assert blob.startswith(b"LGBTPU.BIN.v2\n")
+    assert b"pickle" not in blob

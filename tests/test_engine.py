@@ -216,3 +216,30 @@ def test_trivial_tree_walk_resolves_leaf0():
     fields = (zeros, zeros, zeros, zeros, zeros, jnp.zeros((L, Bmax), bool))
     leaf = _walk_one_tree(fields, dd.bins, dd.routing, L)
     assert int(jnp.max(leaf)) == 0 and int(jnp.min(leaf)) == 0
+
+
+def test_no_trailing_trivial_trees():
+    """When growth stops, splitless zero trees appended between the delayed
+    finished-flag polls are dropped (reference: gbdt.cpp stops without
+    keeping them)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 3)
+    y = (X[:, 0] > 0).astype(np.float64)  # one split fits it exactly
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster({"objective": "regression", "num_leaves": 4,
+                       "learning_rate": 1.0, "verbosity": -1,
+                       "min_gain_to_split": 1e-3,
+                       "min_data_in_leaf": 1}, ds)
+    # emulate the TPU's deferred device->host poll cadence
+    bst.engine._finished_check_every = 8
+    finished_at = None
+    for i in range(30):
+        if bst.update():
+            finished_at = i
+            break
+    assert finished_at is not None
+    trees = bst.engine.models
+    # the trailing single-leaf zero trees between polls were trimmed
+    assert bst.num_trees() < finished_at + 1
+    assert trees[-1].num_leaves > 1
+    assert bst.engine.iter_ == bst.num_trees()
