@@ -86,14 +86,12 @@ def _to_2d_float(data) -> Tuple[np.ndarray, Optional[List[str]], List[int]]:
     return arr, feature_names, cat_idx
 
 
-def _scipy_to_dense(data):
+def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sp
-        if sp.issparse(data):
-            return np.asarray(data.todense(), dtype=np.float64)
+        return sp.issparse(data)
     except ImportError:
-        pass
-    return None
+        return False
 
 
 class Dataset:
@@ -111,6 +109,7 @@ class Dataset:
         self._feature_name_arg = feature_name
         self._categorical_feature_arg = categorical_feature
         self._predictor = None
+        self._dist = None
 
         if isinstance(data, (str, Path)) and self._is_binary_file(data):
             if reference is not None:
@@ -118,6 +117,7 @@ class Dataset:
                     "a binary dataset file carries its own bin mappers; "
                     "reference= cannot be combined with it")
             self.raw_data = None
+            self.raw_sparse = None
             self._pandas_names = None
             self._pandas_cat_idx = []
             self.binned = None
@@ -143,7 +143,20 @@ class Dataset:
             return
         if isinstance(data, (str, Path)):
             from .dataset_io import load_data_file
-            data, label_file, extras = load_data_file(str(data), self.params)
+            from .parallel.dist_data import dist_context
+            dist = dist_context()
+            if (dist is not None and reference is None
+                    and not self.params.get("pre_partition", False)):
+                # distributed load: this process parses ONLY its row shard
+                # (reference: DatasetLoader::LoadFromFile rank sharding,
+                # dataset_loader.cpp:211); mappers sync in construct()
+                rank, nproc = dist
+                data, label_file, extras = load_data_file(
+                    str(data), self.params, rank=rank, num_machines=nproc)
+                self._dist = {"rank": rank, "nproc": nproc}
+            else:
+                data, label_file, extras = load_data_file(str(data),
+                                                          self.params)
             if label is None:
                 label = label_file
             if weight is None:
@@ -152,11 +165,19 @@ class Dataset:
                 group = extras.get("group")
             if position is None:
                 position = extras.get("position")
-        sp = _scipy_to_dense(data)
-        if sp is not None:
-            data = sp
-        self.raw_data, self._pandas_names, pandas_cat = _to_2d_float(data)
-        self.num_data_, self.num_feature_ = self.raw_data.shape
+        self.raw_sparse = None
+        if _is_scipy_sparse(data):
+            # CSR/CSC kept sparse end-to-end: bin mappers from sampled
+            # non-zeros + implicit-zero counts, EFB from CSC structure,
+            # binned matrix scattered in O(nnz) — the dense X is never
+            # materialized (reference: src/io/sparse_bin.hpp, bin.h:482)
+            self.raw_sparse = data.tocsr()
+            self.raw_data = None
+            self._pandas_names, pandas_cat = None, []
+            self.num_data_, self.num_feature_ = self.raw_sparse.shape
+        else:
+            self.raw_data, self._pandas_names, pandas_cat = _to_2d_float(data)
+            self.num_data_, self.num_feature_ = self.raw_data.shape
         self._pandas_cat_idx = pandas_cat
 
         self.label = None if label is None else np.asarray(label, np.float64).reshape(-1)
@@ -171,6 +192,52 @@ class Dataset:
         self.binned: Optional[BinnedData] = None
         self._device: Optional[DeviceData] = None
         self._resolved_feature_names: Optional[List[str]] = None
+        if self._dist is not None:
+            self._finalize_distributed()
+
+    def _finalize_distributed(self) -> None:
+        """Fix the global shard-padded row layout and allgather the per-row
+        metadata (O(N) scalars; the O(N*F) features stay shard-local).
+        Pad rows carry weight 0 + true-mask 0 (see parallel/dist_data.py)."""
+        from .parallel.dist_data import (allgather_np, check_uniform_features,
+                                         gather_padded, shard_pad_base)
+        if self.group is not None:
+            raise LightGBMError(
+                "distributed loading cannot row-shard grouped (ranking) "
+                "data; pre-partition per machine (pre_partition=true)")
+        fg = check_uniform_features(self.num_feature_)
+        if fg != self.num_feature_:
+            self.raw_data = np.pad(self.raw_data,
+                                   ((0, 0), (0, fg - self.num_feature_)))
+            self.num_feature_ = fg
+        n_local = self.num_data_
+        base = shard_pad_base()
+        counts = allgather_np(np.asarray([n_local], np.int64)).reshape(-1)
+        n_shard = -(-int(counts.max()) // base) * base
+        self._dist.update(n_local=n_local, n_shard=n_shard,
+                          counts=counts, num_data_true=int(counts.sum()))
+        mask = np.zeros(n_local, np.float32) + 1.0
+        self._true_mask = gather_padded(mask, n_shard)
+        self.label = gather_padded(self.label, n_shard)
+        # pad rows must carry zero weight so weighted stats/metrics see only
+        # true rows; without user weights the mask itself is the weight
+        w = self.weight if self.weight is not None else mask.astype(np.float64)
+        self.weight = gather_padded(np.asarray(w, np.float64), n_shard)
+        self.position = gather_padded(self.position, n_shard)
+        if self.init_score is not None:
+            self.init_score = gather_padded(self.init_score, n_shard)
+        self.num_data_ = int(n_shard * self._dist["nproc"])
+
+    def get_true_row_mask(self, n: int) -> np.ndarray:
+        """Row-validity mask of the padded global row space. Single-process
+        layouts are a true-row prefix; distributed shard-padded layouts are
+        not, so the engine must use this instead of a prefix slice."""
+        out = np.zeros(n, np.float32)
+        if self._dist is not None:
+            out[:len(self._true_mask)] = self._true_mask
+        else:
+            out[:self.num_data_] = 1.0
+        return out
 
     @classmethod
     def _is_binary_file(cls, path) -> bool:
@@ -218,31 +285,105 @@ class Dataset:
         if self.num_data_ == 0:
             raise LightGBMError("Cannot construct Dataset: it has no rows")
         cfg = Config.from_params(self.params)
+        if self._dist is not None:
+            return self._construct_distributed(cfg)
+        sparse = self.raw_sparse is not None
         if self.reference is not None:
             ref = self.reference.construct()
             mappers = ref.binned.bin_mappers
             groups = ref.binned.group_features
-            self.binned = construct_binned(self.raw_data, mappers, groups)
+            if sparse:
+                from .binning import construct_binned_sparse
+                self.binned = construct_binned_sparse(self.raw_sparse,
+                                                      mappers, groups)
+            else:
+                self.binned = construct_binned(self.raw_data, mappers, groups)
         else:
             cats = self._resolve_categorical()
-            mappers = find_bin_mappers(
-                self.raw_data, max_bin=cfg.max_bin,
-                min_data_in_bin=cfg.min_data_in_bin,
+            mapper_kw = dict(
+                max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
                 categorical_features=cats,
                 use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
-                sample_cnt=cfg.bin_construct_sample_cnt, seed=cfg.data_random_seed,
+                sample_cnt=cfg.bin_construct_sample_cnt,
+                seed=cfg.data_random_seed,
                 max_bin_by_feature=cfg.max_bin_by_feature)
-            groups = None
-            if cfg.enable_bundle:
-                sample_n = min(self.num_data_, cfg.bin_construct_sample_cnt)
-                rng = np.random.RandomState(cfg.data_random_seed)
-                idx = (np.arange(self.num_data_) if self.num_data_ <= sample_n else
-                       np.sort(rng.choice(self.num_data_, sample_n, replace=False)))
-                sample_bins = [mappers[f].transform(self.raw_data[idx, f])
-                               for f in range(self.num_feature_)]
-                groups = find_feature_groups(sample_bins, mappers,
-                                             enable_bundle=True)
-            self.binned = construct_binned(self.raw_data, mappers, groups)
+            if sparse:
+                from .binning import (construct_binned_sparse,
+                                      find_bin_mappers_sparse,
+                                      sample_sparse_csc, sparse_nz_masks)
+                mappers = find_bin_mappers_sparse(self.raw_sparse, **mapper_kw)
+                groups = None
+                if cfg.enable_bundle:
+                    # SAME sample rows as the dense path (same seed/draw), so
+                    # bundling — and therefore the model — is identical to
+                    # Dataset(X.todense()); transient cost is the F boolean
+                    # masks, ~F * min(N, sample_cnt) bytes
+                    Xc, n_sample = sample_sparse_csc(
+                        self.raw_sparse, cfg.bin_construct_sample_cnt,
+                        cfg.data_random_seed)
+                    masks = sparse_nz_masks(Xc, n_sample, mappers)
+                    del Xc
+                    groups = find_feature_groups(None, mappers,
+                                                 enable_bundle=True,
+                                                 nz_masks=masks)
+                    del masks
+                self.binned = construct_binned_sparse(self.raw_sparse,
+                                                      mappers, groups)
+            else:
+                mappers = find_bin_mappers(self.raw_data, **mapper_kw)
+                groups = None
+                if cfg.enable_bundle:
+                    sample_n = min(self.num_data_, cfg.bin_construct_sample_cnt)
+                    rng = np.random.RandomState(cfg.data_random_seed)
+                    idx = (np.arange(self.num_data_)
+                           if self.num_data_ <= sample_n else
+                           np.sort(rng.choice(self.num_data_, sample_n,
+                                              replace=False)))
+                    sample_bins = [mappers[f].transform(self.raw_data[idx, f])
+                                   for f in range(self.num_feature_)]
+                    groups = find_feature_groups(sample_bins, mappers,
+                                                 enable_bundle=True)
+                self.binned = construct_binned(self.raw_data, mappers, groups)
+        if self.free_raw_data:
+            self.raw_data = None
+            self.raw_sparse = None
+        return self
+
+    def _construct_distributed(self, cfg) -> "Dataset":
+        """Bin this rank's shard with GLOBALLY-synchronized mappers: per-rank
+        samples are allgathered and every process runs the deterministic
+        mapper + EFB computation on the identical gathered sample
+        (reference: ConstructBinMappersFromTextData + mapper Allgather,
+        dataset_loader.cpp:733-741)."""
+        from dataclasses import replace
+        from .parallel.dist_data import gather_sample
+        d = self._dist
+        per_rank = max(1, cfg.bin_construct_sample_cnt // d["nproc"])
+        rng = np.random.RandomState(cfg.data_random_seed + d["rank"])
+        if d["n_local"] > per_rank:
+            idx = np.sort(rng.choice(d["n_local"], per_rank, replace=False))
+            sample_local = self.raw_data[idx]
+        else:
+            sample_local = self.raw_data
+        sample = gather_sample(sample_local)
+        cats = self._resolve_categorical()
+        mappers = find_bin_mappers(
+            sample, max_bin=cfg.max_bin,
+            min_data_in_bin=cfg.min_data_in_bin, categorical_features=cats,
+            use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
+            sample_cnt=len(sample) + 1, seed=cfg.data_random_seed,
+            max_bin_by_feature=cfg.max_bin_by_feature)
+        groups = None
+        if cfg.enable_bundle:
+            sample_bins = [mappers[f].transform(sample[:, f])
+                           for f in range(self.num_feature_)]
+            groups = find_feature_groups(sample_bins, mappers,
+                                         enable_bundle=True)
+        local = construct_binned(self.raw_data, mappers, groups)
+        n_shard = d["n_shard"]
+        bins = np.pad(local.bins, ((0, n_shard - local.bins.shape[0]),
+                                   (0, 0)))
+        self.binned = replace(local, bins=bins, num_data=n_shard)
         if self.free_raw_data:
             self.raw_data = None
         return self
@@ -354,7 +495,11 @@ class Dataset:
                        position=position)
 
     def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
-        if self.raw_data is None:
+        if self._dist is not None:
+            raise LightGBMError(
+                "cannot subset a distributed-loaded dataset: features are "
+                "rank-local while metadata is global")
+        if self.raw_data is None and self.raw_sparse is None:
             raise LightGBMError("cannot subset after raw data was freed")
         idx = np.asarray(used_indices, np.int64)
         # group propagation: when the indices are query-aligned (as cv()'s
@@ -367,7 +512,8 @@ class Dataset:
             if np.array_equal(counts, bounds[sel_q + 1] - bounds[sel_q]):
                 group_sub = counts
         sub = Dataset(
-            self.raw_data[idx],
+            (self.raw_data if self.raw_data is not None
+             else self.raw_sparse)[idx],
             label=None if self.label is None else self.label[idx],
             weight=None if self.weight is None else self.weight[idx],
             group=group_sub,
@@ -674,9 +820,19 @@ class Booster:
         """Predict (reference: Booster.predict, basic.py:4625)."""
         if isinstance(data, Dataset):
             raise LightGBMError("predict() takes raw data, not a Dataset")
-        sp = _scipy_to_dense(data)
-        if sp is not None:
-            data = sp
+        if _is_scipy_sparse(data):
+            # chunked densify: prediction walks real-valued thresholds, so
+            # rows are materialized a bounded slab at a time (~256 MB)
+            Xr = data.tocsr()
+            nrows = Xr.shape[0]
+            chunk = max(1, (1 << 25) // max(1, Xr.shape[1]))
+            starts = range(0, nrows, chunk) if nrows else [0]
+            outs = [self.predict(
+                np.asarray(Xr[s:s + chunk].todense(), np.float64),
+                start_iteration, num_iteration, raw_score, pred_leaf,
+                pred_contrib, validate_features, **kwargs)
+                for s in starts]
+            return np.concatenate(outs, axis=0)
         X, _, _ = _to_2d_float(data)
         expected = self.num_feature()
         if expected and X.shape[1] != expected:

@@ -207,24 +207,40 @@ def _greedy_find_bounds(uniq: np.ndarray, counts: np.ndarray, max_bin: int,
 # Exclusive Feature Bundling (reference: dataset.cpp:65-369)
 # ---------------------------------------------------------------------------
 
-def find_feature_groups(sample_bins: List[np.ndarray], bin_mappers: List[BinMapper],
+def find_feature_groups(sample_bins: Optional[List[np.ndarray]],
+                        bin_mappers: List[BinMapper],
                         enable_bundle: bool, max_conflict_rate: float = 0.0,
-                        sparse_threshold: float = 0.8) -> List[List[int]]:
+                        sparse_threshold: float = 0.8,
+                        nz_masks: Optional[List[np.ndarray]] = None,
+                        max_group_bins: Optional[int] = None) -> List[List[int]]:
     """Greedy bundling of mutually (near-)exclusive sparse features.
 
     ``sample_bins[f]`` are the sampled bin values of feature f; a row "uses" the feature
     when its bin differs from the feature's default bin. Features whose nonzero sets
-    conflict in at most ``max_conflict_rate * n`` rows share a bundle."""
+    conflict in at most ``max_conflict_rate * n`` rows share a bundle.
+    ``nz_masks`` (sparse ingest) supplies the usage masks directly.
+    ``max_group_bins`` bounds a bundle's total bin count: the engine's dense
+    layouts pad every group to the LARGEST group's bin count (uint8 bins,
+    (F, Bmax) routing tables, (S, G, Bmax) histograms), so one oversized
+    bundle would inflate every per-group buffer (reference analog: EFB
+    bundles are capped by the bin dtype, dataset.cpp FindGroups). The
+    default bounds the padded-layout product F * Bmax instead of a fixed
+    size, so narrow datasets bundle freely while wide sparse ones stay
+    within device memory."""
     num_features = len(bin_mappers)
+    if max_group_bins is None:
+        max_group_bins = max(255, 2_000_000 // max(num_features, 1))
     if not enable_bundle or num_features <= 1:
         return [[f] for f in range(num_features)]
-    n = len(sample_bins[0]) if num_features else 0
+    n = (len(nz_masks[0]) if nz_masks is not None
+         else len(sample_bins[0])) if num_features else 0
     if n == 0:
         return [[f] for f in range(num_features)]
 
-    nz_masks = []
-    for f in range(num_features):
-        nz_masks.append(sample_bins[f] != bin_mappers[f].default_bin)
+    if nz_masks is None:
+        nz_masks = []
+        for f in range(num_features):
+            nz_masks.append(sample_bins[f] != bin_mappers[f].default_bin)
     nz_counts = np.array([int(m.sum()) for m in nz_masks])
     sparse = nz_counts < sparse_threshold * n
     order = np.argsort(-nz_counts, kind="stable")
@@ -233,28 +249,41 @@ def find_feature_groups(sample_bins: List[np.ndarray], bin_mappers: List[BinMapp
     groups: List[List[int]] = []
     group_masks: List[np.ndarray] = []
     group_conflicts: List[int] = []
+    group_bins: List[int] = []          # 1 shared default + per-feature extras
     for f in order:
         f = int(f)
+        nb = int(bin_mappers[f].num_bins)
         if not sparse[f] or bin_mappers[f].bin_type == BIN_CATEGORICAL:
             groups.append([f])
             group_masks.append(None)  # never bundled into
             group_conflicts.append(0)
+            group_bins.append(nb)
             continue
         placed = False
-        for gi in range(len(groups)):
+        tried = 0
+        for gi in range(len(groups) - 1, -1, -1):
+            # newest-first, bounded search (the reference's FindGroups also
+            # caps its search to keep EFB O(#feature), dataset.cpp:112)
             if group_masks[gi] is None:
                 continue
+            if group_bins[gi] + nb - 1 > max_group_bins:
+                continue
+            tried += 1
+            if tried > 64:
+                break
             conflict = int((group_masks[gi] & nz_masks[f]).sum())
             if group_conflicts[gi] + conflict <= max_conflict:
                 groups[gi].append(f)
                 group_masks[gi] = group_masks[gi] | nz_masks[f]
                 group_conflicts[gi] += conflict
+                group_bins[gi] += nb - 1
                 placed = True
                 break
         if not placed:
             groups.append([f])
             group_masks.append(nz_masks[f].copy())
             group_conflicts.append(0)
+            group_bins.append(1 + nb - 1)
     # restore deterministic ordering: sort groups by first feature index
     for g in groups:
         g.sort()
@@ -293,17 +322,14 @@ class BinnedData:
         return len(self.group_features)
 
 
-def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
-                     groups: Optional[List[List[int]]] = None) -> BinnedData:
-    """Bin a raw (N, F) float matrix into the dense group-bin layout."""
-    n, num_features = data.shape
-    assert len(bin_mappers) == num_features
-    if groups is None:
-        groups = [[f] for f in range(num_features)]
+def _group_layout(groups: List[List[int]], bin_mappers: List[BinMapper],
+                  num_features: int):
+    """Shared bin-layout bookkeeping for dense and sparse construction.
 
-    # per-feature in-group offsets; bundled features share a group column.
-    # In a bundle, local bin 0 means "all features at default"; feature f's non-default
-    # bins occupy [in_group_offset[f], in_group_offset[f] + nbins_f - 1) shifted by 1.
+    Per-feature in-group offsets; bundled features share a group column.
+    In a bundle, local bin 0 means "all features at default"; feature f's
+    non-default bins occupy [in_group_offset[f], in_group_offset[f] +
+    nbins_f - 1) shifted by 1."""
     group_bin_counts = []
     feature_offsets = np.zeros(num_features, dtype=np.int64)
     feature_num_bins = np.array([m.num_bins for m in bin_mappers], dtype=np.int64)
@@ -319,9 +345,21 @@ def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
             group_bin_counts.append(cnt)
         group_offsets.append(group_offsets[-1] + group_bin_counts[-1])
     group_offsets = np.asarray(group_offsets, dtype=np.int64)
-
     max_group_bins = max(group_bin_counts) if group_bin_counts else 1
     dtype = np.uint8 if max_group_bins <= 256 else np.uint16
+    return group_bin_counts, group_offsets, feature_offsets, feature_num_bins, dtype
+
+
+def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
+                     groups: Optional[List[List[int]]] = None) -> BinnedData:
+    """Bin a raw (N, F) float matrix into the dense group-bin layout."""
+    n, num_features = data.shape
+    assert len(bin_mappers) == num_features
+    if groups is None:
+        groups = [[f] for f in range(num_features)]
+
+    (group_bin_counts, group_offsets, feature_offsets, feature_num_bins,
+     dtype) = _group_layout(groups, bin_mappers, num_features)
     bins = np.zeros((n, len(groups)), dtype=dtype)
 
     for gi, g in enumerate(groups):
@@ -344,14 +382,6 @@ def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
                 feature_offsets[f] = group_offsets[gi] + in_group - 1  # see split remap
                 in_group += m.num_bins - 1
             bins[:, gi] = col.astype(dtype)
-
-    # for bundles the per-feature global span is approximate for split-finding; single
-    # features (the common case) are exact. feature_num_bins for bundled features counts
-    # the non-default bins only.
-    for gi, g in enumerate(groups):
-        if len(g) > 1:
-            for f in g:
-                feature_num_bins[f] = bin_mappers[f].num_bins
 
     return BinnedData(
         bins=bins,
@@ -391,3 +421,124 @@ def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int,
             mappers.append(BinMapper.find_numerical(col, mb, min_data_in_bin,
                                                     use_missing, zero_as_missing))
     return mappers
+
+
+# ---------------------------------------------------------------------------
+# Sparse (CSR/CSC) ingestion — never materializes the dense matrix
+# (reference: src/io/sparse_bin.hpp, dataset_loader.cpp sampling of non-zero
+# values + total counts; bin.h:482 MultiValBin sparse layouts)
+# ---------------------------------------------------------------------------
+
+def sample_sparse_csc(X, sample_cnt: int, seed: int):
+    """Row-sample a scipy sparse matrix and return the sample in CSC form."""
+    n = X.shape[0]
+    rng = np.random.RandomState(seed)
+    Xr = X.tocsr()
+    if n > sample_cnt:
+        idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+        Xr = Xr[idx]
+    return Xr.tocsc(), Xr.shape[0]
+
+
+def find_bin_mappers_sparse(X, max_bin: int, min_data_in_bin: int,
+                            categorical_features: Sequence[int] = (),
+                            use_missing: bool = True,
+                            zero_as_missing: bool = False,
+                            sample_cnt: int = 200000, seed: int = 1,
+                            max_bin_by_feature: Optional[Sequence[int]] = None
+                            ) -> List[BinMapper]:
+    """Per-feature bin mappers from a scipy sparse matrix, one column of
+    sampled non-zeros at a time — implicit zeros are restored by count so the
+    mappers are IDENTICAL to the densified path's (tested)."""
+    n, num_features = X.shape
+    Xc, n_sample = sample_sparse_csc(X, sample_cnt, seed)
+    cat = set(int(c) for c in categorical_features)
+    mappers = []
+    for f in range(num_features):
+        mb = max_bin if max_bin_by_feature is None else int(max_bin_by_feature[f])
+        vals = np.asarray(Xc.data[Xc.indptr[f]:Xc.indptr[f + 1]], np.float64)
+        # restore the implicit zeros (transient: one feature at a time)
+        col = np.concatenate([vals, np.zeros(n_sample - len(vals))])
+        if f in cat:
+            mappers.append(BinMapper.find_categorical(col, mb, min_data_in_bin,
+                                                      use_missing))
+        else:
+            mappers.append(BinMapper.find_numerical(col, mb, min_data_in_bin,
+                                                    use_missing, zero_as_missing))
+    return mappers
+
+
+def sparse_nz_masks(Xc, n_sample: int, bin_mappers: List[BinMapper]
+                    ) -> List[np.ndarray]:
+    """Per-feature "row uses this feature" masks for EFB conflict counting,
+    straight from CSC structure (no densify)."""
+    masks = []
+    for f, m in enumerate(bin_mappers):
+        lo, hi = Xc.indptr[f], Xc.indptr[f + 1]
+        vals = np.asarray(Xc.data[lo:hi], np.float64)
+        rows = np.asarray(Xc.indices[lo:hi])
+        b = m.transform(vals)
+        mask = np.zeros(n_sample, bool)
+        mask[rows[b != m.default_bin]] = True
+        masks.append(mask)
+    return masks
+
+
+def construct_binned_sparse(X, bin_mappers: List[BinMapper],
+                            groups: Optional[List[List[int]]] = None
+                            ) -> BinnedData:
+    """Bin a scipy sparse matrix into the dense uint8/16[N, G] group layout
+    in O(nnz): group columns start at the implicit-zero bin and only explicit
+    entries are scattered in. Output matches construct_binned(todense())
+    exactly (tested); peak memory is O(nnz + N*G)."""
+    n, num_features = X.shape
+    assert len(bin_mappers) == num_features
+    if groups is None:
+        groups = [[f] for f in range(num_features)]
+    Xc = X.tocsc()
+
+    (group_bin_counts, group_offsets, feature_offsets, feature_num_bins,
+     dtype) = _group_layout(groups, bin_mappers, num_features)
+    bins = np.zeros((n, len(groups)), dtype=dtype)
+
+    def col_nonzeros(f):
+        lo, hi = Xc.indptr[f], Xc.indptr[f + 1]
+        return (np.asarray(Xc.indices[lo:hi]),
+                np.asarray(Xc.data[lo:hi], np.float64))
+
+    for gi, g in enumerate(groups):
+        if len(g) == 1:
+            f = g[0]
+            m = bin_mappers[f]
+            default = int(m.transform(np.zeros(1))[0])
+            if default:
+                bins[:, gi] = default
+            rows, vals = col_nonzeros(f)
+            bins[rows, gi] = m.transform(vals).astype(dtype)
+            feature_offsets[f] = group_offsets[gi]
+        else:
+            # bundle: implicit zeros are the shared default bin 0; explicit
+            # non-default entries scatter in feature order (matching the
+            # dense path's last-writer-wins on EFB conflicts)
+            in_group = 1
+            for f in g:
+                m = bin_mappers[f]
+                rows, vals = col_nonzeros(f)
+                b = m.transform(vals).astype(np.int64)
+                nondef = b != m.default_bin
+                local = np.where(b > m.default_bin, b - 1, b)
+                bins[rows[nondef], gi] = (in_group + local[nondef]).astype(dtype)
+                feature_offsets[f] = group_offsets[gi] + in_group - 1
+                in_group += m.num_bins - 1
+
+    return BinnedData(
+        bins=bins,
+        group_features=groups,
+        group_offsets=group_offsets.astype(np.int32),
+        group_bin_counts=np.asarray(group_bin_counts, dtype=np.int32),
+        feature_offsets=feature_offsets.astype(np.int32),
+        feature_num_bins=feature_num_bins.astype(np.int32),
+        bin_mappers=bin_mappers,
+        num_data=n,
+        num_features=num_features,
+    )

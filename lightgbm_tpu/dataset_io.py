@@ -29,17 +29,73 @@ def _detect_format(first_lines) -> str:
     return "csv"
 
 
-def load_data_file(path: str, params: Dict[str, Any]
+def shard_byte_range(path: str, rank: int, num_machines: int,
+                     skip_header: bool = False) -> Tuple[int, int, int]:
+    """Byte range [start, end) of this rank's row shard plus the global index
+    of its first row (reference: DatasetLoader::LoadFromFile splits the file
+    by rank, dataset_loader.cpp:211; TextReader ReadPartAndParallelProcess).
+
+    The file is cut at num_machines near-equal byte offsets advanced to the
+    next newline, so every line belongs to exactly one rank; start_row is
+    found by counting newlines before the range (a raw byte scan)."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        data_start = 0
+        if skip_header:
+            f.readline()
+            data_start = f.tell()
+        span = size - data_start
+
+        def cut(i: int) -> int:
+            if i <= 0:
+                return data_start
+            if i >= num_machines:
+                return size
+            f.seek(data_start + (span * i) // num_machines)
+            f.readline()             # advance to the next line boundary
+            return min(f.tell(), size)
+
+        start, end = cut(rank), cut(rank + 1)
+        # rows before `start` = DATA lines in [data_start, start): blank and
+        # '#'-comment lines are skipped by every parser, so raw newline
+        # counts would misalign the per-row sidecar slices
+        start_row = 0
+        f.seek(data_start)
+        remaining = start - data_start
+        tail = b""
+        while remaining > 0:
+            chunk = f.read(min(1 << 24, remaining))
+            if not chunk:
+                break
+            remaining -= len(chunk)
+            buf = tail + chunk
+            lines = buf.split(b"\n")
+            tail = lines.pop()
+            start_row += sum(1 for ln in lines
+                             if ln.strip() and not ln.lstrip().startswith(b"#"))
+    return start, end, start_row
+
+
+def load_data_file(path: str, params: Dict[str, Any],
+                   rank: Optional[int] = None,
+                   num_machines: Optional[int] = None
                    ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict[str, Any]]:
     """Load a data file; returns (features, label, extras) where extras may
     hold 'weight' / 'group' / 'position' from the .weight/.query/.position
     sidecar files (reference: dataset_loader.cpp:211 LoadQueryBoundaries,
-    metadata.cpp LoadWeights/LoadPositions) or libsvm qid tags."""
+    metadata.cpp LoadWeights/LoadPositions) or libsvm qid tags.
+
+    rank/num_machines: distributed loading — parse ONLY this rank's row
+    shard (near-equal byte ranges cut at line boundaries); per-row sidecars
+    are sliced to the shard, and extras['start_row'] reports the shard's
+    global first row (reference: dataset_loader.cpp:211 rank sharding)."""
     if not os.path.exists(path):
         raise LightGBMError(f"data file {path} not found")
     with open(path) as f:
         head = [f.readline() for _ in range(3)]
     fmt = _detect_format(head)
+    if rank is not None and num_machines is not None and num_machines > 1:
+        return _load_data_file_shard(path, params, fmt, rank, num_machines)
     has_header = bool(params.get("header", False))
     label_col = 0
     lc = str(params.get("label_column", ""))
@@ -79,30 +135,95 @@ def load_data_file(path: str, params: Dict[str, Any]
     return feats, label, extras
 
 
+def _load_data_file_shard(path: str, params: Dict[str, Any], fmt: str,
+                          rank: int, num_machines: int):
+    """Parse one rank's shard of a CSV/TSV/LibSVM file (see load_data_file)."""
+    has_header = bool(params.get("header", False))
+    start, end, start_row = shard_byte_range(path, rank, num_machines,
+                                             skip_header=has_header)
+    with open(path, "rb") as f:
+        f.seek(start)
+        blob = f.read(end - start)
+    label_col = 0
+    lc = str(params.get("label_column", ""))
+    if lc.startswith("column="):
+        label_col = int(lc.split("=")[1])
+    elif lc.isdigit():
+        label_col = int(lc)
+
+    if fmt == "libsvm":
+        import io
+        feats, label, qids = _parse_libsvm_lines(io.StringIO(blob.decode()))
+        extras: Dict[str, Any] = {}
+        if qids is not None:
+            change = np.flatnonzero(np.diff(qids)) + 1
+            bounds = np.concatenate([[0], change, [len(qids)]])
+            extras["group"] = np.diff(bounds)
+    else:
+        delim = "," if fmt == "csv" else "\t"
+        from .native import parse_csv_bytes
+        data = parse_csv_bytes(blob, delim=delim)
+        if data is None:
+            rows = [ln for ln in blob.decode().splitlines() if ln.strip()]
+            data = np.asarray([[_fast_float(t) for t in ln.split(delim)]
+                               for ln in rows], np.float64)
+        if data.ndim == 1:
+            data = data.reshape(-1, 1)
+        label = data[:, label_col].copy()
+        feats = np.delete(data, label_col, axis=1)
+        extras = {}
+    n_local = len(feats)
+    for name, loader in (("weight", load_weight_file),
+                         ("position", load_position_file)):
+        if name not in extras:
+            v = loader(path)
+            if v is not None:
+                extras[name] = v[start_row:start_row + n_local]
+    # .query sidecars are query-aligned, not row-aligned; distributed
+    # ranking needs pre-partitioned per-rank files (reference behavior)
+    if load_query_file(path) is not None and "group" not in extras:
+        raise LightGBMError(
+            "distributed loading cannot row-shard a .query sidecar; "
+            "pre-partition ranking data per machine (pre_partition=true)")
+    extras["start_row"] = start_row
+    return feats, label, extras
+
+
+def _fast_float(tok: str) -> float:
+    tok = tok.strip()
+    if not tok or tok.lower() in ("na", "nan", "null", ""):
+        return float("nan")
+    return float(tok)
+
+
 def _load_libsvm(path: str):
+    with open(path) as f:
+        return _parse_libsvm_lines(f)
+
+
+def _parse_libsvm_lines(f):
     labels = []
     rows = []
     qids = []
     max_idx = -1
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
+    for line in f:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        kv = []
+        for tok in parts[1:]:
+            if ":" not in tok:
                 continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            kv = []
-            for tok in parts[1:]:
-                if ":" not in tok:
-                    continue
-                k, v = tok.split(":", 1)
-                if k == "qid":
-                    qids.append(int(v))
-                    continue
-                ki = int(k)
-                kv.append((ki, float(v)))
-                max_idx = max(max_idx, ki)
-            rows.append(kv)
+            k, v = tok.split(":", 1)
+            if k == "qid":
+                qids.append(int(v))
+                continue
+            ki = int(k)
+            kv.append((ki, float(v)))
+            max_idx = max(max_idx, ki)
+        rows.append(kv)
     n = len(rows)
     out = np.zeros((n, max_idx + 1), np.float64)
     for i, kv in enumerate(rows):

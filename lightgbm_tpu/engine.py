@@ -107,6 +107,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             log_info("Stopped training because there are no more leaves that "
                      "meet the split requirements")
             break
+    else:
+        # loop ran to num_boost_round: growth may have stopped between the
+        # engine's deferred finished-flag polls — drop any trailing no-op
+        # trees so the saved model matches the reference's immediate stop
+        booster.engine._trim_trailing_trivial()
 
     if evaluation_result_list:
         best: Dict[str, Dict[str, float]] = collections.defaultdict(dict)

@@ -89,8 +89,54 @@ class GBDT:
                                 config.num_machines)
         dd: DeviceData = train_data.device_data()
         self._row_sharding = None
-        if self.mesh is not None:
-            n_pad = pad_rows_for_mesh(dd.bins.shape[0], self.mesh)
+        self._row_axis = None
+        self._mesh_stream = False
+        # voting replaces the grow fn with its own shard_map learner, which
+        # never reads the packed stream layout — keep stream (and its packed
+        # bins copy) off when voting will engage
+        self._voting_planned = False
+        if config.tree_learner == "voting" and self.mesh is not None:
+            from ..parallel.voting import voting_supported
+            self._voting_planned = (
+                voting_supported(dd.layout, dd.routing)
+                and not any(m.bin_type == 1
+                            for m in train_data.bin_mappers()))
+        self._dist_mode = getattr(train_data, "_dist", None) is not None
+        if self._dist_mode:
+            # multi-process training on a distributed-loaded dataset: each
+            # process holds only its binned row shard; assemble ONE global
+            # row-sharded array (reference: the per-worker partitions of
+            # data_parallel_tree_learner.cpp)
+            if self.mesh is None or not self._mesh_shards_rows_only():
+                raise LightGBMError(
+                    "distributed-loaded datasets train with "
+                    "tree_learner=data (row sharding) only")
+            self.dd = dd
+            from ..parallel.dist_data import make_global_bins
+            self._row_sharding = data_sharding(self.mesh)
+            self._row_axis = self._row_sharding.spec[0]
+            bins = make_global_bins(np.asarray(dd.bins), self.mesh,
+                                    self._row_axis)
+            dd = dd._replace(bins=bins)
+            self._mesh_stream = (self._resolve_hist_backend() == "stream")
+            if self.objective is not None:
+                # committed single-device arrays cannot enter multi-process
+                # computations; numpy rebinds as replicated values
+                for a in self.objective.data_bound_attrs():
+                    v = getattr(self.objective, a, None)
+                    if v is not None:
+                        setattr(self.objective, a, np.asarray(v))
+        elif self.mesh is not None:
+            # resolve the backend on the pre-shard view: the stream kernel
+            # needs rows padded to a whole block per device
+            self.dd = dd
+            pad_base = 256
+            if self._resolve_hist_backend() == "stream":
+                from ..pallas.stream_kernel import stream_block_rows
+                self._mesh_stream = True
+                pad_base = stream_block_rows(dd.max_bins, dd.num_groups)
+            n_pad = pad_rows_for_mesh(dd.bins.shape[0], self.mesh,
+                                      base=pad_base)
             bins = dd.bins
             if n_pad != bins.shape[0]:
                 bins = jnp.pad(bins, ((0, n_pad - bins.shape[0]), (0, 0)))
@@ -112,13 +158,14 @@ class GBDT:
                 # to ONE consistent SPMD program (mixed placements would race the
                 # in-process collectives)
                 self._row_sharding = data_sharding(self.mesh)
+                self._row_axis = self._row_sharding.spec[0]
         self.dd = dd
         n = dd.bins.shape[0]                  # padded row count
         self.num_data = train_data.num_data()
 
-        # row-pad mask: padded rows contribute nothing
-        pad_mask = np.zeros(n, np.float32)
-        pad_mask[:self.num_data] = 1.0
+        # row-pad mask: padded rows contribute nothing (distributed layouts
+        # pad per shard, so the mask is not a prefix — Dataset knows)
+        pad_mask = train_data.get_true_row_mask(n)
         self._pad_mask = self._shard_row_array(jnp.asarray(pad_mask))
 
         k = self.num_tree_per_iteration
@@ -147,6 +194,12 @@ class GBDT:
             packed = pack_bins_T(dd.bins,
                                  stream_block_rows(dd.max_bins,
                                                    dd.num_groups)).bins_T
+            if self._mesh_stream:
+                # rows were pre-padded to a whole kernel block per device, so
+                # the packed words split evenly across the row axis
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                packed = jax.device_put(
+                    packed, NamedSharding(self.mesh, P(None, self._row_axis)))
         elif self._grow_params.hist_backend == "pallas":
             from ..pallas.hist_kernel import pack_bins
             packed = pack_bins(dd.bins)
@@ -160,7 +213,9 @@ class GBDT:
                               monotone=self._monotone_array(),
                               interaction_groups=self._interaction_group_masks(),
                               forced=self._parse_forced_splits(),
-                              cegb_coupled=self._cegb_coupled_array()))
+                              cegb_coupled=self._cegb_coupled_array(),
+                              mesh=self.mesh if self._mesh_stream else None,
+                              row_axis=self._row_axis))
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
                            if self._grow_params.has_cegb else None)
         self._voting = False
@@ -257,30 +312,56 @@ class GBDT:
             a, NamedSharding(self._row_sharding.mesh, P(spec[0], None)))
 
     # ------------------------------------------------------------------
+    def _mesh_shards_rows_only(self) -> bool:
+        """True when the mesh shards bins on the row axis alone — the layout
+        the per-device stream kernel + histogram psum path requires."""
+        if self.mesh is None:
+            return False
+        from ..parallel.mesh import bins_sharding
+        spec = bins_sharding(self.mesh, self.config.tree_learner).spec
+        return len(spec) == 1 or spec[1] is None
+
     def _resolve_hist_backend(self) -> str:
-        """Pick the histogram backend. The Pallas kernels are single-device
-        programs; under a GSPMD mesh the contraction-based backends partition
-        automatically (row-sharded histograms turn into psum), so auto selects
-        them there instead."""
+        """Pick the histogram backend. Under a row-sharded mesh the stream
+        kernel runs per-device inside shard_map with a histogram psum (the
+        reference's per-worker fast path + ReduceScatter,
+        data_parallel_tree_learner.cpp:285-299); feature-sharded meshes use
+        the contraction backends, which GSPMD partitions automatically."""
         b = self.config.hist_backend
-        if b != "auto":
-            return b
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if self.mesh is not None:
+            if self._voting_planned:
+                # the PV-Tree shard_map learner ignores the hist backend;
+                # avoid packing a stream layout it would never read
+                return "onehot" if on_tpu else "segsum"
+            rows_only = self._mesh_shards_rows_only()
+            if b == "stream" or (b == "auto" and on_tpu and rows_only
+                                 and self._stream_fits()):
+                if not rows_only:
+                    raise LightGBMError(
+                        "hist_backend=stream under a mesh needs row-only "
+                        "sharding (tree_learner=data); feature sharding "
+                        "cannot stream packed group words")
+                return "stream"
+            if b != "auto":
+                return b
             return "onehot" if on_tpu else "segsum"
+        if b != "auto":
+            return b
         if on_tpu and self._stream_fits():
             return "stream"
         return "pallas" if on_tpu else "segsum"
 
     def _stream_fits(self) -> bool:
         """The fused streaming kernel keeps the whole (G*B, 2S) histogram block
-        and the (L, T) leaf one-hot resident in VMEM (~16 MB/core)."""
+        and the (L, T) leaf one-hot resident in VMEM (~16 MB/core); the block
+        row count steps down to 256 for wide layouts (stream_block_rows)."""
         L = max(self.config.num_leaves, 2)
         S = 2 * min(max(1, self.config.max_splits_per_round), max(L - 1, 1))
         G = self.dd.num_groups
         Bpad = -(-self.dd.max_bins // 8) * 8
         hist_bytes = G * Bpad * S * 4
-        onehot_bytes = G * Bpad * 1024 * 2      # (G*B, T) bf16 MXU operand
+        onehot_bytes = G * Bpad * 256 * 2       # (G*B, T) bf16 at minimum T
         return (L <= 2048 and G <= 512 and hist_bytes <= 8 * 2 ** 20
                 and onehot_bytes <= 8 * 2 ** 20
                 and S <= 2 * 255)   # slot ids must stay bf16-exact (<= 255)
@@ -485,6 +566,11 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_data, name: str, metrics: Sequence[Metric]) -> None:
+        if getattr(self, "_dist_mode", False):
+            raise LightGBMError(
+                "validation sets are not yet supported with "
+                "distributed-loaded training data; evaluate after training "
+                "with Booster.predict on each process's shard")
         self.valid_sets.append(valid_data)
         self.valid_names.append(name)
         self.valid_metrics.append(list(metrics))

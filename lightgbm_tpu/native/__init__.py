@@ -82,10 +82,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
 def parse_csv(path: str, delim: str = ",", skip_header: bool = False
               ) -> Optional[np.ndarray]:
     """Parse a delimited file natively; returns None if the library is unavailable."""
+    return parse_csv_bytes(Path(path).read_bytes(), delim, skip_header)
+
+
+def parse_csv_bytes(buf: bytes, delim: str = ",", skip_header: bool = False
+                    ) -> Optional[np.ndarray]:
+    """Parse an in-memory delimited blob (e.g. one rank's file shard) with
+    the same native parser as parse_csv, so distributed and single-process
+    loads produce bit-identical doubles."""
     lib = get_lib()
     if lib is None:
         return None
-    buf = Path(path).read_bytes()
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()
     lib.lgbt_rows_cols(buf, len(buf), delim.encode()[0:1], int(skip_header),

@@ -141,7 +141,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               key: Optional[jax.Array] = None,
               packed=None, forced=None, cegb_coupled=None,
               cegb_used=None,
-              gh_scales: Optional[jax.Array] = None
+              gh_scales: Optional[jax.Array] = None,
+              mesh=None, row_axis: Optional[str] = None,
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
@@ -154,7 +155,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     the engine packs once per training run instead of once per tree.
     forced: static forced-split levels (reference: serial_tree_learner.cpp:628
     ForceSplits) — tuple of (leaf_ids, feats, thr_bins, default_lefts) tuples
-    applied as unrolled rounds before gain-driven growth."""
+    applied as unrolled rounds before gain-driven growth.
+    mesh/row_axis: when set, the streaming kernel runs per-device under
+    shard_map over the row axis and its histogram block is psum'd — the
+    reference's per-worker fast histogram path + ReduceScatter
+    (data_parallel_tree_learner.cpp:285-299); all other backends partition
+    via GSPMD without this."""
     N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
@@ -249,16 +255,47 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         w_T = jnp.zeros((8, n_pad), f32)
         w_T = (w_T.at[0, :N].set(w_grad).at[1, :N].set(w_hess)
                   .at[2, :N].set(cnt_w))
+
+        if mesh is not None:
+            # data-parallel stream path: per-device kernel + histogram psum —
+            # the reference's per-worker histogram construction followed by
+            # ReduceScatter (data_parallel_tree_learner.cpp:285-299)
+            from jax.sharding import PartitionSpec as P
+
+            def _rh(bT, lid_row, wT, tb, bi, num_slots):
+                def _local(bT, lid_row, wT, tb, bi):
+                    nl, h, c = route_and_hist(
+                        bT, lid_row, wT, tb, bi, num_slots, Bmax, G, L,
+                        block_rows=T_rows, has_cat=params.has_categorical,
+                        two_pass=params.hist_two_pass, int_weights=use_int)
+                    return (nl, jax.lax.psum(h, row_axis),
+                            jax.lax.psum(c, row_axis))
+
+                return jax.shard_map(
+                    _local, mesh=mesh,
+                    in_specs=(P(None, row_axis), P(None, row_axis),
+                              P(None, row_axis), P(None, None),
+                              P(None, None)),
+                    out_specs=(P(None, row_axis),
+                               P(None, None, None, None), P(None)),
+                    # pallas_call cannot annotate varying-mesh-axes on its
+                    # outputs; the psum above makes hist/cnt replicated
+                    check_vma=False,
+                )(bT, lid_row, wT, tb, bi)
+        else:
+            def _rh(bT, lid_row, wT, tb, bi, num_slots):
+                return route_and_hist(
+                    bT, lid_row, wT, tb, bi, num_slots, Bmax, G, L,
+                    block_rows=T_rows, has_cat=params.has_categorical,
+                    two_pass=params.hist_two_pass, int_weights=use_int)
+
         zL = jnp.zeros(L, i32)
         tabs0 = build_route_tables(zL, zL, zL, zL, zL, zL, zL,
                                    zL.at[0].set(1), routing, L)
         bits0 = jnp.zeros((Bpad, L), jnp.bfloat16)
         leaf_id = jnp.zeros(n_pad, i32)
-        _, root_hist, _ = route_and_hist(
-            bins_T, leaf_id.reshape(1, -1), w_T, tabs0, bits0,
-            1, Bmax, G, L, block_rows=T_rows,
-            has_cat=params.has_categorical, two_pass=params.hist_two_pass,
-            int_weights=use_int)
+        _, root_hist, _ = _rh(bins_T, leaf_id.reshape(1, -1), w_T, tabs0,
+                              bits0, 1)
         if use_int:
             root_hist = root_hist.astype(f32) * hscale
     else:
@@ -479,12 +516,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
                     leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
                 with jax.named_scope("route_and_hist"):
-                    new_leaf_row, hist_small, slot_cnt = route_and_hist(
+                    new_leaf_row, hist_small, slot_cnt = _rh(
                         bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
-                        bits_l.T, S, Bmax, G, L, block_rows=T_rows,
-                        has_cat=params.has_categorical,
-                        two_pass=params.hist_two_pass,
-                        int_weights=use_int)
+                        bits_l.T, S)
                 if use_int:
                     hist_small = hist_small.astype(f32) * hscale
                 new_leaf_id = new_leaf_row.reshape(-1)

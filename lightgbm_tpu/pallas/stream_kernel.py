@@ -48,7 +48,15 @@ from .hist_kernel import _wsplit  # shared f32 -> (hi, lo) bf16 split
 
 NUM_TAB = 24          # per-leaf table rows (padded to a sublane multiple)
 MAX_SLOTS = 255       # slot table rows are single bf16 digits (exact <= 256)
-_INTERPRET = False    # flipped by tests to run on CPU in interpret mode
+_INTERPRET = False    # force-interpret override (tests)
+
+
+def _interp() -> bool:
+    """Pallas interpret mode: forced by tests, or automatic off-TPU so the
+    stream backend is runnable on CPU meshes (dryruns, distributed tests)."""
+    return _INTERPRET or jax.default_backend() not in ("tpu", "axon")
+
+
 import os as _os
 # Perf-ablation probes (dev only): additive variants that double one kernel
 # phase so its cost can be measured through the real bench. Several modes
@@ -79,9 +87,13 @@ def _digits(v):
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, hist_ref, cnt_ref, *, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True,
-                       int_weights: bool = False):
+                       int_weights: bool = False, f32_dots: bool = False):
     b = pl.program_id(0)
-    i32, bf16, f32 = jnp.int32, jnp.bfloat16, jnp.float32
+    i32, f32 = jnp.int32, jnp.float32
+    # interpret mode on CPU: XLA:CPU's Eigen DotThunk rejects bf16 at some
+    # shapes; f32 operands carry the identical (bf16-rounded) values, so the
+    # contraction results match the TPU MXU's bf16 x bf16 -> f32 exactly
+    bf16 = f32 if f32_dots else jnp.bfloat16
 
     # ---------------- route ----------------
     lid = leaf_ref[0:1, :]                                   # (1, T) i32
@@ -128,7 +140,8 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     go_left_i = jnp.where(is_nan_i > 0, defleft_i, le_thr)
     if has_cat:
         # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, then pick fb
-        br = jax.lax.dot_general(bits_ref[...], leaf_oh, (((1,), (0,)), ((), ())),
+        br = jax.lax.dot_general(bits_ref[...].astype(bf16), leaf_oh,
+                                 (((1,), (0,)), ((), ())),
                                  preferred_element_type=f32)  # (B, T)
         b_iota_c = jax.lax.broadcasted_iota(i32, (B, T), 0)
         cat_bit = jnp.sum(jnp.where(b_iota_c == fb, br, 0.0), axis=0,
@@ -183,13 +196,20 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         # convert the (2S, T) operand to int8 once
         slot_oh_i = (s_iota == slot).astype(i32)
         w_i = jnp.round(w2).astype(i32)                      # int-valued rows
-        A_i8 = jnp.concatenate(
-            [w_i[c:c + 1, :] * slot_oh_i for c in range(2)],
-            axis=0).astype(jnp.int8)
-        oh_i8 = oh_match.astype(jnp.int8)
-        hist_ref[...] += jax.lax.dot_general(
-            oh_i8.reshape(G * B, T), A_i8, (((1,), (1,)), ((), ())),
-            preferred_element_type=i32)
+        A_i = jnp.concatenate(
+            [w_i[c:c + 1, :] * slot_oh_i for c in range(2)], axis=0)
+        if f32_dots:
+            # CPU interpret: f32 products of |v| <= 127 ints are exact and
+            # per-block sums stay below 2^24, so rounding back is lossless
+            d = jax.lax.dot_general(
+                oh_match.astype(f32).reshape(G * B, T), A_i.astype(f32),
+                (((1,), (1,)), ((), ())), preferred_element_type=f32)
+            hist_ref[...] += d.astype(i32)
+        else:
+            hist_ref[...] += jax.lax.dot_general(
+                oh_match.astype(jnp.int8).reshape(G * B, T),
+                A_i.astype(jnp.int8), (((1,), (1,)), ((), ())),
+                preferred_element_type=i32)
         return
 
     # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot; the reference's
@@ -204,8 +224,9 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     def build_A(w):
         # (1, T) x (S, T) broadcast-multiplies + sublane concat; the 3-D
         # broadcast form lowers to a much slower relayout
-        return jnp.concatenate([w[c:c + 1, :] * slot_oh for c in range(2)],
-                               axis=0)                       # (2S, T)
+        return jnp.concatenate(
+            [w[c:c + 1, :].astype(bf16) * slot_oh for c in range(2)],
+            axis=0)                                          # (2S, T)
 
     A_hi = build_A(w_hi)
     if _ABLATE == "dblA":        # perf probe: one extra A-operand build
@@ -246,17 +267,21 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
 def stream_block_rows(bmax: int, num_groups: int = 28) -> int:
     """Rows per kernel block. 2048 measures ~2% faster than 1024 on v5e when
     the (G*B, T) bf16 one-hot operand stays within ~8 MB of VMEM; 4096
-    REGRESSES 5x (VMEM pressure kills the pipeline)."""
+    REGRESSES 5x (VMEM pressure kills the pipeline). Wide layouts (many EFB
+    groups, e.g. high-dimensional sparse data) step down to 512/256-row
+    blocks so the operand still fits."""
     import os
     env = os.environ.get("LGBTPU_BLOCK_ROWS")
     if env:
         return int(env)
     if jax.default_backend() not in ("tpu", "axon"):
-        # CPU interpret mode: 2048-wide bf16 dots cross XLA:CPU's threshold
-        # into its Eigen DotThunk, which rejects bf16
+        # CPU interpret mode: keep dots narrow for XLA:CPU
         return 1024
     B = -(-bmax // 8) * 8
-    return 2048 if num_groups * B * 2048 * 2 <= 8 * 2 ** 20 else 1024
+    for T in (2048, 1024, 512, 256):
+        if num_groups * B * T * 2 <= 8 * 2 ** 20:
+            return T
+    return 256
 
 
 class StreamLayout(NamedTuple):
@@ -312,7 +337,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
     new_leaf, hist, cnt = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
                           has_cat=has_cat, two_pass=two_pass,
-                          int_weights=int_weights),
+                          int_weights=int_weights, f32_dots=_interp()),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),
@@ -333,7 +358,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-        interpret=_INTERPRET,
+        interpret=_interp(),
     )(bins_T, leaf_id, w_T, tabs, bits)
 
     # (G*B, 2S) -> (S, G, Bmax, 2); int histograms are unscaled by the caller
@@ -379,7 +404,7 @@ def leaf_gather(leaf_id: jax.Array, values: jax.Array,
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-        interpret=_INTERPRET,
+        interpret=_interp(),
     )(lid, values.reshape(1, L).astype(jnp.float32))
     return out.reshape(-1)[:N]
 

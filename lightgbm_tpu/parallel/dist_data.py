@@ -1,0 +1,111 @@
+"""Distributed (multi-process) dataset ingestion.
+
+Reference: DatasetLoader::LoadFromFile(rank, num_machines) — each machine
+parses its own row shard of the shared file, bin mappers are found from
+per-rank samples and synchronized across machines (dataset_loader.cpp:211,
+733-741, 1240-1248) so every rank bins identically, and training runs on the
+union without any single host ever holding the full feature matrix.
+
+TPU re-design: ranks are jax processes. Mapper sync = host-level allgather of
+the per-rank samples (jax.experimental.multihost_utils) followed by a
+DETERMINISTIC mapper computation on every process — equivalent to the
+reference's mapper Allgather but without serializing mapper objects. The
+binned shard is assembled into one global row-sharded device array with
+jax.make_array_from_process_local_data; per-row metadata (label/weight/
+position — O(N) scalars, not the O(N*F) features) is allgathered to every
+host in shard-padded order so the whole existing engine works unchanged.
+
+Row layout: every rank pads its shard to a common n_shard (a multiple of
+2048 * local_device_count, covering the stream kernel's largest block), and
+the global row space is the rank-ordered concatenation of padded shards.
+Pad rows carry weight 0 and a 0 entry in the true-row mask, so they take no
+part in histograms, counts, or metrics.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import LightGBMError
+
+
+def dist_context() -> Optional[Tuple[int, int]]:
+    """(process_index, process_count) when running multi-process, else None."""
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    return jax.process_index(), jax.process_count()
+
+
+def allgather_np(x: np.ndarray) -> np.ndarray:
+    """Allgather equal-shape host arrays; returns (P, *x.shape).
+
+    64-bit dtypes ride as uint32 pairs — jax would silently downcast them
+    to 32 bits (x64 disabled), which must not corrupt sample values that
+    feed bin-boundary computation."""
+    from jax.experimental import multihost_utils
+    x = np.ascontiguousarray(np.asarray(x))
+    wide = x.dtype in (np.dtype(np.float64), np.dtype(np.int64))
+    if wide:
+        orig = x.dtype
+        x = x.view(np.uint32)        # last axis doubles
+    g = np.asarray(multihost_utils.process_allgather(x))
+    if wide:
+        g = g.view(orig)
+    return g
+
+
+def shard_pad_base() -> int:
+    """Per-shard row padding: covers the stream kernel's largest block per
+    local device so the assembled global array splits evenly."""
+    import jax
+    return 2048 * max(jax.local_device_count(), 1)
+
+
+def pad_rows(a: Optional[np.ndarray], n_shard: int, fill=0.0
+             ) -> Optional[np.ndarray]:
+    if a is None:
+        return None
+    pad = [(0, n_shard - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad, constant_values=fill)
+
+
+def gather_padded(a: Optional[np.ndarray], n_shard: int, fill=0.0
+                  ) -> Optional[np.ndarray]:
+    """Pad the local per-row array to n_shard and allgather into the global
+    shard-ordered layout (P * n_shard rows)."""
+    if a is None:
+        return None
+    g = allgather_np(pad_rows(a, n_shard, fill))
+    return g.reshape((-1,) + a.shape[1:])
+
+
+def gather_sample(sample: np.ndarray) -> np.ndarray:
+    """Allgather per-rank sample rows (padded to the largest rank's count)
+    and return only the valid rows, rank-ordered — the input every process
+    feeds to the deterministic mapper/EFB computation (reference:
+    bin-mapper Allgather, dataset_loader.cpp:733-741)."""
+    cnt = np.asarray([sample.shape[0]], np.int64)
+    counts = allgather_np(cnt).reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m,) + sample.shape[1:], sample.dtype)
+    padded[:sample.shape[0]] = sample
+    gathered = allgather_np(padded)
+    return np.concatenate([gathered[r, :counts[r]]
+                           for r in range(len(counts))], axis=0)
+
+
+def make_global_bins(local_bins: np.ndarray, mesh, row_axis: str):
+    """Assemble per-process binned shards into one global row-sharded device
+    array (the features never leave their host except to its own devices)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P(row_axis, None))
+    return jax.make_array_from_process_local_data(sh, local_bins)
+
+
+def check_uniform_features(num_feature: int) -> int:
+    """LibSVM shards can infer different widths; agree on the max."""
+    widths = allgather_np(np.asarray([num_feature], np.int64)).reshape(-1)
+    return int(widths.max())

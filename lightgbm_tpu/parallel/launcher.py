@@ -18,8 +18,10 @@ tpu-vm ssh --worker=all`):
     #                      num_processes=4, process_id=rank)
     bst = lgb.train({"tree_learner": "data", ...}, dset)
 
-Every process must execute the same calls with the same data order; the
-framework shards rows across the GLOBAL device list.
+Every process must execute the same calls; passing a FILE PATH to Dataset
+under multi-process training loads only this rank's row shard (bin mappers
+sync automatically — see parallel/dist_data.py), so no host ever holds the
+full feature matrix. In-memory arrays must still be identical everywhere.
 """
 from __future__ import annotations
 

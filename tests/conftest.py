@@ -76,3 +76,7 @@ def make_synthetic_ranking(nq=100, docs_per_q=(5, 40), f=10, seed=0):
         y[start:start + s] = np.minimum(4, (ranks * 5) // max(s, 1))
         start += s
     return X, y, sizes
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
