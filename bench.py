@@ -11,6 +11,12 @@ reduced bin count (0.845209 GPU-63 vs 0.845724 CPU-255).  This benchmark
 follows that exact protocol on the TPU: the FULL 10.5M-row workload (no row
 scaling), max_bin=63, num_leaves=255, and an AUC gate on a held-out split so a
 fast-but-wrong regression cannot pass.
+
+BENCH_TASK=ranking switches to the second north-star workload: an
+MSLR-WEB30K-shaped lambdarank run (2.27M docs x 136 features, ~120 docs per
+query, 5 relevance grades, num_leaves=255) against the published CPU
+baseline 70.417 s / 500 trees (docs/Experiments.rst:117), gated on holdout
+NDCG@10.
 """
 import json
 import os
@@ -39,6 +45,98 @@ def make_higgs_like(n, f, seed=7):
     p = 1.0 / (1.0 + np.exp(-1.2 * logit))
     y = (rs.rand(n) < p).astype(np.float64)
     return X, y
+
+
+def make_mslr_like(n_docs, f, docs_per_q=120, seed=11):
+    """Synthetic MSLR-WEB30K-shaped ranking task: ~120 docs/query, graded
+    0-4 relevance driven by a few informative features + noise."""
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n_docs, f).astype(np.float32)
+    rel = (1.2 * X[:, 0] + 0.9 * X[:, 1] - 0.7 * X[:, 2]
+           + 0.5 * X[:, 3] * X[:, 4] + 0.8 * rs.randn(n_docs))
+    nq = max(1, n_docs // docs_per_q)
+    sizes = np.full(nq, docs_per_q, np.int64)
+    sizes[-1] += n_docs - sizes.sum()
+    # per-query grade assignment: top fractions get higher grades
+    y = np.zeros(n_docs)
+    start = 0
+    for s in sizes:
+        seg = rel[start:start + s]
+        ranks = np.argsort(np.argsort(seg))
+        frac = ranks / max(s - 1, 1)
+        y[start:start + s] = np.select(
+            [frac >= 0.98, frac >= 0.92, frac >= 0.80, frac >= 0.55],
+            [4, 3, 2, 1], default=0)
+        start += s
+    return X, y, sizes
+
+
+def ndcg_at_k(y, score, sizes, k=10):
+    out = []
+    start = 0
+    gains = 2.0 ** y - 1.0
+    for s in sizes:
+        seg_g = gains[start:start + s]
+        seg_s = score[start:start + s]
+        if seg_g.max() > 0:
+            order = np.argsort(-seg_s)[:k]
+            disc = 1.0 / np.log2(np.arange(2, 2 + len(order)))
+            dcg = float(np.sum(seg_g[order] * disc))
+            ideal = np.sort(seg_g)[::-1][:k]
+            idcg = float(np.sum(ideal * disc[:len(ideal)]))
+            out.append(dcg / idcg)
+        start += s
+    return float(np.mean(out))
+
+
+def run_ranking():
+    import lightgbm_tpu as lgb
+
+    n_docs = int(os.environ.get("BENCH_RANK_ROWS", 2_270_000))
+    n_iters = int(os.environ.get("BENCH_RANK_ITERS", 20))
+    gate = float(os.environ.get("BENCH_NDCG_GATE", 0.70))
+    baseline_s_per_tree = 70.417 / 500.0   # MSLR CPU, Experiments.rst:117
+    X, y, sizes = make_mslr_like(n_docs, 136)
+    # holdout: last ~10% of queries
+    q_split = int(len(sizes) * 0.9)
+    d_split = int(np.sum(sizes[:q_split]))
+    params = {
+        "objective": "lambdarank",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "verbosity": -1,
+        "max_splits_per_round": 64,
+        "ndcg_eval_at": [10],
+    }
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
+    ds = lgb.Dataset(X[:d_split], label=y[:d_split], group=sizes[:q_split])
+    bst = lgb.Booster(params, ds)
+    bst.update()
+    bst.engine.score.block_until_ready()
+    t0 = time.time()
+    for _ in range(n_iters):
+        bst.update()
+    bst.engine.score.block_until_ready()
+    s_per_tree = (time.time() - t0) / n_iters
+    s_per_tree_full = s_per_tree * (2_270_000 / n_docs)
+    vs_baseline = baseline_s_per_tree / s_per_tree_full
+
+    score = np.asarray(bst.predict(X[d_split:], raw_score=True))
+    ndcg = ndcg_at_k(y[d_split:], score, sizes[q_split:], 10)
+    ok = ndcg >= gate
+    print(json.dumps({
+        "metric": "mslr_like_lambdarank_s_per_tree_2p27M_docs",
+        "value": round(s_per_tree_full, 4),
+        "unit": (f"s/tree (lower is better; 2.27M docs, 255 leaves, 63 bins, "
+                 f"holdout NDCG@10 {ndcg:.4f} "
+                 f"{'>=' if ok else '< GATE '}{gate})"),
+        "vs_baseline": round(vs_baseline, 3) if ok else 0.0,
+    }))
+    if not ok:
+        sys.exit(1)
 
 
 def auc_score(y, p):
@@ -109,4 +207,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_TASK", "") == "ranking":
+        run_ranking()
+    else:
+        main()

@@ -569,7 +569,8 @@ class Dataset:
             "group_features": [list(map(int, g)) for g in b.group_features],
             "mappers": [[int(m.bin_type), int(m.missing_type),
                          int(m.num_bins), int(m.default_bin),
-                         int(m.most_freq_bin)] for m in mappers],
+                         int(m.most_freq_bin), float(m.min_val),
+                         float(m.max_val)] for m in mappers],
         }
         meta_b = json.dumps(meta).encode()
         with open(filename, "wb") as f:
@@ -611,14 +612,17 @@ class Dataset:
                 f"failed to load binary dataset {path}: {exc}") from exc
         mappers = []
         ub_off = cat_off = 0
-        for i, (bt, mt, nb, db, mfb) in enumerate(meta["mappers"]):
+        for i, ms in enumerate(meta["mappers"]):
+            bt, mt, nb, db, mfb = ms[:5]
+            mn, mx = (ms[5], ms[6]) if len(ms) > 6 else (0.0, 0.0)
             ub_n = int(blob["mapper_ub_len"][i])
             cat_n = int(blob["mapper_cats_len"][i])
             mappers.append(BinMapper(
                 upper_bounds=blob["mapper_ub"][ub_off:ub_off + ub_n],
                 bin_type=bt, missing_type=mt,
                 categories=blob["mapper_cats"][cat_off:cat_off + cat_n],
-                num_bins=nb, default_bin=db, most_freq_bin=mfb))
+                num_bins=nb, default_bin=db, most_freq_bin=mfb,
+                min_val=mn, max_val=mx))
             ub_off += ub_n
             cat_off += cat_n
         self.binned = BinnedData(

@@ -43,6 +43,8 @@ class BinMapper:
     num_bins: int = 1
     default_bin: int = 0          # bin that value 0.0 maps to (sparse default)
     most_freq_bin: int = 0
+    min_val: float = 0.0          # sampled value range (feature_infos)
+    max_val: float = 0.0
 
     @property
     def is_trivial(self) -> bool:
@@ -53,40 +55,67 @@ class BinMapper:
     def find_numerical(sample: np.ndarray, max_bin: int, min_data_in_bin: int,
                        use_missing: bool, zero_as_missing: bool,
                        total_sample_cnt: Optional[int] = None) -> "BinMapper":
-        """Find bin boundaries from sampled values.
+        """Find bin boundaries from sampled values — an exact port of the
+        reference's BinMapper::FindBin numerical path (src/io/bin.cpp:316:
+        NaN filtering and missing-type choice, zero-count restoration, and
+        FindBinWithZeroAsOneBin / GreedyFindBin boundary selection), so
+        thresholds in saved models match stock LightGBM digit-for-digit.
 
-        Equal-count greedy binning with dedicated bins for heavy-hitter values
-        (reference semantics of GreedyFindBin, src/io/bin.cpp)."""
+        total_sample_cnt: total rows the sample stands for; rows beyond
+        len(sample) are implicit zeros (sparse ingestion)."""
         sample = np.asarray(sample, dtype=np.float64)
-        na_mask = np.isnan(sample)
-        if zero_as_missing:
-            na_mask = na_mask | (np.abs(sample) <= _ZERO_UB)
-        vals = sample[~na_mask]
-        has_nan = bool(na_mask.any())
+        n_total = int(total_sample_cnt if total_sample_cnt is not None
+                      else len(sample))
+        vals = sample[~np.isnan(sample)]
+        n_nonnan = len(vals)
+        na_cnt = 0
+        if not use_missing:
+            missing_type = MISSING_NONE
+        elif zero_as_missing:
+            missing_type = MISSING_ZERO
+        elif n_nonnan == len(sample):
+            missing_type = MISSING_NONE
+        else:
+            missing_type = MISSING_NAN
+            na_cnt = len(sample) - n_nonnan
+        zero_cnt = n_total - n_nonnan - na_cnt
 
-        missing_type = MISSING_NONE
-        nan_bin_budget = 0
-        if use_missing and has_nan:
-            missing_type = MISSING_ZERO if zero_as_missing else MISSING_NAN
-            nan_bin_budget = 1
+        distinct, counts = _distinct_with_zero(vals, zero_cnt)
+        if len(distinct) == 0:
+            return BinMapper(missing_type=missing_type,
+                             num_bins=2 if missing_type == MISSING_NAN else 1)
+        min_val, max_val = float(distinct[0]), float(distinct[-1])
 
-        if vals.size == 0:
-            if nan_bin_budget:
-                m = BinMapper(upper_bounds=np.array([np.inf]),
-                              missing_type=missing_type, num_bins=2)
-                return m
-            return BinMapper()
+        if missing_type == MISSING_NAN:
+            bounds = _find_bin_zero_as_one_bin(distinct, counts, max_bin - 1,
+                                               n_total - na_cnt,
+                                               min_data_in_bin)
+            num_bins = len(bounds) + 1      # + NaN bin (last)
+        else:
+            bounds = _find_bin_zero_as_one_bin(distinct, counts, max_bin,
+                                               n_total, min_data_in_bin)
+            if missing_type == MISSING_ZERO and len(bounds) == 2:
+                missing_type = MISSING_NONE
+            num_bins = len(bounds)
 
-        uniq, counts = np.unique(vals, return_counts=True)
-        budget = max(1, max_bin - nan_bin_budget)
-        bounds = _greedy_find_bounds(uniq, counts, budget, min_data_in_bin)
-        num_bins = len(bounds) + nan_bin_budget
-
-        m = BinMapper(upper_bounds=np.asarray(bounds), missing_type=missing_type,
-                      num_bins=num_bins, bin_type=BIN_NUMERICAL)
+        m = BinMapper(upper_bounds=np.asarray(bounds, np.float64),
+                      missing_type=missing_type, num_bins=int(num_bins),
+                      bin_type=BIN_NUMERICAL)
+        m.min_val, m.max_val = min_val, max_val
+        if num_bins <= 1:
+            return m
+        # per-bin sample counts -> default/most_freq bins (bin.cpp:401-507)
+        cnt_in_bin = np.zeros(num_bins, np.int64)
+        idx = np.searchsorted(m.upper_bounds, distinct, side="left")
+        np.add.at(cnt_in_bin, np.minimum(idx, len(bounds) - 1), counts)
+        if missing_type == MISSING_NAN:
+            cnt_in_bin[num_bins - 1] = na_cnt
         m.default_bin = int(np.searchsorted(m.upper_bounds, 0.0, side="left"))
-        if missing_type == MISSING_ZERO:
-            m.default_bin = m.num_bins - 1  # zeros are the missing bin
+        most_freq = int(np.argmax(cnt_in_bin))
+        if most_freq != m.default_bin and \
+                cnt_in_bin[most_freq] / max(n_total, 1) < 0.7:  # kSparseThreshold
+            most_freq = m.default_bin
+        m.most_freq_bin = most_freq
         return m
 
     @staticmethod
@@ -140,26 +169,160 @@ class BinMapper:
                 hit = self.categories[sorter[pos]] == iv
                 out = np.where(hit, sorter[pos], 0).astype(np.int32)
             return out
-        # native fast path (C++/OpenMP binary search; reference: BinMapper::ValueToBin)
+        # reference ValueToBin (bin.h:613): NaN -> last bin when
+        # MissingType::NaN, else NaN binned as 0.0 (zero lives in its own
+        # [-kZeroThreshold, kZeroThreshold] window bin)
         from .native import value_to_bin as _native_v2b
         res = _native_v2b(values, self.upper_bounds, self.missing_type,
                           self.num_bins, self.default_bin)
         if res is not None:
             return res.astype(np.int32)
         nan_mask = np.isnan(values)
-        if self.missing_type == MISSING_ZERO:
-            nan_mask = nan_mask | (np.abs(values) <= _ZERO_UB)
-        out = np.searchsorted(self.upper_bounds, values, side="left").astype(np.int32)
+        out = np.searchsorted(self.upper_bounds,
+                              np.where(nan_mask, 0.0, values),
+                              side="left").astype(np.int32)
         out = np.clip(out, 0, len(self.upper_bounds) - 1)
-        if self.missing_type in (MISSING_NAN, MISSING_ZERO):
+        if self.missing_type == MISSING_NAN:
             out[nan_mask] = self.num_bins - 1
-        else:
-            out[nan_mask] = self.default_bin
         return out
 
     def bin_to_threshold(self, bin_idx: int) -> float:
         """Real-valued threshold for `value <= threshold` split at bin boundary."""
         return float(self.upper_bounds[min(bin_idx, len(self.upper_bounds) - 1)])
+
+
+def _distinct_with_zero(vals: np.ndarray, zero_cnt: int):
+    """Sorted distinct values + counts with the implicit zeros restored at
+    their sorted position (reference: BinMapper::FindBin, bin.cpp:344-380 —
+    a 0.0 entry is inserted between the last negative and first positive
+    distinct value even when zero_cnt is 0; adjacent values within one ulp
+    merge keeping the larger)."""
+    vals = np.sort(vals, kind="stable")
+    n = len(vals)
+    if n == 0:
+        if zero_cnt > 0:
+            return np.array([0.0]), np.array([zero_cnt], np.int64)
+        return np.array([]), np.array([], np.int64)
+    # merge ulp-adjacent duplicates (CheckDoubleEqualOrdered): a run where
+    # each value <= nextafter(previous) collapses to its LAST value
+    new_grp = np.empty(n, bool)
+    new_grp[0] = True
+    new_grp[1:] = vals[1:] > np.nextafter(vals[:-1], np.inf)
+    grp = np.cumsum(new_grp) - 1
+    k = int(grp[-1]) + 1
+    run_last = np.flatnonzero(np.append(new_grp[1:], True))
+    distinct = vals[run_last]                   # last (largest) of each run
+    counts = np.bincount(grp, minlength=k).astype(np.int64)
+
+    neg = distinct < 0.0
+    pos = distinct > 0.0
+    has_zero_val = np.any(~neg & ~pos)
+    if has_zero_val:
+        zi = int(np.flatnonzero(~neg & ~pos)[0])
+        counts[zi] += zero_cnt
+        return distinct, counts
+    insert_at = int(np.sum(neg))
+    if (insert_at == 0 and zero_cnt > 0) or \
+            (0 < insert_at < k) or \
+            (insert_at == k and zero_cnt > 0):
+        distinct = np.insert(distinct, insert_at, 0.0)
+        counts = np.insert(counts, insert_at, zero_cnt)
+    return distinct, counts
+
+
+def _greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+                     total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Exact port of GreedyFindBin (bin.cpp:81): per-value bins when the
+    budget allows (with min_data_in_bin coalescing), else heavy-hitter
+    values get dedicated bins and the rest greedily fill to a re-estimated
+    mean bin size; boundaries are nextafter'd midpoints."""
+    nd = len(distinct)
+    bounds: List[float] = []
+    if max_bin <= 0:
+        return bounds
+    if nd <= max_bin:
+        cur = 0
+        for i in range(nd - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                val = np.nextafter((distinct[i] + distinct[i + 1]) / 2.0,
+                                   np.inf)
+                if not bounds or val > np.nextafter(bounds[-1], np.inf):
+                    bounds.append(float(val))
+                    cur = 0
+        bounds.append(np.inf)
+        return bounds
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(np.sum(is_big))
+    rest_sample_cnt = int(total_cnt - counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    uppers: List[float] = []
+    lowers: List[float] = [float(distinct[0])]
+    cur = 0
+    for i in range(nd - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur += int(counts[i])
+        if is_big[i] or cur >= mean_bin_size or \
+                (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5)):
+            uppers.append(float(distinct[i]))
+            lowers.append(float(distinct[i + 1]))
+            if len(uppers) >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    for i in range(len(uppers)):
+        val = np.nextafter((uppers[i] + lowers[i + 1]) / 2.0, np.inf)
+        if not bounds or val > np.nextafter(bounds[-1], np.inf):
+            bounds.append(float(val))
+    bounds.append(np.inf)
+    return bounds
+
+
+_K_ZERO = 1e-35  # kZeroThreshold (meta.h:57): |v| <= ~0 shares the zero bin
+
+
+def _find_bin_zero_as_one_bin(distinct: np.ndarray, counts: np.ndarray,
+                              max_bin: int, total_cnt: int,
+                              min_data_in_bin: int) -> List[float]:
+    """Exact port of FindBinWithZeroAsOneBin (bin.cpp:247): negatives and
+    positives are binned separately with count-proportional budgets and the
+    zero window [-kZeroThreshold, kZeroThreshold] is its own bin."""
+    left_cnt_data = int(counts[distinct <= -_K_ZERO].sum())
+    cnt_zero = int(counts[(distinct > -_K_ZERO) & (distinct <= _K_ZERO)].sum())
+    right_cnt_data = int(counts[distinct > _K_ZERO].sum())
+
+    gt = np.flatnonzero(distinct > -_K_ZERO)
+    left_cnt = int(gt[0]) if len(gt) else len(distinct)
+
+    bounds: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        denom = max(total_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bounds = _greedy_find_bin(distinct[:left_cnt], counts[:left_cnt],
+                                  left_max_bin, left_cnt_data,
+                                  min_data_in_bin)
+        if bounds:
+            bounds[-1] = -_K_ZERO
+
+    rs = np.flatnonzero(distinct[left_cnt:] > _K_ZERO)
+    right_start = left_cnt + int(rs[0]) if len(rs) else -1
+
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        right = _greedy_find_bin(distinct[right_start:], counts[right_start:],
+                                 right_max_bin, right_cnt_data,
+                                 min_data_in_bin)
+        bounds.append(_K_ZERO)
+        bounds.extend(right)
+    else:
+        bounds.append(np.inf)
+    return bounds
 
 
 def _greedy_find_bounds(uniq: np.ndarray, counts: np.ndarray, max_bin: int,

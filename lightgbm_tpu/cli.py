@@ -123,6 +123,55 @@ def run_predict(params: Dict[str, Any]) -> None:
     log_info(f"Finished prediction; results saved to {out}")
 
 
+def run_refit(params: Dict[str, Any]) -> None:
+    """Refit leaf values of an existing model on new data (reference:
+    Application task=refit, application.cpp:236; GBDT::RefitTree)."""
+    data_path = params.get("data")
+    model_path = params.get("input_model")
+    if not data_path or not model_path:
+        raise LightGBMError("task=refit requires data=<file> and "
+                            "input_model=<file>")
+    from .dataset_io import load_data_file
+    X, label, _ = load_data_file(str(data_path), dict(params))
+    if label is None:
+        raise LightGBMError("task=refit requires labeled data")
+    bst = Booster(model_file=str(model_path), params=dict(params))
+    out = bst.refit(X, label,
+                    decay_rate=float(params.get("refit_decay_rate", 0.9)))
+    out_model = str(params.get("output_model", "LightGBM_model.txt"))
+    out.save_model(out_model)
+    log_info(f"Finished refit; model saved to {out_model}")
+
+
+def run_save_binary(params: Dict[str, Any]) -> None:
+    """Bin the data file once and save the reusable binary dataset
+    (reference: Application task=save_binary, application.cpp:217)."""
+    data_path = params.get("data")
+    if not data_path:
+        raise LightGBMError("task=save_binary requires data=<file>")
+    ds = Dataset(str(data_path), params=dict(params))
+    ds.construct()
+    out = str(params.get("output_model", str(data_path) + ".bin"))
+    ds.save_binary(out)
+    log_info(f"Finished save_binary; dataset saved to {out}")
+
+
+def run_convert_model(params: Dict[str, Any]) -> None:
+    """Convert a model file to JSON (reference: task=convert_model,
+    application.cpp; the reference's if-else C++ codegen is a non-goal —
+    the JSON dump carries the same tree structure)."""
+    model_path = params.get("input_model")
+    if not model_path:
+        raise LightGBMError("task=convert_model requires input_model=<file>")
+    import json
+    bst = Booster(model_file=str(model_path))
+    out = str(params.get("convert_model", params.get(
+        "output_model", "model_convert.json")))
+    with open(out, "w") as fh:
+        json.dump(bst.dump_model(), fh, indent=2)
+    log_info(f"Finished convert_model; JSON saved to {out}")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
@@ -135,8 +184,11 @@ def main(argv=None) -> int:
     elif task in ("predict", "prediction", "test"):
         run_predict(params)
     elif task == "refit":
-        raise LightGBMError("task=refit is not implemented in the CLI yet; "
-                            "use Booster.refit from Python")
+        run_refit(params)
+    elif task == "save_binary":
+        run_save_binary(params)
+    elif task == "convert_model":
+        run_convert_model(params)
     else:
         raise LightGBMError(f"unknown task {task!r}")
     return 0

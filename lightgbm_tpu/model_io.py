@@ -70,9 +70,13 @@ def _feature_infos(booster) -> List[str]:
         elif m.bin_type == BIN_CATEGORICAL:
             infos.append(":".join(str(int(c)) for c in m.categories))
         else:
-            ub = m.upper_bounds
-            lo = float(ub[0]) if len(ub) else 0.0
-            hi = float(ub[-2]) if len(ub) >= 2 else lo
+            # reference: [min_val:max_val] of the sampled data
+            # (gbdt_model_text.cpp writes BinMapper min/max)
+            lo, hi = float(m.min_val), float(m.max_val)
+            if lo == 0.0 and hi == 0.0 and len(m.upper_bounds):
+                ub = m.upper_bounds
+                lo = float(ub[0])
+                hi = float(ub[-2]) if len(ub) >= 2 else lo
             infos.append(f"[{_fmt_double(lo)}:{_fmt_double(hi)}]")
     return infos
 

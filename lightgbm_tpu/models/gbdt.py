@@ -386,6 +386,7 @@ class GBDT:
                                 for m in self.train_data.bin_mappers()),
             has_monotone=self._monotone_array() is not None,
             monotone_penalty=c.monotone_penalty,
+            monotone_intermediate=self._monotone_intermediate(),
             path_smooth=c.path_smooth,
             has_interaction=self._interaction_group_masks() is not None,
             extra_trees=c.extra_trees,
@@ -488,12 +489,22 @@ class GBDT:
                 f"has {F} features")
         if not np.any(arr):
             return None
-        if self.config.monotone_constraints_method not in ("basic",):
+        if self.config.monotone_constraints_method == "advanced":
+            log_warning(
+                "monotone_constraints_method='advanced' (per-threshold "
+                "refinement) is not implemented; using 'intermediate'")
+        elif self.config.monotone_constraints_method not in (
+                "basic", "intermediate"):
             log_warning(
                 f"monotone_constraints_method="
-                f"{self.config.monotone_constraints_method!r} is not implemented; "
-                "falling back to 'basic'")
+                f"{self.config.monotone_constraints_method!r} is not "
+                "implemented; falling back to 'basic'")
         return jnp.asarray(arr)
+
+    def _monotone_intermediate(self) -> bool:
+        return (self._monotone_array() is not None
+                and self.config.monotone_constraints_method
+                in ("intermediate", "advanced"))
 
     def _interaction_group_masks(self) -> Optional[jax.Array]:
         """(C, F) bool allowed-feature groups or None (reference: col_sampler.hpp;

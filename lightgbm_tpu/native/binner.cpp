@@ -168,14 +168,17 @@ void lgbt_value_to_bin(const double* values, int64_t n,
 #if defined(_OPENMP)
 #pragma omp parallel for schedule(static)
 #endif
+  // reference ValueToBin (bin.h:613): NaN -> last bin under
+  // MissingType::NaN (2); otherwise NaN is binned as 0.0 — the zero window
+  // [-kZeroThreshold, kZeroThreshold] is a real bin of its own
   for (int64_t i = 0; i < n; ++i) {
     double v = values[i];
-    bool miss = std::isnan(v);
-    if (missing_type == 1 && std::fabs(v) <= 1e-35) miss = true;
-    if (miss) {
-      out[i] = static_cast<uint16_t>(
-          missing_type == 0 ? default_bin : num_bins - 1);
-      continue;
+    if (std::isnan(v)) {
+      if (missing_type == 2) {
+        out[i] = static_cast<uint16_t>(num_bins - 1);
+        continue;
+      }
+      v = 0.0;
     }
     // first index with upper_bounds[idx] >= v
     int32_t lo = 0, hi = num_bounds - 1;

@@ -58,6 +58,11 @@ class GrowParams(NamedTuple):
     # col_sampler.hpp, feature_histogram.hpp path_smooth + extra_trees)
     has_monotone: bool = False
     monotone_penalty: float = 0.0
+    # intermediate method: per-round recompute of every leaf's bounds from
+    # the opposite subtrees' ACTUAL outputs (monotone_constraints.hpp:330+
+    # IntermediateLeafConstraints), instead of the basic method's frozen
+    # split-midpoint bounds
+    monotone_intermediate: bool = False
     path_smooth: float = 0.0
     has_interaction: bool = False
     extra_trees: bool = False
@@ -103,6 +108,10 @@ class _GrowState(NamedTuple):
     out_lo: jax.Array           # (L,) f32 — monotone lower bound on leaf output
     out_hi: jax.Array           # (L,) f32 — upper bound
     leaf_out: jax.Array         # (L,) f32 — constrained/smoothed output of each leaf
+    # intermediate-monotone ancestry ((1,1)/(1,) dummies when off):
+    anc_left: jax.Array         # (L, L) bool — leaf row is in node col's LEFT subtree
+    anc_right: jax.Array        # (L, L) bool
+    node_mono: jax.Array        # (L,) i32 — monotone dir of each internal node's feature
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
     cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
     round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
@@ -117,6 +126,34 @@ class _GrowState(NamedTuple):
     num_leaves_cur: jax.Array   # () i32
     progressed: jax.Array       # () bool
     col_mask: jax.Array         # (F,) bool feature sampling mask for this tree
+
+
+def intermediate_monotone_bounds(anc_left, anc_right, node_mono, leaf_out,
+                                 big):
+    """Per-leaf output bounds under the INTERMEDIATE monotone method.
+
+    Reference: monotone_constraints.hpp IntermediateLeafConstraints — after
+    any leaf output changes, the bounds of leaves in the OPPOSITE subtrees
+    of its monotone ancestors are refreshed against actual outputs
+    (GoUpToFindLeavesToUpdate + UpdateConstraintsWithOutputs). Here the
+    lazy walk becomes a dense recompute: for every internal node, take the
+    min/max leaf output of each side, then every leaf's bound is the
+    tightest over its monotone ancestors. An increasing split requires
+    left-subtree outputs <= right-subtree outputs, so a left leaf is capped
+    by min(right outputs) and a right leaf floored by max(left outputs)."""
+    lmax = jnp.max(jnp.where(anc_left, leaf_out[:, None], -big), axis=0)
+    lmin = jnp.min(jnp.where(anc_left, leaf_out[:, None], big), axis=0)
+    rmax = jnp.max(jnp.where(anc_right, leaf_out[:, None], -big), axis=0)
+    rmin = jnp.min(jnp.where(anc_right, leaf_out[:, None], big), axis=0)
+    inc = (node_mono > 0)[None, :]
+    dec = (node_mono < 0)[None, :]
+    hi = jnp.min(jnp.minimum(
+        jnp.where(anc_left & inc, rmin[None, :], big),
+        jnp.where(anc_right & dec, lmin[None, :], big)), axis=1)
+    lo = jnp.max(jnp.maximum(
+        jnp.where(anc_right & inc, lmax[None, :], -big),
+        jnp.where(anc_left & dec, rmax[None, :], -big)), axis=1)
+    return lo, hi
 
 
 def feature_local_bin(group_bin: jax.Array, feat: jax.Array,
@@ -169,6 +206,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     f32, i32 = jnp.float32, jnp.int32
 
     use_mono = params.has_monotone and monotone is not None
+    use_imono = use_mono and params.monotone_intermediate
     use_inter = params.has_interaction and interaction_groups is not None
     use_smooth = params.path_smooth > 0.0
     use_output = use_mono or use_smooth
@@ -349,6 +387,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         out_hi=jnp.full(L if use_output else 1, BIG, f32),
         leaf_out=(jnp.zeros(L, f32).at[0].set(root_out)
                   if use_output else jnp.zeros(1, f32)),
+        anc_left=jnp.zeros((L, L) if use_imono else (1, 1), bool),
+        anc_right=jnp.zeros((L, L) if use_imono else (1, 1), bool),
+        node_mono=jnp.zeros(L if use_imono else 1, i32),
         used_feat=used0,
         cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
         round_idx=jnp.asarray(0, i32),
@@ -579,7 +620,45 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
             # ---- constraint propagation (reference: BasicLeafConstraints::Update:
             # mid = (left_out + right_out)/2; increasing: left.max=mid, right.min=mid) ----
-            if use_output:
+            if use_imono:
+                # INTERMEDIATE method: the reference applies splits one at a
+                # time with bounds refreshed from actual outputs after every
+                # split (monotone_constraints.hpp GoUpToFindLeavesToUpdate).
+                # A batched round must replay that serial order (best-gain
+                # first, matching the reference's leaf-wise order) or two
+                # same-round splits on opposite sides of a monotone node can
+                # cross; the heavy work (routing/histograms) stays batched.
+                def _one_split(i, carry):
+                    lo_v, hi_v, lov, anc_l, anc_r, nmono = carry
+                    val = pair_valid[i]
+                    o = jnp.where(val, pair_old[i], L)
+                    nw = jnp.where(val, pair_new[i], L)
+                    nd = jnp.where(val, pair_node[i], L)
+                    ol_i, or_i = constrained_child_outputs(
+                        lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
+                        params.lambda_l1, params.lambda_l2,
+                        lo_v[pair_old[i]], hi_v[pair_old[i]],
+                        params.path_smooth, lov[pair_old[i]])
+                    lov = lov.at[o].set(ol_i, mode="drop") \
+                             .at[nw].set(or_i, mode="drop")
+                    anc_l = anc_l.at[nw].set(anc_l[pair_old[i]], mode="drop")
+                    anc_r = anc_r.at[nw].set(anc_r[pair_old[i]], mode="drop")
+                    anc_l = anc_l.at[o, nd].set(True, mode="drop")
+                    anc_r = anc_r.at[nw, nd].set(True, mode="drop")
+                    nm = jnp.where((dirf[i] & 2) != 0, 0, monotone[feat[i]])
+                    nmono = nmono.at[nd].set(nm, mode="drop")
+                    lo_v, hi_v = intermediate_monotone_bounds(
+                        anc_l, anc_r, nmono, lov, BIG)
+                    return lo_v, hi_v, lov, anc_l, anc_r, nmono
+
+                carry = jax.lax.fori_loop(
+                    0, S, _one_split,
+                    (st.out_lo, st.out_hi, st2.leaf_out,
+                     st2.anc_left, st2.anc_right, st2.node_mono))
+                st2 = st2._replace(out_lo=carry[0], out_hi=carry[1],
+                                   leaf_out=carry[2], anc_left=carry[3],
+                                   anc_right=carry[4], node_mono=carry[5])
+            elif use_output:
                 lo_p = st.out_lo[pair_old]
                 hi_p = st.out_hi[pair_old]
                 po = st.leaf_out[pair_old]

@@ -63,7 +63,7 @@ import os as _os
 # deliberately CORRUPT results — never set this for real training.
 _ABLATE = _os.environ.get("LGBTPU_KABLATE", "")
 _KNOWN_ABLATE = ("", "nohist", "constoh", "dblcon", "dblroute", "dblA",
-                 "dbldot", "dbldot_i8")
+                 "dbldot", "dbldot_i8", "noA")
 if _ABLATE not in _KNOWN_ABLATE:
     raise ValueError(f"unknown LGBTPU_KABLATE={_ABLATE!r}; one of "
                      f"{_KNOWN_ABLATE[1:]}")
@@ -172,15 +172,24 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     w2 = w_ref[0:2, :]                                       # (2, T) f32
     w_hi, w_lo = _wsplit(w2)
 
-    # unpack the 4-per-word packed group bins and build the (G, B, T)
-    # bin-match mask shared by the int and float contraction paths
+    # unpack the 4-per-word packed group bins and build the bin-match
+    # one-hot shared by the int and float contraction paths. The one-hot is
+    # built B-MAJOR — row r = b * G + g — via key = bin * G + g tiled B
+    # times against a flat 2-D iota: measured ~40% of kernel time used to
+    # go into the (G, B, T) 3-D broadcast-compare layout this replaces.
     rows = []
     for g in range(G):  # static unroll
         word_g = bins_ref[g // 4:g // 4 + 1, :]
         rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
     bins_G = jnp.concatenate(rows, axis=0)                   # (G, T)
-    b_iota3 = jax.lax.broadcasted_iota(i32, (G, B, T), 1)
-    oh_match = bins_G[:, None, :] == b_iota3                 # (G, B, T) bool
+    g_iota = jax.lax.broadcasted_iota(i32, (G, T), 0)
+    key = bins_G * G + g_iota                                # (G, T)
+    key_t = jnp.concatenate([key] * B, axis=0)               # (B*G, T) tiled
+    r_iota = jax.lax.broadcasted_iota(i32, (B * G, T), 0)
+    oh_match = key_t == r_iota            # (B*G, T) bool, row r = b * G + g
+    if _ABLATE == "dblcon":      # additive probe: one extra (never-hit) construct
+        key_t2 = jnp.concatenate([key + B * G] * B, axis=0)
+        oh_match = oh_match | (key_t2 == r_iota)
 
     if int_weights:
         # Quantized-gradient histograms (reference: gradient_discretizer.cpp
@@ -198,18 +207,35 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         w_i = jnp.round(w2).astype(i32)                      # int-valued rows
         A_i = jnp.concatenate(
             [w_i[c:c + 1, :] * slot_oh_i for c in range(2)], axis=0)
+        if _ABLATE == "nohist":      # int-path probe: no one-hot, no dot
+            hist_ref[...] += jnp.sum(A_i, axis=1)[None, :]
+            return
         if f32_dots:
             # CPU interpret: f32 products of |v| <= 127 ints are exact and
             # per-block sums stay below 2^24, so rounding back is lossless
             d = jax.lax.dot_general(
-                oh_match.astype(f32).reshape(G * B, T), A_i.astype(f32),
+                oh_match.astype(f32), A_i.astype(f32),
                 (((1,), (1,)), ((), ())), preferred_element_type=f32)
             hist_ref[...] += d.astype(i32)
         else:
+            if _ABLATE == "constoh":     # int-path probe: constant operand
+                oh_i = jnp.full((B * G, T), 1, jnp.int8)
+            else:
+                oh_i = oh_match.astype(jnp.int8)
+            if _ABLATE == "noA":         # int-path probe: constant A operand
+                A_8 = jnp.full((2 * S, T), 1, jnp.int8)
+            else:
+                A_8 = A_i.astype(jnp.int8)
             hist_ref[...] += jax.lax.dot_general(
-                oh_match.astype(jnp.int8).reshape(G * B, T),
-                A_i.astype(jnp.int8), (((1,), (1,)), ((), ())),
+                oh_i, A_8, (((1,), (1,)), ((), ())),
                 preferred_element_type=i32)
+            if _ABLATE == "dbldot_i8":   # additive probe: one extra int8 dot
+                d2 = jax.lax.dot_general(
+                    oh_i, jnp.flip(A_8, 1), (((1,), (1,)), ((), ())),
+                    preferred_element_type=i32)
+                # |d2| < 2^30 so this adds exactly 0, but the compiler
+                # cannot prove it — the extra dot survives DCE
+                hist_ref[...] += jnp.abs(d2) // jnp.int32(2 ** 30)
         return
 
     # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot; the reference's
@@ -237,10 +263,7 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # ONE (G*B, T) @ (T, 3S) contraction per block: per-group (B, T) dots
     # have M=B=64 — half an MXU tile — so merging groups into a single
     # one-hot doubles MXU utilisation (the dominant cost of training).
-    oh = oh_match.astype(bf16).reshape(G * B, T)
-    if _ABLATE == "dblcon":      # perf probe: one extra (never-hit) construct
-        oh2 = (bins_G[:, None, :] == b_iota3 + B).astype(bf16)
-        oh = oh + oh2.reshape(G * B, T)
+    oh = oh_match.astype(bf16)
     if _ABLATE == "nohist":      # fixed costs only (route + A + writes)
         hist_ref[...] += jnp.sum(A_hi, axis=1)[None, :]
         return
@@ -249,9 +272,9 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     if _ABLATE == "dbldot":      # perf probe: one extra bf16 dot
         hist_ref[...] += dot(oh, build_A(w_lo)) * 1e-30
     if _ABLATE == "dbldot_i8":   # perf probe: one extra int8 dot
-        oh_i8 = (bins_G[:, None, :] == b_iota3).astype(jnp.int8)
+        oh_i8 = oh_match.astype(jnp.int8)
         a_i8 = build_A(w_lo).astype(jnp.int8)
-        d2 = jax.lax.dot_general(oh_i8.reshape(G * B, T), a_i8,
+        d2 = jax.lax.dot_general(oh_i8, a_i8,
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.int32)
         hist_ref[...] += d2.astype(f32) * 1e-30
@@ -361,8 +384,9 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         interpret=_interp(),
     )(bins_T, leaf_id, w_T, tabs, bits)
 
-    # (G*B, 2S) -> (S, G, Bmax, 2); int histograms are unscaled by the caller
-    hist4 = hist.reshape(G, B, 2, S).transpose(3, 0, 1, 2)[:, :, :bmax, :]
+    # (B*G, 2S) b-major rows -> (S, G, Bmax, 2); int histograms are
+    # unscaled by the caller
+    hist4 = hist.reshape(B, G, 2, S).transpose(3, 1, 0, 2)[:, :, :bmax, :]
     return new_leaf, hist4, cnt.reshape(-1)
 
 

@@ -90,6 +90,65 @@ def main():
                  "predict_raw_score": "true", "verbosity": -1}, FIX)
         print(f"generated stock_{name}.model")
 
+    # ---- weighted training (reference: metadata.cpp LoadWeights) ----
+    rs = np.random.RandomState(7)
+    w = (0.5 + rs.rand(len(X))).round(4)
+    np.savetxt(str(train_csv) + ".weight", w, fmt="%.4f")
+    model = FIX / "stock_binary_weighted.model"
+    run_cli({**common, "objective": "binary", "data": str(train_csv),
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_binary_weighted.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    np.savetxt(FIX / "golden_weights.csv", w, fmt="%.4f")
+    os.remove(str(train_csv) + ".weight")
+    print("generated stock_binary_weighted.model")
+
+    # ---- monotone constraint methods (monotone_constraints.hpp) ----
+    for method in ("basic", "intermediate"):
+        model = FIX / f"stock_monotone_{method}.model"
+        run_cli({**common, "objective": "regression",
+                 "data": str(FIX / 'golden_train_reg.csv'),
+                 "monotone_constraints": "1,-1,0,0,0,0",
+                 "monotone_constraints_method": method,
+                 "task": "train", "output_model": str(model)}, FIX)
+        run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+                 "input_model": str(model), "header": "false",
+                 "output_result": str(FIX / f"stock_pred_monotone_{method}.txt"),
+                 "predict_raw_score": "true", "verbosity": -1}, FIX)
+        print(f"generated stock_monotone_{method}.model")
+
+    # ---- interaction constraints (col_sampler.hpp) ----
+    model = FIX / "stock_interaction.model"
+    run_cli({**common, "objective": "regression",
+             "data": str(FIX / 'golden_train_reg.csv'),
+             "interaction_constraints": "[0,1],[2,3,4,5]",
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_interaction.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_interaction.model")
+
+    # ---- refit on perturbed labels (Application task=refit) ----
+    rs2 = np.random.RandomState(13)
+    flip = rs2.rand(len(y_bin)) < 0.15
+    y_refit = np.where(flip, 1 - y_bin, y_bin)
+    refit_csv = FIX / "golden_train_refit.csv"
+    write_csv(refit_csv, y_refit, X)
+    model = FIX / "stock_binary_refit.model"
+    run_cli({"task": "refit", "data": str(refit_csv),
+             "input_model": str(FIX / 'stock_binary.model'),
+             "output_model": str(model), "header": "false",
+             "label_column": "0", "refit_decay_rate": "0.9",
+             "verbosity": -1}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_binary_refit.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_binary_refit.model")
+
     # ---- reverse direction: OUR model must load in stock LightGBM ----
     sys.path.insert(0, str(ROOT))
     import lightgbm_tpu as lgb

@@ -107,3 +107,64 @@ def test_libsvm_qid_groups(tmp_path):
     ds = lgb.Dataset(str(path))
     ds.construct()
     np.testing.assert_array_equal(np.asarray(ds.get_group()), [8] * 5)
+
+
+def test_cli_refit(tmp_path):
+    """task=refit refits leaf values on new data (reference:
+    application.cpp:236)."""
+    train_csv, X, y = _write_train(tmp_path)
+    model = str(tmp_path / "m.txt")
+    cli_main([f"data={train_csv}", "objective=binary", "num_leaves=7",
+              "num_iterations=5", f"output_model={model}", "verbosity=-1"])
+    # new data: same shape, perturbed labels
+    rs = np.random.RandomState(9)
+    X2 = X + 0.05 * rs.randn(*X.shape)
+    y2 = (X2[:, 0] + X2[:, 1] > 0).astype(int)
+    refit_csv = tmp_path / "refit.csv"
+    np.savetxt(refit_csv, np.column_stack([y2, X2]), delimiter=",",
+               fmt="%.5g")
+    out_model = str(tmp_path / "refit.txt")
+    cli_main(["task=refit", f"data={refit_csv}", f"input_model={model}",
+              f"output_model={out_model}", "verbosity=-1"])
+    a = open(model).read()
+    b = open(out_model).read()
+    assert "tree" in b and a != b      # structure kept, leaf values moved
+    # structure (splits) must be unchanged by refit
+    for key in ("split_feature=", "threshold="):
+        sa = [l for l in a.splitlines() if l.startswith(key)]
+        sb = [l for l in b.splitlines() if l.startswith(key)]
+        assert sa == sb
+
+
+def test_cli_save_binary_then_train(tmp_path):
+    """task=save_binary writes a binary dataset the train task can consume
+    (reference: application.cpp:217, Dataset::SaveBinaryFile)."""
+    train_csv, X, y = _write_train(tmp_path)
+    binpath = str(tmp_path / "train.bin")
+    cli_main(["task=save_binary", f"data={train_csv}",
+              f"output_model={binpath}", "verbosity=-1"])
+    assert open(binpath, "rb").read(14) == b"LGBTPU.BIN.v2\n"
+    m1 = str(tmp_path / "m1.txt")
+    m2 = str(tmp_path / "m2.txt")
+    common = ["objective=binary", "num_leaves=7", "num_iterations=5",
+              "verbosity=-1"]
+    cli_main([f"data={train_csv}", f"output_model={m1}"] + common)
+    cli_main([f"data={binpath}", f"output_model={m2}"] + common)
+    t1 = open(m1).read().split("\nparameters:")[0]
+    t2 = open(m2).read().split("\nparameters:")[0]
+    assert t1 == t2
+
+
+def test_cli_convert_model(tmp_path):
+    """task=convert_model dumps the model as JSON."""
+    import json
+    train_csv, X, y = _write_train(tmp_path)
+    model = str(tmp_path / "m.txt")
+    cli_main([f"data={train_csv}", "objective=binary", "num_leaves=7",
+              "num_iterations=3", f"output_model={model}", "verbosity=-1"])
+    out = str(tmp_path / "m.json")
+    cli_main(["task=convert_model", f"input_model={model}",
+              f"convert_model={out}", "verbosity=-1"])
+    blob = json.loads(open(out).read())
+    assert blob["num_tree_per_iteration"] == 1
+    assert len(blob["tree_info"]) == 3

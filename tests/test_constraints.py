@@ -139,3 +139,53 @@ def test_unimplemented_params_raise():
         params = {"objective": "regression", "verbosity": -1, **bad}
         with pytest.raises(lgb.LightGBMError):
             lgb.train(params, ds, num_boost_round=2)
+
+
+def _monotone_fit(method, seed=5, n=2500):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 4)
+    y = (2.0 * X[:, 0] + np.sin(6 * X[:, 1]) - 1.2 * X[:, 2]
+         + 0.15 * rs.randn(n))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "monotone_constraints": [1, 0, -1, 0],
+                     "monotone_constraints_method": method},
+                    ds, num_boost_round=25)
+    return bst, X, y
+
+
+def _check_monotone(bst, n_probe=200, seed=0):
+    """Sweep each constrained feature over its range with all other features
+    fixed; predictions must be monotone in the required direction."""
+    rs = np.random.RandomState(seed)
+    base = rs.rand(n_probe, 4)
+    grid = np.linspace(0.01, 0.99, 25)
+    for feat, direction in ((0, 1), (2, -1)):
+        preds = []
+        for g in grid:
+            Xp = base.copy()
+            Xp[:, feat] = g
+            preds.append(bst.predict(Xp))
+        P = np.stack(preds)                     # (grid, probe)
+        diffs = np.diff(P, axis=0) * direction
+        assert np.all(diffs >= -1e-10), (feat, direction, diffs.min())
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+def test_monotone_methods_enforce_monotonicity(method):
+    """Both constraint methods must produce truly monotone models
+    (reference: monotone_constraints.hpp Basic/IntermediateLeafConstraints)."""
+    bst, X, y = _monotone_fit(method)
+    _check_monotone(bst)
+
+
+def test_intermediate_fits_at_least_as_well_as_basic():
+    """The intermediate method's refreshed bounds are less conservative than
+    basic's frozen midpoints, so its fit should not be worse (reference:
+    monotone_constraints.hpp motivation)."""
+    b_basic, X, y = _monotone_fit("basic")
+    b_inter, _, _ = _monotone_fit("intermediate")
+    mse_basic = float(np.mean((b_basic.predict(X) - y) ** 2))
+    mse_inter = float(np.mean((b_inter.predict(X) - y) ** 2))
+    assert mse_inter <= mse_basic * 1.02, (mse_inter, mse_basic)
