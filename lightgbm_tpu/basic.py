@@ -300,13 +300,16 @@ class Dataset:
                 self.binned = construct_binned(self.raw_data, mappers, groups)
         else:
             cats = self._resolve_categorical()
+            from .binning import load_forced_bins
             mapper_kw = dict(
                 max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
                 categorical_features=cats,
                 use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
                 sample_cnt=cfg.bin_construct_sample_cnt,
                 seed=cfg.data_random_seed,
-                max_bin_by_feature=cfg.max_bin_by_feature)
+                max_bin_by_feature=cfg.max_bin_by_feature,
+                forced_bins=load_forced_bins(cfg.forcedbins_filename,
+                                             self.num_feature_, cats))
             if sparse:
                 from .binning import (construct_binned_sparse,
                                       find_bin_mappers_sparse,
@@ -367,12 +370,15 @@ class Dataset:
             sample_local = self.raw_data
         sample = gather_sample(sample_local)
         cats = self._resolve_categorical()
+        from .binning import load_forced_bins
         mappers = find_bin_mappers(
             sample, max_bin=cfg.max_bin,
             min_data_in_bin=cfg.min_data_in_bin, categorical_features=cats,
             use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
             sample_cnt=len(sample) + 1, seed=cfg.data_random_seed,
-            max_bin_by_feature=cfg.max_bin_by_feature)
+            max_bin_by_feature=cfg.max_bin_by_feature,
+            forced_bins=load_forced_bins(cfg.forcedbins_filename,
+                                         self.num_feature_, cats))
         groups = None
         if cfg.enable_bundle:
             sample_bins = [mappers[f].transform(sample[:, f])

@@ -54,7 +54,9 @@ class BinMapper:
     @staticmethod
     def find_numerical(sample: np.ndarray, max_bin: int, min_data_in_bin: int,
                        use_missing: bool, zero_as_missing: bool,
-                       total_sample_cnt: Optional[int] = None) -> "BinMapper":
+                       total_sample_cnt: Optional[int] = None,
+                       forced_bounds: Optional[Sequence[float]] = None
+                       ) -> "BinMapper":
         """Find bin boundaries from sampled values — an exact port of the
         reference's BinMapper::FindBin numerical path (src/io/bin.cpp:316:
         NaN filtering and missing-type choice, zero-count restoration, and
@@ -86,14 +88,20 @@ class BinMapper:
                              num_bins=2 if missing_type == MISSING_NAN else 1)
         min_val, max_val = float(distinct[0]), float(distinct[-1])
 
+        # forced bounds route to the predefined-bin finder (reference:
+        # FindBinWithZeroAsOneBin's forced_upper_bounds overload, bin.cpp:316)
+        def _find(mb, tc):
+            if forced_bounds:
+                return _find_bin_predefined(distinct, counts, mb, tc,
+                                            min_data_in_bin, forced_bounds)
+            return _find_bin_zero_as_one_bin(distinct, counts, mb, tc,
+                                             min_data_in_bin)
+
         if missing_type == MISSING_NAN:
-            bounds = _find_bin_zero_as_one_bin(distinct, counts, max_bin - 1,
-                                               n_total - na_cnt,
-                                               min_data_in_bin)
+            bounds = _find(max_bin - 1, n_total - na_cnt)
             num_bins = len(bounds) + 1      # + NaN bin (last)
         else:
-            bounds = _find_bin_zero_as_one_bin(distinct, counts, max_bin,
-                                               n_total, min_data_in_bin)
+            bounds = _find(max_bin, n_total)
             if missing_type == MISSING_ZERO and len(bounds) == 2:
                 missing_type = MISSING_NONE
             num_bins = len(bounds)
@@ -285,6 +293,95 @@ def _greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
 
 
 _K_ZERO = 1e-35  # kZeroThreshold (meta.h:57): |v| <= ~0 shares the zero bin
+
+
+def _find_bin_predefined(distinct: np.ndarray, counts: np.ndarray,
+                         max_bin: int, total_cnt: int, min_data_in_bin: int,
+                         forced: Sequence[float]) -> List[float]:
+    """Exact port of FindBinWithPredefinedBin (bin.cpp:162): zero bounds +
+    user-forced bounds first, then remaining budget split across the forced
+    intervals proportionally to their sample counts via GreedyFindBin."""
+    nd = len(distinct)
+    gt = np.flatnonzero(distinct > -_K_ZERO)
+    left_cnt = int(gt[0]) if len(gt) else nd
+    rs = np.flatnonzero(distinct[left_cnt:] > _K_ZERO)
+    right_start = left_cnt + int(rs[0]) if len(rs) else -1
+
+    bounds: List[float] = []
+    if max_bin == 2:
+        bounds.append(_K_ZERO if left_cnt == 0 else -_K_ZERO)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bounds.append(-_K_ZERO)
+        if right_start >= 0:
+            bounds.append(_K_ZERO)
+    bounds.append(np.inf)
+
+    max_to_insert = max_bin - len(bounds)
+    num_inserted = 0
+    for fb in forced:
+        if num_inserted >= max_to_insert:
+            break
+        if abs(float(fb)) > _K_ZERO:
+            bounds.append(float(fb))
+            num_inserted += 1
+    bounds.sort()
+
+    free_bins = max_bin - len(bounds)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    nb = len(bounds)
+    for i in range(nb):
+        cnt_in_bin = 0
+        bin_start = value_ind
+        while value_ind < nd and distinct[value_ind] < bounds[i]:
+            cnt_in_bin += int(counts[value_ind])
+            value_ind += 1
+        distinct_cnt = value_ind - bin_start
+        bins_remaining = max_bin - nb - len(bounds_to_add)
+        # std::lround = round-half-away-from-zero (operand is non-negative)
+        num_sub_bins = int(math.floor(cnt_in_bin * free_bins / total_cnt + 0.5))
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == nb - 1:
+            num_sub_bins = bins_remaining + 1
+        new_ub = _greedy_find_bin(distinct[bin_start:value_ind],
+                                  counts[bin_start:value_ind],
+                                  num_sub_bins, cnt_in_bin, min_data_in_bin)
+        bounds_to_add.extend(new_ub[:-1])      # last bound is infinity
+    bounds.extend(bounds_to_add)
+    bounds.sort()
+    return bounds
+
+
+def load_forced_bins(path: str, num_features: int,
+                     categorical_features: Sequence[int] = ()
+                     ) -> Optional[List[List[float]]]:
+    """Read a forcedbins_filename JSON (reference:
+    DatasetLoader::GetForcedBins, dataset_loader.cpp:1511): a list of
+    {"feature": i, "bin_upper_bound": [..]} entries; categorical features are
+    ignored with a warning, duplicate consecutive bounds dropped."""
+    if not path:
+        return None
+    import json as _json
+    import os as _os
+    if not _os.path.exists(path):
+        log_warning(f"Could not open {path}. Will ignore.")
+        return None
+    with open(path) as fh:
+        arr = _json.load(fh)
+    cats = set(int(c) for c in categorical_features)
+    forced: List[List[float]] = [[] for _ in range(num_features)]
+    for item in arr:
+        f = int(item["feature"])
+        if not 0 <= f < num_features:
+            raise ValueError(f"forced bins feature index {f} out of range")
+        if f in cats:
+            log_warning(f"Feature {f} is categorical. Will ignore forced "
+                        "bins for this feature.")
+            continue
+        bb = [float(v) for v in item.get("bin_upper_bound", [])]
+        forced[f] = [b for i, b in enumerate(bb) if i == 0 or b != bb[i - 1]]
+    return forced
 
 
 def _find_bin_zero_as_one_bin(distinct: np.ndarray, counts: np.ndarray,
@@ -563,7 +660,9 @@ def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int,
                      categorical_features: Sequence[int] = (),
                      use_missing: bool = True, zero_as_missing: bool = False,
                      sample_cnt: int = 200000, seed: int = 1,
-                     max_bin_by_feature: Optional[Sequence[int]] = None) -> List[BinMapper]:
+                     max_bin_by_feature: Optional[Sequence[int]] = None,
+                     forced_bins: Optional[List[List[float]]] = None
+                     ) -> List[BinMapper]:
     """Sample rows then find per-feature bin mappers (reference: two-round sampling,
     dataset_loader.cpp:258,601)."""
     n, num_features = data.shape
@@ -581,8 +680,9 @@ def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int,
         if f in cat:
             mappers.append(BinMapper.find_categorical(col, mb, min_data_in_bin, use_missing))
         else:
-            mappers.append(BinMapper.find_numerical(col, mb, min_data_in_bin,
-                                                    use_missing, zero_as_missing))
+            mappers.append(BinMapper.find_numerical(
+                col, mb, min_data_in_bin, use_missing, zero_as_missing,
+                forced_bounds=forced_bins[f] if forced_bins else None))
     return mappers
 
 
@@ -608,7 +708,8 @@ def find_bin_mappers_sparse(X, max_bin: int, min_data_in_bin: int,
                             use_missing: bool = True,
                             zero_as_missing: bool = False,
                             sample_cnt: int = 200000, seed: int = 1,
-                            max_bin_by_feature: Optional[Sequence[int]] = None
+                            max_bin_by_feature: Optional[Sequence[int]] = None,
+                            forced_bins: Optional[List[List[float]]] = None
                             ) -> List[BinMapper]:
     """Per-feature bin mappers from a scipy sparse matrix, one column of
     sampled non-zeros at a time — implicit zeros are restored by count so the
@@ -626,8 +727,9 @@ def find_bin_mappers_sparse(X, max_bin: int, min_data_in_bin: int,
             mappers.append(BinMapper.find_categorical(col, mb, min_data_in_bin,
                                                       use_missing))
         else:
-            mappers.append(BinMapper.find_numerical(col, mb, min_data_in_bin,
-                                                    use_missing, zero_as_missing))
+            mappers.append(BinMapper.find_numerical(
+                col, mb, min_data_in_bin, use_missing, zero_as_missing,
+                forced_bounds=forced_bins[f] if forced_bins else None))
     return mappers
 
 

@@ -352,6 +352,40 @@ class GBDT:
             return "stream"
         return "pallas" if on_tpu else "segsum"
 
+    def _resolve_hist_precision(self) -> str:
+        """Histogram/scan precision. 'double' mirrors the reference's
+        arithmetic — float32 gradients accumulated into double histograms
+        (hist_t, dense_bin.hpp) with double split scans — so near-tied split
+        gains resolve exactly as stock LightGBM's do. auto = double on the
+        CPU segsum backend (where f64 is native-speed and golden-oracle
+        fidelity matters), single on the TPU kernel backends (f32/int8 MXU
+        paths; f64 is emulated and ~10x slower on TPU)."""
+        p = self.config.hist_precision
+        backend = self._resolve_hist_backend()
+        if p == "auto":
+            return "double" if backend in ("segsum", "onehot") \
+                and jax.default_backend() == "cpu" \
+                and not self._voting_planned else "single"
+        if p == "double" and backend in ("stream", "pallas"):
+            raise LightGBMError(
+                "hist_precision=double requires hist_backend=segsum or "
+                "onehot (the TPU stream/pallas kernels are f32/int8)")
+        if p == "double" and self._voting_planned:
+            raise LightGBMError(
+                "hist_precision=double is not supported with "
+                "tree_learner=voting (the PV-Tree shard_map learner runs "
+                "f32); use tree_learner=data")
+        return p
+
+    def _grow_x64_ctx(self):
+        """enable_x64 scope for the grow program under hist_precision=double
+        (f64 arrays cannot exist outside it); used at trace AND call time so
+        the jit cache stays consistent."""
+        if self._grow_params.hist_double:
+            return jax.enable_x64()
+        import contextlib
+        return contextlib.nullcontext()
+
     def _stream_fits(self) -> bool:
         """The fused streaming kernel keeps the whole (G*B, 2S) histogram block
         and the (L, T) leaf one-hot resident in VMEM (~16 MB/core); the block
@@ -371,7 +405,12 @@ class GBDT:
         return GrowParams(
             num_leaves=max(c.num_leaves, 2),
             max_depth=c.max_depth,
-            max_splits_per_round=max(1, c.max_splits_per_round),
+            # intermediate/advanced monotone constraints are only sound under
+            # the reference's serial split order (each split tightens other
+            # leaves' bounds and re-finds their best splits before the next
+            # split is chosen) — force one split per round for them
+            max_splits_per_round=(1 if self._monotone_intermediate()
+                                  else max(1, c.max_splits_per_round)),
             lambda_l1=c.lambda_l1, lambda_l2=c.lambda_l2,
             min_data_in_leaf=c.min_data_in_leaf,
             min_sum_hessian_in_leaf=c.min_sum_hessian_in_leaf,
@@ -391,7 +430,8 @@ class GBDT:
             has_interaction=self._interaction_group_masks() is not None,
             extra_trees=c.extra_trees,
             bynode_fraction=c.feature_fraction_bynode,
-            hist_two_pass=(c.hist_precision == "mixed"),
+            hist_two_pass=(self._resolve_hist_precision() == "mixed"),
+            hist_double=(self._resolve_hist_precision() == "double"),
             # int8 operand range, exact int32 accumulation bounds, and an
             # even level count (odd counts clip to a non-integer +half grid
             # value that the int8 kernel could not represent)
@@ -535,10 +575,10 @@ class GBDT:
         silently training a different model (reference behavior: config
         validation fatals; VERDICT r1 'silently ignored parameters')."""
         c = self.config
-        if c.hist_precision not in ("auto", "single", "mixed"):
+        if c.hist_precision not in ("auto", "single", "mixed", "double"):
             raise LightGBMError(
                 f"hist_precision={c.hist_precision!r} is not one of "
-                "'auto', 'single', 'mixed'")
+                "'auto', 'single', 'mixed', 'double'")
 
         def _nonempty(v):
             return v is not None and len(np.atleast_1d(v)) > 0
@@ -622,7 +662,17 @@ class GBDT:
         if self.objective is None:
             raise LightGBMError("cannot boost without an objective "
                                 "(use custom-gradient update)")
-        grad, hess = self.objective.get_gradients(self._unpad_score())
+        if self._grow_params.hist_double:
+            # mirror the reference's arithmetic: gradients evaluated in
+            # double, stored as score_t=float32 (objective_function.h
+            # GetGradients writes score_t from double expressions)
+            with self._grow_x64_ctx():
+                grad, hess = self.objective.get_gradients(
+                    self._unpad_score().astype(jnp.float64))
+                grad = grad.astype(jnp.float32)
+                hess = hess.astype(jnp.float32)
+        else:
+            grad, hess = self.objective.get_gradients(self._unpad_score())
         return self._pad_gh(grad), self._pad_gh(hess)
 
     def _unpad_score(self):
@@ -652,12 +702,22 @@ class GBDT:
                 if getattr(objective, a, None) is not None]
             attr_names = self._grad_attr_names
 
+            double = self._grow_params.hist_double
+
             def _fn(score, bound, pad_mask, qkey):
                 old = {a: getattr(objective, a) for a in attr_names}
                 for a in attr_names:
                     setattr(objective, a, bound[a])
                 try:
-                    g, h = objective.get_gradients(score[:num_data])
+                    s = score[:num_data]
+                    if double:
+                        # reference arithmetic: gradients evaluated in double,
+                        # stored as score_t=float32 (objective_function.h)
+                        g, h = objective.get_gradients(s.astype(jnp.float64))
+                        g = g.astype(jnp.float32)
+                        h = h.astype(jnp.float32)
+                    else:
+                        g, h = objective.get_gradients(s)
                 finally:
                     for a in attr_names:
                         setattr(objective, a, old[a])
@@ -677,7 +737,8 @@ class GBDT:
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
         bound = {a: getattr(self.objective, a)
                  for a in self._grad_attr_names}
-        return self._grad_fn(self.score, bound, self._pad_mask, qkey)
+        with self._grow_x64_ctx():
+            return self._grad_fn(self.score, bound, self._pad_mask, qkey)
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
@@ -739,7 +800,7 @@ class GBDT:
             sc = None
             if gh_scales is not None:
                 sc = gh_scales if k == 1 else gh_scales[:, kk]
-            with global_timer.scope("GBDT::TrainTree"):
+            with global_timer.scope("GBDT::TrainTree"), self._grow_x64_ctx():
                 arrays, leaf_id = self._grow_fn(
                     self.dd.bins, g, h, mask, col_mask, key=gkey,
                     packed=self._packed, cegb_used=self._cegb_used,

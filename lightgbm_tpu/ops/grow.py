@@ -68,6 +68,11 @@ class GrowParams(NamedTuple):
     extra_trees: bool = False
     bynode_fraction: float = 1.0
     hist_two_pass: bool = True   # two-pass bf16 hist weights (f32-accurate)
+    # float64 histograms + split scan (hist_precision=double; segsum/onehot
+    # backends under jax.enable_x64): reproduces the reference's
+    # f32-gradients-into-double-histograms arithmetic so near-tied split
+    # gains resolve exactly as stock LightGBM resolves them
+    hist_double: bool = False
     int_hist: bool = False       # int8 quantized-gradient histograms (stream)
     # cost-effective gradient boosting (cost_effective_gradient_boosting.hpp)
     has_cegb: bool = False
@@ -112,6 +117,11 @@ class _GrowState(NamedTuple):
     anc_left: jax.Array         # (L, L) bool — leaf row is in node col's LEFT subtree
     anc_right: jax.Array        # (L, L) bool
     node_mono: jax.Array        # (L,) i32 — monotone dir of each internal node's feature
+    node_depth: jax.Array       # (L,) i32 — depth of each internal node
+    rect_lo: jax.Array          # (L, F) i32 — leaf's bin-space hyperrectangle [lo, hi)
+    rect_hi: jax.Array          # (L, F) i32
+    leaf_in_mono: jax.Array     # (L,) bool — leaf under a monotone split
+                                # (IntermediateLeafConstraints::leaf_is_in_monotone_subtree_)
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
     cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
     round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
@@ -204,6 +214,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     Bmax = layout.valid_mask.shape[1]
     F = layout.gather_idx.shape[0]
     f32, i32 = jnp.float32, jnp.int32
+    # leaf sums / histograms / gains dtype (see GrowParams.hist_double)
+    hdt = jnp.float64 if params.hist_double else jnp.float32
 
     use_mono = params.has_monotone and monotone is not None
     use_imono = use_mono and params.monotone_intermediate
@@ -346,10 +358,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_id = jnp.zeros(N, i32)
         root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
                                      backend=params.hist_backend,
-                                     bins_packed=bins_packed)[..., :2]
-    root_g = jnp.sum(grad)
-    root_h = jnp.sum(hess)
-    root_c = jnp.sum(cnt_w)
+                                     bins_packed=bins_packed,
+                                     acc_dtype=hdt)[..., :2]
+    root_g = jnp.sum(grad, dtype=hdt)
+    root_h = jnp.sum(hess, dtype=hdt)
+    root_c = jnp.sum(cnt_w, dtype=hdt)
     root_out = leaf_output(root_g, root_h, params.lambda_l1, params.lambda_l2,
                            params.max_delta_step)
     used0 = jnp.zeros((L if use_inter else 1, F if use_inter else 1), bool)
@@ -368,7 +381,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         parent_out=root_out[None] if use_output else None,
         extra_key=jax.random.fold_in(key, 1) if use_extra else None)
 
-    hist = jnp.zeros((L, G, Bmax, 2), f32).at[0].set(root_hist[0])
+    hist = jnp.zeros((L, G, Bmax, 2), hdt).at[0].set(root_hist[0])
     state = _GrowState(
         leaf_id=leaf_id,
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
@@ -378,9 +391,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         internal_value=jnp.zeros(L, f32), internal_weight=jnp.zeros(L, f32),
         internal_count=jnp.zeros(L, f32),
         cat_bitset=jnp.zeros((L, Bmax), bool),
-        sum_g=jnp.zeros(L, f32).at[0].set(root_g),
-        sum_h=jnp.zeros(L, f32).at[0].set(root_h),
-        cnt=jnp.zeros(L, f32).at[0].set(root_c),
+        sum_g=jnp.zeros(L, hdt).at[0].set(root_g),
+        sum_h=jnp.zeros(L, hdt).at[0].set(root_h),
+        cnt=jnp.zeros(L, hdt).at[0].set(root_c),
         depth=jnp.zeros(L, i32),
         leaf_parent=jnp.full(L, -1, i32),
         out_lo=jnp.full(L if use_output else 1, -BIG, f32),
@@ -390,16 +403,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         anc_left=jnp.zeros((L, L) if use_imono else (1, 1), bool),
         anc_right=jnp.zeros((L, L) if use_imono else (1, 1), bool),
         node_mono=jnp.zeros(L if use_imono else 1, i32),
+        node_depth=jnp.zeros(L if use_imono else 1, i32),
+        rect_lo=jnp.zeros((L, F) if use_imono else (1, 1), i32),
+        rect_hi=jnp.full((L, F) if use_imono else (1, 1), 2 ** 30, i32),
+        leaf_in_mono=jnp.zeros(L if use_imono else 1, bool),
         used_feat=used0,
         cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
         round_idx=jnp.asarray(0, i32),
-        best_gain=jnp.full(L, NEG_INF, f32).at[0].set(root_split.gain[0]),
+        best_gain=jnp.full(L, NEG_INF, hdt).at[0].set(root_split.gain[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(root_split.feature[0]),
         best_thr=jnp.zeros(L, i32).at[0].set(root_split.threshold[0]),
         best_dir=jnp.zeros(L, i32).at[0].set(root_split.dir_flags[0]),
-        best_left_g=jnp.zeros(L, f32).at[0].set(root_split.left_sum_g[0]),
-        best_left_h=jnp.zeros(L, f32).at[0].set(root_split.left_sum_h[0]),
-        best_left_c=jnp.zeros(L, f32).at[0].set(root_split.left_count[0]),
+        best_left_g=jnp.zeros(L, hdt).at[0].set(root_split.left_sum_g[0]),
+        best_left_h=jnp.zeros(L, hdt).at[0].set(root_split.left_sum_h[0]),
+        best_left_c=jnp.zeros(L, hdt).at[0].set(root_split.left_count[0]),
         hist=hist,
         num_leaves_cur=jnp.asarray(1, i32),
         progressed=jnp.asarray(True),
@@ -430,8 +447,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 k = jnp.asarray(nf, i32)
                 pair_valid = jnp.arange(S) < nf
                 pair_old = jnp.asarray(list(f_leaves) + [0] * (S - nf), i32)
-                pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
-                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+                pair_new = jnp.where(pair_valid, cur + jnp.arange(S, dtype=i32), 0)
+                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S, dtype=i32), 0)
                 node_idx = jnp.where(pair_valid, pair_node, drop)
                 new_idx = jnp.where(pair_valid, pair_new, drop)
                 old_idx = jnp.where(pair_valid, pair_old, drop)
@@ -470,13 +487,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 ranks = jnp.arange(L)
                 sorted_gain = cand[order]
                 chosen_rank = (ranks < k_budget) & (sorted_gain > 0)
-                k = jnp.sum(chosen_rank.astype(i32))
+                k = jnp.sum(chosen_rank, dtype=i32)
 
                 # pair arrays over S slots (i = rank)
                 pair_valid = jnp.arange(S) < k                # (S,)
-                pair_old = jnp.where(pair_valid, order[:S], 0)
-                pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
-                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+                pair_old = jnp.where(pair_valid, order[:S].astype(i32), 0)
+                pair_new = jnp.where(pair_valid, cur + jnp.arange(S, dtype=i32), 0)
+                pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S, dtype=i32), 0)
                 node_idx = jnp.where(pair_valid, pair_node, drop)
                 new_idx = jnp.where(pair_valid, pair_new, drop)
                 old_idx = jnp.where(pair_valid, pair_old, drop)
@@ -511,10 +528,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 split_feature=st.split_feature.at[node_idx].set(feat, mode="drop"),
                 threshold_bin=st.threshold_bin.at[node_idx].set(thr, mode="drop"),
                 dir_flags=st.dir_flags.at[node_idx].set(dirf, mode="drop"),
-                split_gain=st.split_gain.at[node_idx].set(gain, mode="drop"),
-                internal_value=st.internal_value.at[node_idx].set(out, mode="drop"),
-                internal_weight=st.internal_weight.at[node_idx].set(ph, mode="drop"),
-                internal_count=st.internal_count.at[node_idx].set(pc, mode="drop"),
+                split_gain=st.split_gain.at[node_idx].set(gain.astype(f32), mode="drop"),
+                internal_value=st.internal_value.at[node_idx].set(out.astype(f32), mode="drop"),
+                internal_weight=st.internal_weight.at[node_idx].set(ph.astype(f32), mode="drop"),
+                internal_count=st.internal_count.at[node_idx].set(pc.astype(f32), mode="drop"),
                 cat_bitset=st.cat_bitset.at[node_idx].set(bitset, mode="drop"),
                 left_child=st.left_child.at[node_idx].set(~pair_old, mode="drop"),
                 right_child=st.right_child.at[node_idx].set(~pair_new, mode="drop"),
@@ -593,7 +610,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 slot = slot_map[new_leaf_id]
                 hist3 = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
                                          backend=params.hist_backend,
-                                         bins_packed=bins_packed)
+                                         bins_packed=bins_packed,
+                                         acc_dtype=hdt)
                 hist_small = hist3[..., :2]
                 # any one group's bins partition the slot's rows, so group 0's
                 # count channel sums to the exact per-slot data count
@@ -621,43 +639,150 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             # ---- constraint propagation (reference: BasicLeafConstraints::Update:
             # mid = (left_out + right_out)/2; increasing: left.max=mid, right.min=mid) ----
             if use_imono:
-                # INTERMEDIATE method: the reference applies splits one at a
-                # time with bounds refreshed from actual outputs after every
-                # split (monotone_constraints.hpp GoUpToFindLeavesToUpdate).
-                # A batched round must replay that serial order (best-gain
-                # first, matching the reference's leaf-wise order) or two
-                # same-round splits on opposite sides of a monotone node can
-                # cross; the heavy work (routing/histograms) stays batched.
+                # INTERMEDIATE method — a dense, traced replay of
+                # IntermediateLeafConstraints (monotone_constraints.hpp:517):
+                #   * per-leaf [min, max] entries tightened with the ACTUAL
+                #     constrained child outputs (UpdateConstraintsWithOutputs),
+                #     not the basic method's midpoints;
+                #   * after each split, leaves in the opposite subtrees of
+                #     every monotone ancestor that are CONTIGUOUS with the new
+                #     leaves get their bound tightened with the new outputs
+                #     (GoUpToFindLeavesToUpdate / GoDownToFindLeavesToUpdate).
+                # The recursive walk becomes: a bottom-up scan over the split
+                # leaf's ancestor chain carrying (a) a (feature, side) dedup
+                # set (OppositeChildShouldBeUpdated) and (b) a per-leaf
+                # reachability mask derived from leaf hyperrectangles in bin
+                # space (ShouldKeepGoingLeftRight prunes exactly the leaves
+                # whose rectangle misses the original leaf's interval on each
+                # recorded ancestor feature). Splits replay serially (the
+                # reference is serial; best-gain order matches its leaf-wise
+                # order); heavy work (routing/histograms) stays batched.
                 def _one_split(i, carry):
-                    lo_v, hi_v, lov, anc_l, anc_r, nmono = carry
+                    (lo_v, hi_v, lov, anc_l, anc_r, nmono, ndepth,
+                     rlo, rhi, inmono, bchg) = carry
                     val = pair_valid[i]
                     o = jnp.where(val, pair_old[i], L)
                     nw = jnp.where(val, pair_new[i], L)
                     nd = jnp.where(val, pair_node[i], L)
+                    o_c = pair_old[i]                       # unclamped index
                     ol_i, or_i = constrained_child_outputs(
                         lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
                         params.lambda_l1, params.lambda_l2,
-                        lo_v[pair_old[i]], hi_v[pair_old[i]],
-                        params.path_smooth, lov[pair_old[i]])
-                    lov = lov.at[o].set(ol_i, mode="drop") \
-                             .at[nw].set(or_i, mode="drop")
-                    anc_l = anc_l.at[nw].set(anc_l[pair_old[i]], mode="drop")
-                    anc_r = anc_r.at[nw].set(anc_r[pair_old[i]], mode="drop")
+                        lo_v[o_c], hi_v[o_c],
+                        params.path_smooth, lov[o_c])
+                    lov = lov.at[o].set(ol_i.astype(f32), mode="drop") \
+                             .at[nw].set(or_i.astype(f32), mode="drop")
+                    anc_o_l = anc_l[o_c]                    # PROPER ancestors
+                    anc_o_r = anc_r[o_c]                    # of the new node
+                    is_num = (dirf[i] & 2) == 0
+                    m_split = jnp.where(is_num, monotone[feat[i]], 0)
+                    flag = (m_split != 0) | inmono[o_c]     # BeforeSplit
+                    depth_o = st.depth[o_c]
+                    sf, stb = feat[i], thr[i]
+
+                    # ---- children entries (UpdateConstraintsWithOutputs):
+                    # right clones left's entry, then monotone tightening with
+                    # the actual outputs (gated on leaf_is_in_monotone_subtree)
+                    lo_o, hi_o = lo_v[o_c], hi_v[o_c]
+                    g_num = flag & is_num
+                    new_hi_o = jnp.where(g_num & (m_split > 0),
+                                         jnp.minimum(hi_o, or_i), hi_o)
+                    new_lo_o = jnp.where(g_num & (m_split < 0),
+                                         jnp.maximum(lo_o, or_i), lo_o)
+                    new_lo_nw = jnp.where(g_num & (m_split > 0),
+                                          jnp.maximum(lo_o, ol_i), lo_o)
+                    new_hi_nw = jnp.where(g_num & (m_split < 0),
+                                          jnp.minimum(hi_o, ol_i), hi_o)
+                    lo_v = lo_v.at[o].set(new_lo_o.astype(f32), mode="drop") \
+                               .at[nw].set(new_lo_nw.astype(f32), mode="drop")
+                    hi_v = hi_v.at[o].set(new_hi_o.astype(f32), mode="drop") \
+                               .at[nw].set(new_hi_nw.astype(f32), mode="drop")
+
+                    # ---- contiguity walk up the ancestor chain ----
+                    use_l_P = (rlo[:, sf] <= stb) | ~is_num      # (L,) leaves
+                    use_r_P = (rhi[:, sf] > stb + 1) | ~is_num
+                    vmax = jnp.where(use_l_P & use_r_P,
+                                     jnp.maximum(ol_i, or_i),
+                                     jnp.where(use_l_P, ol_i, or_i)).astype(f32)
+                    vmin = jnp.where(use_l_P & use_r_P,
+                                     jnp.minimum(ol_i, or_i),
+                                     jnp.where(use_l_P, ol_i, or_i)).astype(f32)
+                    splittable = st.best_gain > NEG_INF / 2
+
+                    def _walk(j, wc):
+                        lo_w, hi_w, bad, seen, chg = wc
+                        d = depth_o - 1 - j
+                        one = anc_o_l | anc_o_r
+                        at_d = one & (ndepth == d) & \
+                            (jnp.arange(L) < (cur - 1) + i + 1)
+                        has_A = jnp.any(at_d) & (d >= 0)
+                        Aidx = jnp.argmax(at_d)
+                        Af = st.split_feature[Aidx]
+                        At = st.threshold_bin[Aidx]
+                        Anum = (st.dir_flags[Aidx] & 2) == 0
+                        side_r = anc_o_r[Aidx]              # o right of A
+                        Amono = nmono[Aidx]
+                        recorded = has_A & Anum & ~seen[Af, side_r.astype(i32)]
+                        doup = recorded & (Amono != 0) & flag & val
+                        opp = jnp.where(side_r, anc_l[:, Aidx], anc_r[:, Aidx])
+                        target = doup & opp & splittable & ~bad & \
+                            (use_l_P | use_r_P)
+                        # (monotone<0 ? o-left : o-right) updates opposite MAX
+                        upd_max = jnp.where(Amono < 0, ~side_r, side_r)
+                        hi_n = jnp.where(target & upd_max,
+                                         jnp.minimum(hi_w, vmin), hi_w)
+                        lo_n = jnp.where(target & ~upd_max,
+                                         jnp.maximum(lo_w, vmax), lo_w)
+                        # leaves whose entry actually tightened need their
+                        # best split re-found (leaves_to_update_; Update*
+                        # AndReturnBoolIfChanged semantics)
+                        chg = chg | (hi_n < hi_w) | (lo_n > lo_w)
+                        hi_w, lo_w = hi_n, lo_n
+                        # extend the reachability prune with A's plane
+                        okP = jnp.where(side_r, rhi[:, Af] > At + 1,
+                                        rlo[:, Af] <= At)
+                        bad = bad | (recorded & ~okP)
+                        seen = seen.at[Af, side_r.astype(i32)].set(
+                            seen[Af, side_r.astype(i32)] | recorded)
+                        return lo_w, hi_w, bad, seen, chg
+
+                    lo_v, hi_v, _, _, bchg = jax.lax.fori_loop(
+                        0, jnp.maximum(depth_o, 0), _walk,
+                        (lo_v, hi_v, jnp.zeros(L, bool),
+                         jnp.zeros((F, 2), bool), bchg))
+
+                    # ---- bookkeeping: ancestry, rectangles, node info ----
+                    anc_l = anc_l.at[nw].set(anc_o_l, mode="drop")
+                    anc_r = anc_r.at[nw].set(anc_o_r, mode="drop")
                     anc_l = anc_l.at[o, nd].set(True, mode="drop")
                     anc_r = anc_r.at[nw, nd].set(True, mode="drop")
-                    nm = jnp.where((dirf[i] & 2) != 0, 0, monotone[feat[i]])
-                    nmono = nmono.at[nd].set(nm, mode="drop")
-                    lo_v, hi_v = intermediate_monotone_bounds(
-                        anc_l, anc_r, nmono, lov, BIG)
-                    return lo_v, hi_v, lov, anc_l, anc_r, nmono
+                    nmono = nmono.at[nd].set(m_split, mode="drop")
+                    ndepth = ndepth.at[nd].set(depth_o, mode="drop")
+                    rlo = rlo.at[nw].set(rlo[o_c], mode="drop")
+                    rhi = rhi.at[nw].set(rhi[o_c], mode="drop")
+                    rhi = rhi.at[o, sf].set(
+                        jnp.where(is_num, jnp.minimum(rhi[o_c, sf], stb + 1),
+                                  rhi[o_c, sf]), mode="drop")
+                    rlo = rlo.at[nw, sf].set(
+                        jnp.where(is_num, jnp.maximum(rlo[o_c, sf], stb + 1),
+                                  rlo[o_c, sf]), mode="drop")
+                    inmono = inmono.at[o].set(flag, mode="drop") \
+                                   .at[nw].set(flag, mode="drop")
+                    return (lo_v, hi_v, lov, anc_l, anc_r, nmono, ndepth,
+                            rlo, rhi, inmono, bchg)
 
                 carry = jax.lax.fori_loop(
                     0, S, _one_split,
                     (st.out_lo, st.out_hi, st2.leaf_out,
-                     st2.anc_left, st2.anc_right, st2.node_mono))
+                     st2.anc_left, st2.anc_right, st2.node_mono,
+                     st2.node_depth, st2.rect_lo, st2.rect_hi,
+                     st2.leaf_in_mono, jnp.zeros(L, bool)))
                 st2 = st2._replace(out_lo=carry[0], out_hi=carry[1],
                                    leaf_out=carry[2], anc_left=carry[3],
-                                   anc_right=carry[4], node_mono=carry[5])
+                                   anc_right=carry[4], node_mono=carry[5],
+                                   node_depth=carry[6], rect_lo=carry[7],
+                                   rect_hi=carry[8], leaf_in_mono=carry[9])
+                imono_changed = carry[10]
             elif use_output:
                 lo_p = st.out_lo[pair_old]
                 hi_p = st.out_hi[pair_old]
@@ -676,12 +801,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 r_lo = jnp.where(mt > 0, jnp.maximum(lo_p, mid), lo_p)
                 r_hi = jnp.where(mt < 0, jnp.minimum(hi_p, mid), hi_p)
                 st2 = st2._replace(
-                    out_lo=st2.out_lo.at[old_idx].set(l_lo, mode="drop")
-                                     .at[new_idx].set(r_lo, mode="drop"),
-                    out_hi=st2.out_hi.at[old_idx].set(l_hi, mode="drop")
-                                     .at[new_idx].set(r_hi, mode="drop"),
-                    leaf_out=st2.leaf_out.at[old_idx].set(ol, mode="drop")
-                                         .at[new_idx].set(orr, mode="drop"))
+                    out_lo=st2.out_lo.at[old_idx].set(l_lo.astype(f32), mode="drop")
+                                     .at[new_idx].set(r_lo.astype(f32), mode="drop"),
+                    out_hi=st2.out_hi.at[old_idx].set(l_hi.astype(f32), mode="drop")
+                                     .at[new_idx].set(r_hi.astype(f32), mode="drop"),
+                    leaf_out=st2.leaf_out.at[old_idx].set(ol.astype(f32), mode="drop")
+                                         .at[new_idx].set(orr.astype(f32), mode="drop"))
             if use_inter:
                 fe_oh = jax.nn.one_hot(feat, F, dtype=jnp.int32).astype(bool)
                 new_used = st.used_feat[pair_old] | fe_oh       # (S, F)
@@ -704,15 +829,36 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             st2 = st2._replace(hist=new_hist)
 
             # ---- best splits for the 2S children ----
-            ids2 = jnp.concatenate([pair_old, pair_new])
-            valid2 = jnp.concatenate([pair_valid, pair_valid])
+            # Under intermediate monotone constraints, other leaves' entries
+            # may have tightened, which invalidates their cached best splits;
+            # the reference re-finds splits for every leaf in
+            # leaves_need_update (serial_tree_learner.cpp Split ->
+            # RecomputeBestSplitForLeaf). Recomputing ALL leaves is
+            # equivalent (unchanged bounds reproduce the cached result) and
+            # stays one dense scan.
+            if use_imono:
+                # children always recompute; other leaves only when their
+                # entry actually tightened (leaves_need_update). Unchanged
+                # leaves keep their cached best split — also keeps by-node /
+                # extra_trees draws stable for them (the reference's
+                # RecomputeBestSplitForLeaf redraws GetByNode only for
+                # recomputed leaves, serial_tree_learner.cpp:1053)
+                ids2 = jnp.arange(L)
+                child2 = jnp.zeros(L, bool) \
+                    .at[old_idx].set(pair_valid, mode="drop") \
+                    .at[new_idx].set(pair_valid, mode="drop")
+                valid2 = child2 | imono_changed
+            else:
+                ids2 = jnp.concatenate([pair_old, pair_new])
+                valid2 = jnp.concatenate([pair_valid, pair_valid])
             hist2 = new_hist[ids2]
             rkey = (jax.random.fold_in(key, 2 + st.round_idx)
                     if key is not None else None)
+            rows2 = L if use_imono else 2 * S
             cmask2 = node_col_mask(st.col_mask[None, :],
                                    st2.used_feat[ids2] if use_inter
-                                   else jnp.zeros((2 * S, F), bool),
-                                   rkey, rows=2 * S)
+                                   else jnp.zeros((rows2, F), bool),
+                                   rkey, rows=rows2)
             with jax.named_scope("find_splits"):
                 res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
                               st2.cnt[ids2],
@@ -773,13 +919,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                  params.lambda_l2, params.max_delta_step)
     # single-leaf tree edge case: value 0 (no boost)
     leaf_value = jnp.where(final.num_leaves_cur > 1, leaf_value, 0.0)
+    # f32 outputs regardless of the histogram dtype: downstream score updates
+    # and model finalization run outside any enable_x64 scope
     tree = TreeArrays(
         split_feature=final.split_feature, threshold_bin=final.threshold_bin,
         dir_flags=final.dir_flags, left_child=final.left_child,
         right_child=final.right_child, split_gain=final.split_gain,
         internal_value=final.internal_value, internal_weight=final.internal_weight,
         internal_count=final.internal_count, cat_bitset=final.cat_bitset,
-        leaf_value=leaf_value, leaf_weight=final.sum_h, leaf_count=final.cnt,
+        leaf_value=leaf_value.astype(f32), leaf_weight=final.sum_h.astype(f32),
+        leaf_count=final.cnt.astype(f32),
         leaf_parent=final.leaf_parent, num_leaves=final.num_leaves_cur,
         leaf_depth=final.depth,
     )

@@ -33,7 +33,8 @@ def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
                      hess: jax.Array, cnt: jax.Array, num_slots: int,
                      max_group_bins: int, backend: str = "auto",
                      block_rows: int = 16384, dtype=jnp.float32,
-                     bins_packed: Optional[jax.Array] = None) -> jax.Array:
+                     bins_packed: Optional[jax.Array] = None,
+                     acc_dtype=jnp.float32) -> jax.Array:
     """Build per-slot histograms.
 
     Args:
@@ -43,16 +44,22 @@ def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
       cnt: (N,) float32 count weight (the bagging mask itself; 1.0 = in-bag).
       num_slots: S (static).
       max_group_bins: Bmax (static).
+      acc_dtype: accumulator dtype. float64 (hist_precision=double, segsum/
+        onehot only; needs an enclosing jax.enable_x64) mirrors the
+        reference's float32-gradients-into-double-histograms arithmetic
+        (hist_t, src/io/dense_bin.hpp) so near-tied split gains resolve the
+        same way stock LightGBM resolves them.
     Returns:
-      (S, G, Bmax, 3) float32 histograms.
+      (S, G, Bmax, 3) acc_dtype histograms.
     """
     if backend == "auto":
         backend = "pallas" if jax.default_backend() in ("tpu", "axon") else "segsum"
     if backend == "segsum":
-        return _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins)
+        return _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins,
+                            acc_dtype)
     if backend == "onehot":
         return _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins,
-                            block_rows, dtype)
+                            block_rows, dtype, acc_dtype)
     if backend == "pallas":
         from ..pallas.hist_kernel import build_histograms_sorted
         return build_histograms_sorted(bins, slot, grad, hess, cnt, num_slots,
@@ -60,11 +67,12 @@ def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
     raise ValueError(f"unknown hist backend {backend!r}")
 
 
-def _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins):
+def _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins,
+                 acc_dtype=jnp.float32):
     n, num_groups = bins.shape
     valid = slot >= 0
     s = jnp.where(valid, slot, 0)
-    w = jnp.stack([grad, hess, cnt], axis=-1)  # (N, 3)
+    w = jnp.stack([grad, hess, cnt], axis=-1).astype(acc_dtype)  # (N, 3)
     w = w * valid[:, None].astype(w.dtype)
 
     def per_group(bins_col):
@@ -78,7 +86,7 @@ def _hist_segsum(bins, slot, grad, hess, cnt, num_slots, max_group_bins):
 
 
 def _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins, block_rows,
-                 dtype):
+                 dtype, acc_dtype=jnp.float32):
     """Blocked one-hot matmul: per row block and group, (Bmax, T) @ (T, 3S) on the MXU."""
     n, num_groups = bins.shape
     nb = -(-n // block_rows)
@@ -108,13 +116,13 @@ def _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins, block_r
             oh = jax.nn.one_hot(col.astype(jnp.int32), max_group_bins,
                                 dtype=dtype, axis=0)       # (Bmax, T)
             h = jax.lax.dot(oh, w_blk,
-                            preferred_element_type=jnp.float32)   # (Bmax, 3S)
+                            preferred_element_type=acc_dtype)   # (Bmax, 3S)
             return acc.at[g].add(h)
         acc0 = carry
         acc = jax.lax.fori_loop(0, num_groups, group_body, acc0)
         return acc, None
 
-    init = jnp.zeros((num_groups, max_group_bins, num_slots * NUM_CHANNELS), jnp.float32)
+    init = jnp.zeros((num_groups, max_group_bins, num_slots * NUM_CHANNELS), acc_dtype)
     hist, _ = jax.lax.scan(block_body, init, (bins_b, W_b))
     # (G, Bmax, 3S) -> (S, G, Bmax, 3)
     hist = hist.reshape(num_groups, max_group_bins, num_slots, NUM_CHANNELS)

@@ -223,12 +223,17 @@ def find_best_splits(
     bin_iota = jnp.arange(Bmax)[None, None, :]             # broadcast (1,1,Bmax)
     has_nan = (layout.nan_bin >= 0)[None, :, None]
     nan_idx = jnp.maximum(layout.nan_bin, 0)
+    # zeroed for no-NaN features: their single (reverse) scan must not pick up
+    # bin 0 via the clamped gather below
     nan_g = jnp.take_along_axis(hg, nan_idx[None, :, None].repeat(S, 0), axis=-1)
     nan_h = jnp.take_along_axis(hh, nan_idx[None, :, None].repeat(S, 0), axis=-1)
     nan_c = jnp.take_along_axis(hc, nan_idx[None, :, None].repeat(S, 0), axis=-1)
+    nan_g = jnp.where(has_nan, nan_g, 0.0)
+    nan_h = jnp.where(has_nan, nan_h, 0.0)
+    nan_c = jnp.where(has_nan, nan_c, 0.0)
 
-    def split_gain(lg, lh, lc):
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
+    def split_gain(lg, lh, lc, rc):
+        rg, rh = pg - lg, ph - lh
         if use_output_gain:
             ol, orr = constrained_child_outputs(
                 lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
@@ -245,40 +250,78 @@ def find_best_splits(
               (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
         return jnp.where(ok, gain, NEG_INF)
 
-    # direction 0: missing (NaN bin, stored last) goes right — left = cumsum at t
-    gain_d0 = split_gain(cg, ch, cc)
-    # direction 1: missing goes left — left = cumsum at t + NaN bin contents
-    gain_d1 = split_gain(cg + nan_g, ch + nan_h, cc + nan_c)
-    gain_d1 = jnp.where(has_nan, gain_d1, NEG_INF)
-
-    # valid thresholds: t < nbins - 1 (right side non-empty), and for NaN features the
-    # NaN bin itself is not a threshold position
+    # The reference evaluates numerical thresholds with one or two sequential
+    # scans (feature_histogram.hpp:833 FindBestThresholdSequentially):
+    #   * REVERSE (right-to-left): the ONLY scan for features without missing
+    #     values, and the missing-LEFT scan for NaN features. Its strict
+    #     `current_gain > best_gain` update means the HIGHEST of gain-tied
+    #     thresholds wins (ties happen whenever a bin is empty in a leaf).
+    #   * forward (left-to-right): the missing-RIGHT scan for NaN features;
+    #     the LOWEST tied threshold wins. It also covers threshold nb-2
+    #     ("all data bins left, NaN right"), which REVERSE does not.
+    #   * On a gain tie between scans, REVERSE wins (the forward scan must
+    #     strictly beat it: `best_gain > output->gain + min_gain_shift`), and
+    #     `output->default_left = REVERSE`, so no-missing features always
+    #     record default_left=true, matching stock model bytes.
     data_bins = jnp.where(layout.nan_bin[None, :, None] >= 0,
                           nbins[None, :, None] - 1, nbins[None, :, None])
-    t_valid = bin_iota < (data_bins - 1)
-    gain_d0 = jnp.where(t_valid, gain_d0, NEG_INF)
-    gain_d1 = jnp.where(t_valid, gain_d1, NEG_INF)
-    num_gain = jnp.maximum(gain_d0, gain_d1)               # (S, F, Bmax)
-    num_default_left = gain_d1 > gain_d0
+    # Data-count estimates follow each scan's ACCUMULATION direction: the
+    # reverse scan sums RoundInt'd per-bin counts over the RIGHT data bins
+    # and derives left = num_data - right (feature_histogram.hpp:857-884);
+    # forward accumulates the left side. The two differ after rounding —
+    # e.g. an inflated left-cumsum can report right = 3 when the right bins
+    # round to 5 — and stock's min_data_in_leaf gate uses the scan's own
+    # estimate, so the gate must too.
+    ccDB = jnp.take_along_axis(
+        cc, jnp.maximum(jnp.broadcast_to(data_bins - 1, cc.shape[:2] + (1,)),
+                        0), axis=-1)                       # (S, F, 1)
+    rc_rev = ccDB - cc                                     # right rounded counts
+    lc_rev = pc - rc_rev
+    lc_fwd = cc
+    rc_fwd = pc - cc
+    # rev: missing left — left side = cumsum at t + NaN bin contents
+    gain_rev = split_gain(cg + nan_g, ch + nan_h, lc_rev, rc_rev)
+    # fwd: missing right — left side = plain cumsum at t (NaN features only)
+    gain_fwd = jnp.where(has_nan, split_gain(cg, ch, lc_fwd, rc_fwd), NEG_INF)
+    # rev thresholds: t in [0, data_bins-2]; fwd adds t = data_bins-1
+    # ("NaN vs the rest") for NaN features
+    gain_rev = jnp.where(bin_iota < (data_bins - 1), gain_rev, NEG_INF)
+    gain_fwd = jnp.where(bin_iota < data_bins, gain_fwd, NEG_INF)
 
     # relative (vs parent) gain so per-feature penalties compose before the argmax
     parent_term_num = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
-    num_rel = num_gain - parent_term_num[:, None, None]
-    num_rel = jnp.where(num_gain <= NEG_INF / 2, NEG_INF, num_rel)
-    if monotone is not None and monotone_penalty > 0.0 and slot_depth is not None:
-        pen = monotone_penalty_factor(slot_depth, monotone_penalty)[:, None, None]
-        num_rel = jnp.where((mono_b != 0) & (num_rel > 0), num_rel * pen, num_rel)
-    if extra_key is not None:
-        # extra_trees: evaluate ONE random threshold per (slot, feature)
-        # (reference: feature_histogram.hpp rand_threshold under extra_trees)
-        rand_t = jax.random.randint(
-            extra_key, (S, F), 0, 1 << 30) % jnp.maximum(nbins[None, :] - 1, 1)
-        num_rel = jnp.where(bin_iota == rand_t[..., None], num_rel, NEG_INF)
+
+    def _rel(num_gain):
+        num_rel = num_gain - parent_term_num[:, None, None]
+        num_rel = jnp.where(num_gain <= NEG_INF / 2, NEG_INF, num_rel)
+        if monotone is not None and monotone_penalty > 0.0 and slot_depth is not None:
+            pen = monotone_penalty_factor(slot_depth, monotone_penalty)[:, None, None]
+            num_rel = jnp.where((mono_b != 0) & (num_rel > 0), num_rel * pen, num_rel)
+        if extra_key is not None:
+            # extra_trees: evaluate ONE random threshold per (slot, feature)
+            # (reference: feature_histogram.hpp rand_threshold under extra_trees)
+            rand_t = jax.random.randint(
+                extra_key, (S, F), 0, 1 << 30) % jnp.maximum(nbins[None, :] - 1, 1)
+            num_rel = jnp.where(bin_iota == rand_t[..., None], num_rel, NEG_INF)
+        return num_rel
+
+    rel_rev, rel_fwd = _rel(gain_rev), _rel(gain_fwd)
+
+    def _pick_num_best(rel_rev, rel_fwd):
+        """Per-(slot, feature) winner with the reference's scan-order
+        tie-breaks: reverse prefers the highest tied threshold, forward the
+        lowest, and reverse beats forward on equal gain."""
+        t_rev = (rel_rev.shape[-1] - 1) - jnp.argmax(rel_rev[..., ::-1], axis=-1)
+        g_rev = jnp.take_along_axis(rel_rev, t_rev[..., None], -1)[..., 0]
+        t_fwd = jnp.argmax(rel_fwd, axis=-1)
+        g_fwd = jnp.take_along_axis(rel_fwd, t_fwd[..., None], -1)[..., 0]
+        use_rev = g_rev >= g_fwd
+        return (jnp.where(use_rev, t_rev, t_fwd),
+                jnp.where(use_rev, g_rev, g_fwd), use_rev)
 
     if not enable_categorical:
         # numeric-only fast path: much smaller compiled program (no per-bin argsort)
-        best_t = jnp.argmax(num_rel, axis=-1)
-        best_gain_f = jnp.take_along_axis(num_rel, best_t[..., None], -1)[..., 0]
+        best_t, best_gain_f, use_rev_f = _pick_num_best(rel_rev, rel_fwd)
         if cegb_penalty is not None:
             # cost-effective gradient boosting: subtract the split cost from
             # every candidate's gain (cost_effective_gradient_boosting.hpp:80)
@@ -291,18 +334,19 @@ def find_best_splits(
         ar = jnp.arange(S)
         rel_gain = best_gain_f[ar, best_f]
         t = best_t[ar, best_f]
-        dflt_l = num_default_left[ar, best_f, t]
+        dflt_l = use_rev_f[ar, best_f]
 
         def pick(a3):
             return a3[ar, best_f, t]
 
         lg = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
         lh = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
-        lc = pick(cc) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_c, cc.shape)), 0.0)
+        lc = jnp.where(dflt_l, pick(jnp.broadcast_to(lc_rev, cg.shape)),
+                       pick(jnp.broadcast_to(lc_fwd, cg.shape)))
         rel_gain = jnp.where(rel_gain > min_gain_to_split, rel_gain, NEG_INF)
         dir_flags = jnp.where(dflt_l, DIR_DEFAULT_LEFT, 0)
         return SplitResult(
-            gain=rel_gain.astype(jnp.float32), feature=best_f.astype(jnp.int32),
+            gain=rel_gain, feature=best_f.astype(jnp.int32),
             threshold=t.astype(jnp.int32), dir_flags=dir_flags.astype(jnp.int32),
             left_sum_g=lg, left_sum_h=lh, left_count=lc,
             right_sum_g=parent_g - lg, right_sum_h=parent_h - lh,
@@ -362,9 +406,12 @@ def find_best_splits(
     cat_rel = jnp.where(cat_gain <= NEG_INF / 2, NEG_INF, cat_rel)
 
     # ---------------- combine ----------------
-    gain_t = jnp.where(is_cat, cat_rel, num_rel)           # (S, F, Bmax) rel gains
-    best_t = jnp.argmax(gain_t, axis=-1)                   # (S, F)
-    best_gain_f = jnp.take_along_axis(gain_t, best_t[..., None], -1)[..., 0]
+    t_num, g_num, use_rev_f = _pick_num_best(rel_rev, rel_fwd)
+    t_cat = jnp.argmax(cat_rel, axis=-1)
+    g_cat = jnp.take_along_axis(cat_rel, t_cat[..., None], -1)[..., 0]
+    is_cat_f = layout.is_cat[None, :]                      # (1, F)
+    best_t = jnp.where(is_cat_f, t_cat, t_num)             # (S, F)
+    best_gain_f = jnp.where(is_cat_f, g_cat, g_num)
     if cegb_penalty is not None:
         best_gain_f = jnp.where(best_gain_f > NEG_INF / 2,
                                 best_gain_f - cegb_penalty, NEG_INF)
@@ -382,14 +429,15 @@ def find_best_splits(
     f_is_cat = layout.is_cat[best_f]
     f_use_oh = cat_use_oh[ar, best_f, t]
     f_rev = sorted_rev[ar, best_f, t]
-    dflt_l = num_default_left[ar, best_f, t]
+    dflt_l = use_rev_f[ar, best_f] & ~f_is_cat
 
     def pick(a3):
         return a3[ar, best_f, t]
 
     lg_num = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
     lh_num = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
-    lc_num = pick(cc) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_c, cc.shape)), 0.0)
+    lc_num = jnp.where(dflt_l, pick(jnp.broadcast_to(lc_rev, cg.shape)),
+                       pick(jnp.broadcast_to(lc_fwd, cg.shape)))
     lg_oh, lh_oh, lc_oh = pick(hg), pick(hh), pick(hc)
     lg_fs, lh_fs, lc_fs = pick(csg), pick(csh), pick(csc)
     lg_rs = eg[ar, best_f, 0] - lg_fs
@@ -413,7 +461,7 @@ def find_best_splits(
     thr = jnp.where(f_is_cat & ~f_use_oh, t + 1, t).astype(jnp.int32)
 
     return SplitResult(
-        gain=rel_gain.astype(jnp.float32),
+        gain=rel_gain,
         feature=best_f.astype(jnp.int32),
         threshold=thr,
         dir_flags=dir_flags.astype(jnp.int32),

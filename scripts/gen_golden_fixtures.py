@@ -131,6 +131,22 @@ def main():
              "predict_raw_score": "true", "verbosity": -1}, FIX)
     print("generated stock_interaction.model")
 
+    # ---- forced bin bounds (bin.cpp FindBinWithPredefinedBin) ----
+    import json as _json
+    fb = [{"feature": 1, "bin_upper_bound": [-0.5, 0.1, 0.75]},
+          {"feature": 3, "bin_upper_bound": [0.0, 0.42]}]
+    (FIX / "golden_forcedbins.json").write_text(_json.dumps(fb))
+    model = FIX / "stock_forcedbins.model"
+    run_cli({**common, "objective": "regression",
+             "data": str(FIX / 'golden_train_reg.csv'),
+             "forcedbins_filename": str(FIX / "golden_forcedbins.json"),
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_forcedbins.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_forcedbins.model")
+
     # ---- refit on perturbed labels (Application task=refit) ----
     rs2 = np.random.RandomState(13)
     flip = rs2.rand(len(y_bin)) < 0.15
@@ -138,10 +154,15 @@ def main():
     refit_csv = FIX / "golden_train_refit.csv"
     write_csv(refit_csv, y_refit, X)
     model = FIX / "stock_binary_refit.model"
+    # objective must be passed explicitly: CLI task=refit builds its objective
+    # from the config (default "regression"), NOT the model's objective line
+    # (application.cpp:262 CreateObjectiveFunction(config_.objective)); the
+    # Python-API refit the test exercises uses the booster's objective
     run_cli({"task": "refit", "data": str(refit_csv),
              "input_model": str(FIX / 'stock_binary.model'),
              "output_model": str(model), "header": "false",
              "label_column": "0", "refit_decay_rate": "0.9",
+             "objective": "binary",
              "verbosity": -1}, FIX)
     run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
              "input_model": str(model), "header": "false",

@@ -1,0 +1,82 @@
+"""Profile one training iteration on device and aggregate op durations
+from the chrome trace (dev tool).
+
+Usage: python scripts/profile_grow.py [rows]
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+    import jax
+    import lightgbm_tpu as lgb
+
+    rs = np.random.RandomState(7)
+    X = rs.randn(rows, 28).astype(np.float32)
+    y = (rs.rand(rows) < 0.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
+              "max_bin": 63, "verbosity": -1, "max_splits_per_round": 64,
+              "use_quantized_grad": True, "num_grad_quant_bins": 64}
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params, ds)
+    for _ in range(3):      # warmup: compile everything
+        bst.update()
+    bst.engine.score.block_until_ready()
+
+    tdir = "/tmp/lgb_trace"
+    os.system(f"rm -rf {tdir}")
+    with jax.profiler.trace(tdir):
+        t0 = time.time()
+        for _ in range(3):
+            bst.update()
+        bst.engine.score.block_until_ready()
+        wall = time.time() - t0
+    print(f"3 iters wall: {wall*1e3:.1f} ms ({wall/3*1e3:.1f} ms/iter)")
+
+    files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        print("no trace files found under", tdir)
+        return
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    total = 0.0
+    for fpath in files:
+        with gzip.open(fpath, "rt") as fh:
+            tr = json.load(fh)
+        # device lanes only: pick pids whose process name mentions TPU/device
+        dev_pids = set()
+        for ev in tr.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                nm = ev.get("args", {}).get("name", "")
+                if "TPU" in nm or "Device" in nm or "/device" in nm:
+                    dev_pids.add(ev.get("pid"))
+        for ev in tr.get("traceEvents", []):
+            if ev.get("ph") != "X" or ev.get("pid") not in dev_pids:
+                continue
+            name = ev.get("name", "?")
+            dur = float(ev.get("dur", 0.0))
+            agg[name] += dur
+            cnt[name] += 1
+            total += dur
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:35]
+    print(f"total device op time {total/1e3:.1f} ms across {len(files)} files")
+    for name, dur in top:
+        print(f"{dur/1e3:9.2f} ms  x{cnt[name]:<5d} {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

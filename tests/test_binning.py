@@ -8,14 +8,22 @@ from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
 
 
 def test_few_distinct_values_get_own_bins():
+    # stock-verified: the reference CLI reports "Total Bins 4" for this
+    # feature and tree threshold nextafter(2.5) — FindBinWithZeroAsOneBin
+    # (bin.cpp:247) always reserves the [-kZeroThreshold, kZeroThreshold]
+    # zero bin when positive values exist, so an all-positive feature gets
+    # an empty bin 0 plus one bin per distinct value
     vals = np.array([1.0, 1.0, 2.0, 2.0, 3.0, 3.0, 3.0])
     m = BinMapper.find_numerical(vals, max_bin=255, min_data_in_bin=1,
                                  use_missing=True, zero_as_missing=False)
-    assert m.num_bins == 3
+    assert m.num_bins == 4
+    np.testing.assert_allclose(
+        m.upper_bounds,
+        [1e-35, np.nextafter(1.5, np.inf), np.nextafter(2.5, np.inf), np.inf])
     b = m.transform(np.array([1.0, 2.0, 3.0]))
     assert len(set(b.tolist())) == 3
-    # ordering preserved
-    assert b[0] < b[1] < b[2]
+    # ordering preserved; bin 0 (the zero bin) stays empty
+    assert 0 < b[0] < b[1] < b[2]
 
 
 def test_quantile_binning_many_values():

@@ -133,7 +133,7 @@ def test_unimplemented_params_raise():
     # cegb penalty remains unimplemented and must fail loudly, as must invalid
     # enums and a missing forced-splits file
     for bad in ({"cegb_penalty_feature_lazy": [1.0, 1.0, 1.0]},
-                {"hist_precision": "double"},
+                {"hist_precision": "quad"},
                 {"forcedsplits_filename": "/nonexistent/f.json"}):
         ds = lgb.Dataset(X, label=y)
         params = {"objective": "regression", "verbosity": -1, **bad}
