@@ -108,6 +108,10 @@ def run_ranking():
         "verbosity": -1,
         "max_splits_per_round": 64,
         "ndcg_eval_at": [10],
+        # quantized-gradient training (reference: use_quantized_grad works
+        # for ranking objectives too); the NDCG gate below verifies quality
+        "use_quantized_grad": True,
+        "num_grad_quant_bins": 64,
     }
     extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
     if extra:

@@ -145,11 +145,14 @@ class Dataset:
             from .dataset_io import load_data_file
             from .parallel.dist_data import dist_context
             dist = dist_context()
-            if (dist is not None and reference is None
+            if (dist is not None
                     and not self.params.get("pre_partition", False)):
                 # distributed load: this process parses ONLY its row shard
                 # (reference: DatasetLoader::LoadFromFile rank sharding,
-                # dataset_loader.cpp:211); mappers sync in construct()
+                # dataset_loader.cpp:211); mappers sync in construct().
+                # With reference= set (validation data) the shard is binned
+                # with the TRAINING dataset's mappers instead
+                # (LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:307)
                 rank, nproc = dist
                 data, label_file, extras = load_data_file(
                     str(data), self.params, rank=rank, num_machines=nproc)
@@ -201,10 +204,11 @@ class Dataset:
         Pad rows carry weight 0 + true-mask 0 (see parallel/dist_data.py)."""
         from .parallel.dist_data import (allgather_np, check_uniform_features,
                                          gather_padded, shard_pad_base)
-        if self.group is not None:
+        if self.group is not None and int(self.group.sum()) != self.num_data_:
             raise LightGBMError(
-                "distributed loading cannot row-shard grouped (ranking) "
-                "data; pre-partition per machine (pre_partition=true)")
+                f"sum of group sizes ({int(self.group.sum())}) does not match "
+                f"this rank's row count ({self.num_data_}); distributed "
+                "ranking data must be pre-partitioned on query boundaries")
         fg = check_uniform_features(self.num_feature_)
         if fg != self.num_feature_:
             self.raw_data = np.pad(self.raw_data,
@@ -226,6 +230,29 @@ class Dataset:
         self.position = gather_padded(self.position, n_shard)
         if self.init_score is not None:
             self.init_score = gather_padded(self.init_score, n_shard)
+        if self.group is not None:
+            # global query spans (start, size) in the shard-padded row space:
+            # whole queries stay on their rank (the reference's distributed
+            # ranking contract — queries never straddle machines,
+            # dataset_loader.cpp partition_fun keeps groups together); pad
+            # rows between shards belong to no query
+            g = self.group
+            rank, nproc = self._dist["rank"], self._dist["nproc"]
+            starts_local = np.concatenate([[0], np.cumsum(g)[:-1]])
+            nq_all = allgather_np(np.asarray([len(g)], np.int64)).reshape(-1)
+            nq_max = int(nq_all.max())
+            pad_s = np.zeros(nq_max, np.int64)
+            pad_s[:len(g)] = starts_local
+            pad_z = np.zeros(nq_max, np.int64)
+            pad_z[:len(g)] = g
+            s_all = allgather_np(pad_s)                  # (P, nq_max)
+            z_all = allgather_np(pad_z)
+            spans = []
+            for r in range(nproc):
+                kq = int(nq_all[r])
+                spans.append(np.stack(
+                    [s_all[r, :kq] + r * n_shard, z_all[r, :kq]], axis=1))
+            self._query_spans = np.concatenate(spans, axis=0)   # (NQ, 2)
         self.num_data_ = int(n_shard * self._dist["nproc"])
 
     def get_true_row_mask(self, n: int) -> np.ndarray:
@@ -361,6 +388,20 @@ class Dataset:
         from dataclasses import replace
         from .parallel.dist_data import gather_sample
         d = self._dist
+        if self.reference is not None:
+            # validation data aligns with the TRAINING dataset's mappers and
+            # EFB layout (reference: LoadFromFileAlignWithOtherDataset,
+            # dataset_loader.cpp:307)
+            ref = self.reference.construct()
+            local = construct_binned(self.raw_data, ref.binned.bin_mappers,
+                                     ref.binned.group_features)
+            n_shard = d["n_shard"]
+            bins = np.pad(local.bins, ((0, n_shard - local.bins.shape[0]),
+                                       (0, 0)))
+            self.binned = replace(local, bins=bins, num_data=n_shard)
+            if self.free_raw_data:
+                self.raw_data = None
+            return self
         per_rank = max(1, cfg.bin_construct_sample_cnt // d["nproc"])
         rng = np.random.RandomState(cfg.data_random_seed + d["rank"])
         if d["n_local"] > per_rank:
@@ -469,8 +510,13 @@ class Dataset:
 
     # -- helpers used by the boosting engine ---------------------------
     def get_query_boundaries(self) -> Optional[np.ndarray]:
+        """1-D (nq+1,) cumulative boundaries for contiguous layouts, or
+        (nq, 2) [start, size] spans for the shard-padded distributed layout
+        (pad rows between shards belong to no query)."""
         if self.group is None:
             return None
+        if self._dist is not None:
+            return self._query_spans
         return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
 
     def get_label_padded(self, n: int) -> Optional[np.ndarray]:
@@ -777,15 +823,18 @@ class Booster:
     # ------------------------------------------------------------------
     def eval_train(self, feval=None) -> List:
         out = [(n, m, v, hb) for (n, m, v, hb) in self.engine.eval_train()]
-        out.extend(self._run_feval(feval, "training", self.engine.train_data,
-                                   np.asarray(self.engine._unpad_score())))
+        out.extend(self._run_feval(
+            feval, "training", self.engine.train_data,
+            self.engine._score_to_host(self.engine.score,
+                                       self.engine.num_data)))
         return out
 
     def eval_valid(self, feval=None) -> List:
         out = [(n, m, v, hb) for (n, m, v, hb) in self.engine.eval_valid()]
         for vi, vset in enumerate(self.engine.valid_sets):
             n = vset.num_data()
-            score = np.asarray(self.engine._valid_scores[vi][:n])
+            score = self.engine._score_to_host(
+                self.engine._valid_scores[vi], n)
             out.extend(self._run_feval(feval, self.engine.valid_names[vi], vset, score))
         return out
 
@@ -793,7 +842,8 @@ class Booster:
         for vi, vset in enumerate(self.engine.valid_sets):
             if vset is data:
                 n = vset.num_data()
-                score = np.asarray(self.engine._valid_scores[vi][:n])
+                score = self.engine._score_to_host(
+                    self.engine._valid_scores[vi], n)
                 out = []
                 conv = (self.engine.objective.convert_output
                         if self.engine.objective else (lambda x: x))

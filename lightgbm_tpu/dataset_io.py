@@ -135,15 +135,53 @@ def load_data_file(path: str, params: Dict[str, Any],
     return feats, label, extras
 
 
+def _query_aligned_rows(path: str, qg: np.ndarray, rank: int,
+                        num_machines: int, skip_header: bool):
+    """Row range + group slice for query-boundary-respecting sharding: whole
+    queries stay on one rank (the reference partitions ranking data at query
+    granularity — Metadata::CheckOrPartition keeps groups together), with
+    per-rank row counts as even as the query sizes allow."""
+    bounds = np.concatenate([[0], np.cumsum(qg)]).astype(np.int64)
+    total = int(bounds[-1])
+    targets = [int(round(total * r / num_machines))
+               for r in range(num_machines + 1)]
+    qsplit = np.searchsorted(bounds, targets, side="left")
+    qsplit[0], qsplit[-1] = 0, len(qg)
+    qsplit = np.maximum.accumulate(qsplit)
+    q0, q1 = int(qsplit[rank]), int(qsplit[rank + 1])
+    row0, row1 = int(bounds[q0]), int(bounds[q1])
+    # collect the rank's (non-blank, non-comment) rows
+    lines = []
+    seen = 0
+    with open(path, "rb") as f:
+        if skip_header:
+            f.readline()
+        for ln in f:
+            if not ln.strip() or ln.startswith(b"#"):
+                continue
+            if seen >= row1:
+                break
+            if seen >= row0:
+                lines.append(ln)
+            seen += 1
+    return b"".join(lines), row0, qg[q0:q1]
+
+
 def _load_data_file_shard(path: str, params: Dict[str, Any], fmt: str,
                           rank: int, num_machines: int):
     """Parse one rank's shard of a CSV/TSV/LibSVM file (see load_data_file)."""
     has_header = bool(params.get("header", False))
-    start, end, start_row = shard_byte_range(path, rank, num_machines,
-                                             skip_header=has_header)
-    with open(path, "rb") as f:
-        f.seek(start)
-        blob = f.read(end - start)
+    group_slice = None
+    qg = load_query_file(path) if fmt != "libsvm" else None
+    if qg is not None:
+        blob, start_row, group_slice = _query_aligned_rows(
+            path, qg, rank, num_machines, has_header)
+    else:
+        start, end, start_row = shard_byte_range(path, rank, num_machines,
+                                                 skip_header=has_header)
+        with open(path, "rb") as f:
+            f.seek(start)
+            blob = f.read(end - start)
     label_col = 0
     lc = str(params.get("label_column", ""))
     if lc.startswith("column="):
@@ -179,12 +217,8 @@ def _load_data_file_shard(path: str, params: Dict[str, Any], fmt: str,
             v = loader(path)
             if v is not None:
                 extras[name] = v[start_row:start_row + n_local]
-    # .query sidecars are query-aligned, not row-aligned; distributed
-    # ranking needs pre-partitioned per-rank files (reference behavior)
-    if load_query_file(path) is not None and "group" not in extras:
-        raise LightGBMError(
-            "distributed loading cannot row-shard a .query sidecar; "
-            "pre-partition ranking data per machine (pre_partition=true)")
+    if group_slice is not None and "group" not in extras:
+        extras["group"] = np.asarray(group_slice, np.int64)
     extras["start_row"] = start_row
     return feats, label, extras
 

@@ -309,6 +309,24 @@ class KLDivMetric(_PointwiseMetric):
         return (y * np.log(y / p) + (1.0 - y) * np.log((1.0 - y) / (1.0 - p)))
 
 
+def _compact_queries(qb, *arrays):
+    """Gather rows covered by (nq, 2) [start, size] query spans into a
+    contiguous layout and return cumulative boundaries + compacted arrays;
+    identity for 1-D cumulative boundaries. Distributed shard-padded layouts
+    have pad gaps between ranks' queries (Dataset.get_query_boundaries)."""
+    qb = np.asarray(qb, np.int64)
+    if qb.ndim != 2:
+        return (qb,) + arrays
+    starts, sizes = qb[:, 0], qb[:, 1]
+    if len(starts):
+        idx = np.concatenate([np.arange(s, s + z)
+                              for s, z in zip(starts, sizes)])
+    else:
+        idx = np.zeros(0, np.int64)
+    cum = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    return (cum,) + tuple(a[idx] for a in arrays)
+
+
 class NDCGMetric(Metric):
     """reference: rank_metric.hpp:20 + dcg_calculator.cpp."""
     name = "ndcg"
@@ -326,11 +344,12 @@ class NDCGMetric(Metric):
 
     def evaluate(self, score, convert):
         ks = self.config.eval_at or [1, 2, 3, 4, 5]
-        qb = np.asarray(self.query_boundaries, np.int64)
+        qb, s, lab = _compact_queries(self.query_boundaries,
+                                      np.asarray(score, np.float64),
+                                      self.label)
         nq = len(qb) - 1
-        s = np.asarray(score, np.float64)
         qid = np.repeat(np.arange(nq), np.diff(qb))
-        lab = self.label.astype(np.int64)
+        lab = lab.astype(np.int64)
         gain = self.label_gain[np.clip(lab, 0, len(self.label_gain) - 1)]
         # rank within query by descending score (stable)
         order = np.lexsort((-s, qid))
@@ -368,11 +387,12 @@ class MAPMetric(Metric):
 
     def evaluate(self, score, convert):
         ks = self.config.eval_at or [1, 2, 3, 4, 5]
-        qb = np.asarray(self.query_boundaries, np.int64)
+        qb, s, lab = _compact_queries(self.query_boundaries,
+                                      np.asarray(score, np.float64),
+                                      self.label)
         nq = len(qb) - 1
-        s = np.asarray(score, np.float64)
         qid = np.repeat(np.arange(nq), np.diff(qb))
-        rel = (self.label > 0).astype(np.float64)
+        rel = (lab > 0).astype(np.float64)
         order = np.lexsort((-s, qid))
         rank = np.empty(len(s), np.int64)
         rank[order] = np.arange(len(s)) - qb[qid[order]]

@@ -121,10 +121,14 @@ class GBDT:
             self._mesh_stream = (self._resolve_hist_backend() == "stream")
             if self.objective is not None:
                 # committed single-device arrays cannot enter multi-process
-                # computations; numpy rebinds as replicated values
+                # computations; numpy rebinds as replicated values (ranking
+                # binds LISTS of per-bucket arrays — convert elementwise)
                 for a in self.objective.data_bound_attrs():
                     v = getattr(self.objective, a, None)
-                    if v is not None:
+                    if isinstance(v, (list, tuple)):
+                        setattr(self.objective, a,
+                                type(v)(np.asarray(x) for x in v))
+                    elif v is not None:
                         setattr(self.objective, a, np.asarray(v))
         elif self.mesh is not None:
             # resolve the backend on the pre-shard view: the stream kernel
@@ -192,8 +196,10 @@ class GBDT:
             from ..pallas.stream_kernel import (pack_bins_T,
                                                stream_block_rows)
             packed = pack_bins_T(dd.bins,
-                                 stream_block_rows(dd.max_bins,
-                                                   dd.num_groups)).bins_T
+                                 stream_block_rows(
+                                     dd.max_bins, dd.num_groups,
+                                     self._grow_params.int_hist),
+                                 max_bins=dd.max_bins).bins_T
             if self._mesh_stream:
                 # rows were pre-padded to a whole kernel block per device, so
                 # the packed words split evenly across the row axis
@@ -618,18 +624,23 @@ class GBDT:
     # ------------------------------------------------------------------
     def add_valid(self, valid_data, name: str, metrics: Sequence[Metric]) -> None:
         if getattr(self, "_dist_mode", False):
-            raise LightGBMError(
-                "validation sets are not yet supported with "
-                "distributed-loaded training data; evaluate after training "
-                "with Booster.predict on each process's shard")
+            # rank-aligned validation data (reference:
+            # LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:307):
+            # every process holds its own shard, binned with the TRAINING
+            # mappers; scores live on the same row-sharded mesh as training
+            if getattr(valid_data, "_dist", None) is None:
+                raise LightGBMError(
+                    "validation sets for distributed-loaded training must "
+                    "be distributed-loaded too (load the valid file with "
+                    "the same multi-process loader, reference=train_set)")
         self.valid_sets.append(valid_data)
         self.valid_names.append(name)
         self.valid_metrics.append(list(metrics))
-        dd = valid_data.device_data()
+        dd = self._valid_device_data(valid_data)
         n = dd.bins.shape[0]
         k = self.num_tree_per_iteration
         shape = (n,) if k == 1 else (n, k)
-        score = jnp.zeros(shape, jnp.float32)
+        score = self._shard_row_array(jnp.zeros(shape, jnp.float32))
         if self.iter_ == 0:
             # before training the init score is tracked separately; once trees exist
             # it is folded into tree 0 (AddBias), so catch-up sums are complete
@@ -646,6 +657,38 @@ class GBDT:
         self._valid_scores.append(score)
 
     # ------------------------------------------------------------------
+    def _valid_device_data(self, vset):
+        """Device data for a validation set; distributed-loaded shards are
+        assembled into one global row-sharded array (cached) exactly like
+        the training data."""
+        if not getattr(self, "_dist_mode", False):
+            return vset.device_data()
+        cache = getattr(self, "_valid_dd_cache", None)
+        if cache is None:
+            cache = self._valid_dd_cache = {}
+        key = id(vset)
+        if key not in cache:
+            from ..parallel.dist_data import make_global_bins
+            dd = vset.device_data()
+            bins = make_global_bins(np.asarray(dd.bins), self.mesh,
+                                    self._row_axis)
+            cache[key] = dd._replace(bins=bins)
+        return cache[key]
+
+    def _score_to_host(self, score, n) -> np.ndarray:
+        """Score vector as host numpy; multi-process global arrays gather
+        their per-rank shards (rank-major row order) to every host so
+        metrics — and therefore early stopping — agree on all ranks
+        (reference: metrics Allreduce their sums, e.g. Network::GlobalSum)."""
+        if not getattr(self, "_dist_mode", False):
+            return np.asarray(score[:n])
+        from jax.experimental import multihost_utils
+        shards = sorted(score.addressable_shards,
+                        key=lambda sh: sh.index[0].start or 0)
+        local = np.concatenate([np.asarray(sh.data) for sh in shards])
+        full = multihost_utils.process_allgather(local)
+        return full.reshape((-1,) + tuple(score.shape[1:]))[:n]
+
     def _feature_mask(self) -> jax.Array:
         f = self.dd.num_features
         frac = self.config.feature_fraction
@@ -700,7 +743,11 @@ class GBDT:
             self._grad_attr_names = [
                 a for a in objective.data_bound_attrs()
                 if getattr(objective, a, None) is not None]
-            attr_names = self._grad_attr_names
+            # per-iteration state (e.g. lambdarank position biases) threads
+            # through the jit as argument + output so the trace stays pure
+            self._grad_state_names = list(objective.state_attrs())
+            attr_names = self._grad_attr_names + self._grad_state_names
+            state_names = self._grad_state_names
 
             double = self._grow_params.hist_double
 
@@ -718,6 +765,8 @@ class GBDT:
                         h = h.astype(jnp.float32)
                     else:
                         g, h = objective.get_gradients(s)
+                    new_state = {a: getattr(objective, a)
+                                 for a in state_names}
                 finally:
                     for a in attr_names:
                         setattr(objective, a, old[a])
@@ -729,28 +778,31 @@ class GBDT:
                 g, h = g * pm, h * pm
                 if quant:
                     gq, hq, sc = quantize_gh(g, h, qkey, qbins, qstoch)
-                    return g, h, gq, hq, sc
-                return g, h, g, h, None
+                    return g, h, gq, hq, sc, new_state
+                return g, h, g, h, None, new_state
 
             self._grad_fn = jax.jit(_fn)
         qkey = jax.random.PRNGKey(
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
         bound = {a: getattr(self.objective, a)
-                 for a in self._grad_attr_names}
+                 for a in self._grad_attr_names + self._grad_state_names}
         with self._grow_x64_ctx():
-            return self._grad_fn(self.score, bound, self._pad_mask, qkey)
+            out = self._grad_fn(self.score, bound, self._pad_mask, qkey)
+        for a, v in out[5].items():
+            setattr(self.objective, a, v)
+        return out[:5]
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
         Returns True if no further training is possible (all-zero trees)."""
-        # ranking objectives close over O(n) per-bucket device arrays that a
-        # fused jit would embed as HLO constants (breaking remote compilation
-        # at scale), so they keep the eager gradient path
+        # ranking per-bucket arrays and position-bias state are rebound as
+        # jit arguments (data_bound_attrs / state_attrs), so lambdarank runs
+        # the fused path too; rank_xendcg keeps the eager path (fresh host
+        # RNG draw every iteration)
         fast_path = (grad is None and hess is None
                      and self.objective is not None
                      and self.objective.jit_safe_gradients
-                     and not self.objective.is_ranking
                      and not self.sample_strategy.is_active()
                      and self._row_sharding is None)
         quant_done = False
@@ -876,7 +928,7 @@ class GBDT:
 
         # update validation scores with the new trees
         for vi, vset in enumerate(self.valid_sets):
-            dd = vset.device_data()
+            dd = self._valid_device_data(vset)
             score = self._valid_scores[vi]
             if self.config.linear_tree:
                 if vset.raw_data is None:
@@ -1094,7 +1146,7 @@ class GBDT:
         # prevent re-folding the from-average bias into future first trees
         self.init_scores = [0.0] * k
         for vi, vset in enumerate(self.valid_sets):
-            dd = vset.device_data()
+            dd = self._valid_device_data(vset)
             vs = jnp.zeros_like(self._valid_scores[vi])
             vbase = vset.get_init_score_padded(dd.bins.shape[0], k)
             if vbase is not None:
@@ -1140,7 +1192,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
         out = []
-        score = np.asarray(self._unpad_score())
+        score = self._score_to_host(self.score, self.num_data)
         conv = (self.objective.convert_output if self.objective is not None
                 else (lambda x: x))
         for m in self.train_metrics:
@@ -1154,7 +1206,7 @@ class GBDT:
                 else (lambda x: x))
         for vi, vset in enumerate(self.valid_sets):
             n = vset.num_data()
-            score = np.asarray(self._valid_scores[vi][:n])
+            score = self._score_to_host(self._valid_scores[vi], n)
             for m in self.valid_metrics[vi]:
                 for (name, val, hb) in m.evaluate(score, conv):
                     out.append((self.valid_names[vi], name, val, hb))
@@ -1176,7 +1228,7 @@ class GBDT:
                 self.score, arrays._replace(leaf_value=-arrays.leaf_value),
                 dd, kk, 1.0)
         for vi, vset in enumerate(self.valid_sets):
-            vdd = vset.device_data()
+            vdd = self._valid_device_data(vset)
             score = self._valid_scores[vi]
             for kk, tree in enumerate(dropped):
                 arrays = _tree_to_device(tree, self._grow_params.num_leaves,

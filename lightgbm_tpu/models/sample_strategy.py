@@ -49,13 +49,14 @@ class BaggingSampleStrategy(SampleStrategy):
         if self.active and label is not None and self.use_posneg:
             self._is_pos = jnp.asarray(np.asarray(label) > 0)
         if self.active and c.bagging_by_query and query_boundaries is not None:
-            nq = len(query_boundaries) - 1
-            sizes = np.diff(query_boundaries)
-            qid = np.repeat(np.arange(nq), sizes)
-            if len(qid) < num_data:
-                # grad/hess are padded to num_data rows; padded rows get the
-                # out-of-range query id nq, whose mask entry is always 0
-                qid = np.concatenate([qid, np.full(num_data - len(qid), nq)])
+            from ..ranking import query_spans
+            starts, sizes = query_spans(query_boundaries)
+            nq = len(starts)
+            # rows outside any query (padding, incl. distributed shard gaps)
+            # get the out-of-range id nq, whose mask entry is always 0
+            qid = np.full(num_data, nq, np.int64)
+            for qi in range(nq):
+                qid[starts[qi]:starts[qi] + sizes[qi]] = qi
             self._qid = jnp.asarray(qid)
             self._nq = nq
         self._mask = None

@@ -73,6 +73,12 @@ class ObjectiveFunction:
     def data_bound_attrs(self) -> Tuple[str, ...]:
         return ("label", "weight")
 
+    # names of attrs get_gradients UPDATES each iteration (e.g. lambdarank
+    # position-bias factors): the fused jit passes them in as arguments and
+    # returns the new values, keeping the traced fn functional
+    def state_attrs(self) -> Tuple[str, ...]:
+        return ()
+
 
 class RegressionL2(ObjectiveFunction):
     """reference: regression_objective.hpp:94"""

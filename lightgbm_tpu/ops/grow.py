@@ -283,10 +283,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
                                             stream_block_rows)
-        T_rows = stream_block_rows(Bmax, G)
+        T_rows = stream_block_rows(Bmax, G, params.int_hist)
         if packed is None:
             with jax.named_scope("pack_bins"):
-                bins_T = pack_bins_T(bins, T_rows).bins_T
+                bins_T = pack_bins_T(bins, T_rows, max_bins=Bmax).bins_T
         else:
             # bare array (int metadata would turn into tracers as a jit arg)
             bins_T = packed.bins_T if hasattr(packed, "bins_T") else packed

@@ -87,7 +87,8 @@ def _digits(v):
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, hist_ref, cnt_ref, *, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True,
-                       int_weights: bool = False, f32_dots: bool = False):
+                       int_weights: bool = False, f32_dots: bool = False,
+                       u8_layout: bool = False):
     b = pl.program_id(0)
     i32, f32 = jnp.int32, jnp.float32
     # interpret mode on CPU: XLA:CPU's Eigen DotThunk rejects bf16 at some
@@ -122,12 +123,23 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     slot_r1 = iv[T_SLOT_R:T_SLOT_R + 1, :]
     slot_k1 = iv[T_SLOT_KEEP:T_SLOT_KEEP + 1, :]
 
-    # select the packed word of the split feature's group, then its byte
-    words = bins_ref[...]                                    # (GW, T) i32
-    gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
-    word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
-                   keepdims=True)                            # (1, T)
-    gb = jax.lax.shift_right_logical(word, shift) & 0xFF     # group-local bin
+    # select the split feature's group-local bin for every row
+    if u8_layout:
+        # unpacked (G_pad, T) int8 storage: same HBM bytes as the packed
+        # 4-per-word form (28 B/row either way at G=28) but no per-group
+        # shift/mask unpack work in the kernel
+        bins32 = bins_ref[...].astype(i32)                   # (G_pad, T)
+        grpi = wordi * 4 + jax.lax.shift_right_logical(shift, 3)
+        gp_iota = jax.lax.broadcasted_iota(i32, bins32.shape, 0)
+        gb = jnp.sum(jnp.where(gp_iota == grpi, bins32, 0), axis=0,
+                     keepdims=True)                          # (1, T)
+    else:
+        # packed: select the word of the split feature's group, then its byte
+        words = bins_ref[...]                                # (GW, T) i32
+        gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
+        word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
+                       keepdims=True)                        # (1, T)
+        gb = jax.lax.shift_right_logical(word, shift) & 0xFF  # group-local bin
 
     # feature-local bin for EFB bundles (ops/grow.py feature_local_bin)
     ls = gb - span
@@ -172,16 +184,23 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     w2 = w_ref[0:2, :]                                       # (2, T) f32
     w_hi, w_lo = _wsplit(w2)
 
-    # unpack the 4-per-word packed group bins and build the bin-match
-    # one-hot shared by the int and float contraction paths. The one-hot is
-    # built B-MAJOR — row r = b * G + g — via key = bin * G + g tiled B
-    # times against a flat 2-D iota: measured ~40% of kernel time used to
-    # go into the (G, B, T) 3-D broadcast-compare layout this replaces.
-    rows = []
-    for g in range(G):  # static unroll
-        word_g = bins_ref[g // 4:g // 4 + 1, :]
-        rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
-    bins_G = jnp.concatenate(rows, axis=0)                   # (G, T)
+    # build the bin-match one-hot shared by the int and float contraction
+    # paths. The one-hot is built B-MAJOR — row r = b * G + g — via
+    # key = bin * G + g tiled B times against a flat 2-D iota: measured
+    # ~40% of kernel time used to go into the (G, B, T) 3-D
+    # broadcast-compare layout this replaces.
+    if u8_layout:
+        bins_G = bins32[:G, :]                               # (G, T) no unpack
+    else:
+        # unpack the 4-per-word packed group bins
+        rows = []
+        for g in range(G):  # static unroll
+            word_g = bins_ref[g // 4:g // 4 + 1, :]
+            rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
+        bins_G = jnp.concatenate(rows, axis=0)               # (G, T)
+    # (a per-bin compare-block construct — B int8 compares of (G, T)
+    # concatenated — measured 14% SLOWER than this key form: the 64-block
+    # concat relayout costs more than the (B*G, T) key/iota compare)
     g_iota = jax.lax.broadcasted_iota(i32, (G, T), 0)
     key = bins_G * G + g_iota                                # (G, T)
     key_t = jnp.concatenate([key] * B, axis=0)               # (B*G, T) tiled
@@ -287,12 +306,14 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         hist_ref[...] += dot(oh, A_hi)
 
 
-def stream_block_rows(bmax: int, num_groups: int = 28) -> int:
-    """Rows per kernel block. 2048 measures ~2% faster than 1024 on v5e when
-    the (G*B, T) bf16 one-hot operand stays within ~8 MB of VMEM; 4096
-    REGRESSES 5x (VMEM pressure kills the pipeline). Wide layouts (many EFB
-    groups, e.g. high-dimensional sparse data) step down to 512/256-row
-    blocks so the operand still fits."""
+def stream_block_rows(bmax: int, num_groups: int = 28,
+                      int_hist: bool = False) -> int:
+    """Rows per kernel block, sized so the (G*B, T) one-hot operand stays
+    within ~8 MB of VMEM: int8 one-hots (quantized-gradient path) take
+    4096-row blocks (measured ~3% faster than 2048 end to end), bf16
+    one-hots 2048 (4096 at bf16 REGRESSES 5x — VMEM pressure kills the
+    pipeline). Wide layouts (many EFB groups, e.g. high-dimensional sparse
+    data) step down to 512/256-row blocks."""
     import os
     env = os.environ.get("LGBTPU_BLOCK_ROWS")
     if env:
@@ -301,8 +322,9 @@ def stream_block_rows(bmax: int, num_groups: int = 28) -> int:
         # CPU interpret mode: keep dots narrow for XLA:CPU
         return 1024
     B = -(-bmax // 8) * 8
-    for T in (2048, 1024, 512, 256):
-        if num_groups * B * T * 2 <= 8 * 2 ** 20:
+    oh_bytes = 1 if int_hist else 2
+    for T in (4096, 2048, 1024, 512, 256):
+        if num_groups * B * T * oh_bytes <= 8 * 2 ** 20:
             return T
     return 256
 
@@ -315,12 +337,27 @@ class StreamLayout(NamedTuple):
     num_groups: int
 
 
-def pack_bins_T(bins: jax.Array, block_rows: int = 1024) -> StreamLayout:
-    """(N, G) uint8 -> transposed packed (GW_pad, N_pad) i32 layout."""
+def _use_u8_layout(max_bin_value: int = 127) -> bool:
+    """Unpacked (G_pad, N_pad) int8 bins: identical HBM bytes to the packed
+    4-per-word form, but the kernel skips all shift/mask unpack work.
+    Requires bins < 128 (int8); LGBTPU_STREAM_PACKED=1 forces the old
+    packed layout."""
+    return _os.environ.get("LGBTPU_STREAM_PACKED", "") != "1"
+
+
+def pack_bins_T(bins: jax.Array, block_rows: int = 1024,
+                max_bins: int = 256) -> StreamLayout:
+    """(N, G) uint8 -> transposed (GW_pad, N_pad) i32 packed layout, or the
+    (G_pad, N_pad) i8 unpacked layout when bins fit int8 (the kernel
+    dispatches on the dtype)."""
     n, g = bins.shape
+    n_pad = -(-n // block_rows) * block_rows
+    if max_bins <= 127 and _use_u8_layout():
+        g_pad = -(-g // 32) * 32           # i8 tiling: 32-sublane multiples
+        w = jnp.pad(bins, ((0, n_pad - n), (0, g_pad - g))).astype(jnp.int8)
+        return StreamLayout(bins_T=w.T, n_pad=n_pad, num_groups=g)
     gw = -(-g // 4)
     gw_pad = -(-gw // 8) * 8
-    n_pad = -(-n // block_rows) * block_rows
     w = jnp.pad(bins, ((0, n_pad - n), (0, gw_pad * 4 - g))).astype(jnp.int32)
     w = w.reshape(n_pad, gw_pad, 4)
     packed = (w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24))
@@ -355,12 +392,14 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         raise ValueError(f"stream kernel supports at most {MAX_SLOTS} "
                          f"histogram slots per round, got {S}")
     B = -(-bmax // 8) * 8
+    u8_layout = bins_T.dtype == jnp.int8
 
     hist_dtype = jnp.int32 if int_weights else jnp.float32
     new_leaf, hist, cnt = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
                           has_cat=has_cat, two_pass=two_pass,
-                          int_weights=int_weights, f32_dots=_interp()),
+                          int_weights=int_weights, f32_dots=_interp(),
+                          u8_layout=u8_layout),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),

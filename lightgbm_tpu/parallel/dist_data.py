@@ -60,7 +60,7 @@ def shard_pad_base() -> int:
     """Per-shard row padding: covers the stream kernel's largest block per
     local device so the assembled global array splits evenly."""
     import jax
-    return 2048 * max(jax.local_device_count(), 1)
+    return 4096 * max(jax.local_device_count(), 1)
 
 
 def pad_rows(a: Optional[np.ndarray], n_shard: int, fill=0.0

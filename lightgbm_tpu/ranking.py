@@ -27,6 +27,16 @@ def default_label_gain(max_label: int = 31) -> np.ndarray:
     return (2.0 ** np.arange(max_label + 1)) - 1.0
 
 
+def query_spans(query_boundaries) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts, sizes) from either 1-D cumulative boundaries or (nq, 2)
+    [start, size] spans (the distributed shard-padded layout, which has pad
+    gaps between ranks' queries — see Dataset.get_query_boundaries)."""
+    qb = np.asarray(query_boundaries, np.int64)
+    if qb.ndim == 2:
+        return qb[:, 0], qb[:, 1]
+    return qb[:-1], np.diff(qb)
+
+
 class _QueryBuckets(NamedTuple):
     sizes: List[int]                  # padded M per bucket
     doc_index: List[np.ndarray]       # (Qb, M) flat doc indices, -1 = pad
@@ -36,9 +46,8 @@ class _QueryBuckets(NamedTuple):
 
 def _bucketize(query_boundaries: np.ndarray, labels: np.ndarray,
                label_gain: np.ndarray, truncation_level: int) -> _QueryBuckets:
-    qb = np.asarray(query_boundaries, np.int64)
-    nq = len(qb) - 1
-    sizes = np.diff(qb)
+    starts, sizes = query_spans(query_boundaries)
+    nq = len(starts)
     max_m = int(sizes.max()) if nq else 1
     bucket_sizes: List[int] = []
     m = 8
@@ -52,7 +61,7 @@ def _bucketize(query_boundaries: np.ndarray, labels: np.ndarray,
     gains = label_gain[np.clip(labels.astype(np.int64), 0, len(label_gain) - 1)]
     disc_all = 1.0 / np.log2(np.arange(max_m) + 2.0)
     for qi in range(nq):
-        g = np.sort(gains[qb[qi]:qb[qi + 1]])[::-1][:truncation_level]
+        g = np.sort(gains[starts[qi]:starts[qi] + sizes[qi]])[::-1][:truncation_level]
         md = float(np.sum(g * disc_all[:len(g)]))
         inv_max[qi] = 1.0 / md if md > 0 else 0.0
 
@@ -64,8 +73,8 @@ def _bucketize(query_boundaries: np.ndarray, labels: np.ndarray,
             continue
         idx = np.full((len(qsel), m), -1, np.int64)
         for r, qi in enumerate(qsel):
-            s, e = qb[qi], qb[qi + 1]
-            idx[r, :e - s] = np.arange(s, e)
+            s, z = starts[qi], sizes[qi]
+            idx[r, :z] = np.arange(s, s + z)
         out_sizes.append(m)
         out_idx.append(idx)
         out_inv.append(inv_max[qsel])
@@ -170,9 +179,9 @@ class LambdarankNDCG(ObjectiveFunction):
         # score adjustment + :303 UpdatePositionBiasFactors Newton step)
         self._positions = None
         if position is not None:
-            # stateful per-iteration bias update -> not traceable in a
-            # fused-gradient jit
-            self.jit_safe_gradients = False
+            # the per-iteration Newton bias update stays traceable: pos_biases
+            # is declared in state_attrs(), so the fused gradient jit threads
+            # it in as an argument and returns the new value (GBDT._boost_padded)
             pos = np.asarray(position, np.int64).reshape(-1)
             if len(pos) != n:
                 raise LightGBMError(
@@ -184,6 +193,13 @@ class LambdarankNDCG(ObjectiveFunction):
                 np.bincount(pos, minlength=self.num_position_ids), jnp.float32)
             self._pos_reg = float(c.lambdarank_position_bias_regularization)
             self._pos_lr = float(c.learning_rate)
+
+    def data_bound_attrs(self):
+        return ("label", "weight", "_dev_idx", "_dev_valid", "_dev_inv",
+                "_dev_lab", "_dev_gain", "_positions", "_pos_counts")
+
+    def state_attrs(self):
+        return ("pos_biases",) if self._positions is not None else ()
 
     def get_gradients(self, score):
         c = self.config
@@ -202,8 +218,10 @@ class LambdarankNDCG(ObjectiveFunction):
                 trunc=int(c.lambdarank_truncation_level))
             flat_idx = jnp.where(self._dev_valid[bi].reshape(-1),
                                  idx.reshape(-1), n)
-            grad = grad.at[flat_idx].add(g.reshape(-1), mode="drop")
-            hess = hess.at[flat_idx].add(h.reshape(-1), mode="drop")
+            grad = grad.at[flat_idx].add(
+                g.reshape(-1).astype(jnp.float32), mode="drop")
+            hess = hess.at[flat_idx].add(
+                h.reshape(-1).astype(jnp.float32), mode="drop")
         grad, hess = self._apply_weight(grad, hess)
         if self._positions is not None:
             self._update_position_bias(grad, hess)

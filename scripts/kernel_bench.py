@@ -35,7 +35,7 @@ def main():
     T = stream_block_rows(Bmax, G)
     rs = np.random.RandomState(0)
     bins = rs.randint(0, Bmax, size=(rows, G)).astype(np.uint8)
-    layout = pack_bins_T(jnp.asarray(bins), T)
+    layout = pack_bins_T(jnp.asarray(bins), T, max_bins=Bmax)
     n_pad = layout.n_pad
     F = G
     routing = RoutingLayout(

@@ -31,6 +31,7 @@ def test_save_binary_roundtrip(tmp_path):
     assert b1.model_to_string() == b2.model_to_string()
 
 
+@pytest.mark.slow
 def test_efb_bundling_with_nan_matches_unbundled():
     """Sparse mutually-exclusive features bundle under EFB; predictions must
     match the unbundled run, including NaN rows (VERDICT r1 weak #8)."""

@@ -6,6 +6,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow  # heavy multi-model tier (PERF.md test tiers)
+
 BASE = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
         "min_data_in_leaf": 5}
 

@@ -26,6 +26,7 @@ def _write_train(tmp_path, n=600, seed=3, ranking=False):
     return path, X, y
 
 
+@pytest.mark.slow
 def test_cli_train_predict_roundtrip(tmp_path):
     train_csv, X, y = _write_train(tmp_path)
     model = tmp_path / "model.txt"
@@ -52,6 +53,7 @@ def test_cli_train_predict_roundtrip(tmp_path):
     assert b2.num_trees() == 2
 
 
+@pytest.mark.slow
 def test_query_weight_sidecars(tmp_path):
     rs = np.random.RandomState(5)
     n = 400
@@ -77,6 +79,7 @@ def test_query_weight_sidecars(tmp_path):
     assert bst.num_trees() == 3
 
 
+@pytest.mark.slow
 def test_position_debias_lambdarank(tmp_path):
     rs = np.random.RandomState(7)
     n = 400
@@ -109,6 +112,7 @@ def test_libsvm_qid_groups(tmp_path):
     np.testing.assert_array_equal(np.asarray(ds.get_group()), [8] * 5)
 
 
+@pytest.mark.slow
 def test_cli_refit(tmp_path):
     """task=refit refits leaf values on new data (reference:
     application.cpp:236)."""

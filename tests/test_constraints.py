@@ -180,6 +180,7 @@ def test_monotone_methods_enforce_monotonicity(method):
     _check_monotone(bst)
 
 
+@pytest.mark.slow
 def test_intermediate_fits_at_least_as_well_as_basic():
     """The intermediate method's refreshed bounds are less conservative than
     basic's frozen midpoints, so its fit should not be worse (reference:

@@ -9,6 +9,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow  # heavy multi-model tier (PERF.md test tiers)
+
 
 def _data(n=1500, seed=4):
     rs = np.random.RandomState(seed)

@@ -119,6 +119,162 @@ def test_two_process_distributed_training(tmp_path):
     _models_structurally_equal(bst.model_to_string(), dist_model)
 
 
+_CHILD_VALID = r"""
+import os, sys, json
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends; clear_backends()
+except Exception:
+    pass
+port, rank, data, vdata, out = (sys.argv[1], int(sys.argv[2]), sys.argv[3],
+                                sys.argv[4], sys.argv[5])
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=rank)
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgb_tpu_jax_cache")
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(data)
+vs = lgb.Dataset(vdata, reference=ds)
+evals = {}
+bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                 "min_data_in_leaf": 5, "tree_learner": "data",
+                 "metric": "binary_logloss"},
+                ds, num_boost_round=30, valid_sets=[vs],
+                valid_names=["valid"],
+                callbacks=[lgb.early_stopping(3, verbose=False),
+                           lgb.record_evaluation(evals)])
+if rank == 0:
+    json.dump({"best_iteration": bst.best_iteration,
+               "logloss": evals["valid"]["binary_logloss"]}, open(out, "w"))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_valid_early_stopping_matches_single(tmp_path):
+    """Rank-aligned validation under distributed loading (reference:
+    LoadFromFileAlignWithOtherDataset): early stopping must pick the same
+    best_iteration as single-process training on the full files."""
+    data = str(tmp_path / "train.csv")
+    vdata = str(tmp_path / "valid.csv")
+    _write_csv(data)
+    _write_csv(vdata, n=1200, seed=9)
+    out = str(tmp_path / "dist_es.json")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD_VALID, str(port), str(r), data, vdata,
+         out], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-4000:]}"
+    import json
+    got = json.load(open(out))
+
+    evals = {}
+    ds = lgb.Dataset(data)
+    vs = lgb.Dataset(vdata, reference=ds)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "metric": "binary_logloss"},
+                    ds, num_boost_round=30, valid_sets=[vs],
+                    valid_names=["valid"],
+                    callbacks=[lgb.early_stopping(3, verbose=False),
+                               lgb.record_evaluation(evals)])
+    assert got["best_iteration"] == bst.best_iteration
+    np.testing.assert_allclose(got["logloss"],
+                               evals["valid"]["binary_logloss"],
+                               rtol=2e-3, atol=2e-3)
+
+
+_CHILD_RANK = r"""
+import os, sys
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends; clear_backends()
+except Exception:
+    pass
+port, rank, data, out = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=rank)
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgb_tpu_jax_cache")
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(data)
+bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                 "verbosity": -1, "min_data_in_leaf": 5,
+                 "tree_learner": "data"},
+                ds, num_boost_round=5)
+assert ds.get_group() is not None
+if rank == 0:
+    open(out, "w").write(bst.model_to_string())
+"""
+
+
+def _write_ranking_csv(path, nq=120, seed=3):
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(5, 30, size=nq)
+    n = int(sizes.sum())
+    X = rng.randn(n, 5)
+    rel = X[:, 0] * 2 + X[:, 1] + 0.3 * rng.randn(n)
+    y = np.zeros(n)
+    start = 0
+    for s in sizes:
+        seg = rel[start:start + s]
+        ranks = np.argsort(np.argsort(seg))
+        y[start:start + s] = np.minimum(4, (ranks * 5) // max(s, 1))
+        start += s
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.10g")
+    np.savetxt(path + ".query", sizes, fmt="%d")
+    return sizes
+
+
+@pytest.mark.slow
+def test_two_process_lambdarank_matches_single(tmp_path):
+    """Query-boundary-respecting sharding: lambdarank under multi-process
+    tree_learner=data must reproduce single-process training."""
+    data = str(tmp_path / "rank.csv")
+    _write_ranking_csv(data)
+    out = str(tmp_path / "dist_rank_model.txt")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD_RANK, str(port), str(r), data, out],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-4000:]}"
+
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(data), num_boost_round=5)
+    _models_structurally_equal(bst.model_to_string(), open(out).read())
+
+
+def test_query_aligned_sharding_keeps_queries_whole(tmp_path):
+    p = str(tmp_path / "r.csv")
+    sizes = _write_ranking_csv(p, nq=37, seed=5)
+    parts = [load_data_file(p, {}, rank=r, num_machines=3) for r in range(3)]
+    gs = [q[2]["group"] for q in parts]
+    np.testing.assert_array_equal(np.concatenate(gs), sizes)
+    assert sum(len(q[0]) for q in parts) == int(sizes.sum())
+    for q in parts:
+        assert int(q[2]["group"].sum()) == len(q[0])
+
+
 def test_shard_loading_skips_blank_and_comment_lines(tmp_path):
     """Blank/comment lines must not shift per-row sidecar alignment."""
     p = str(tmp_path / "d.csv")

@@ -15,6 +15,8 @@ import lightgbm_tpu as lgb
 
 from conftest import make_synthetic_binary, make_synthetic_regression
 
+pytestmark = pytest.mark.slow  # heavy multi-model tier (PERF.md test tiers)
+
 needs_mesh = pytest.mark.skipif(len(jax.devices()) < 8,
                                 reason="needs 8 virtual devices")
 

@@ -39,6 +39,7 @@ def test_binary_classification():
     assert acc > 0.72, f"accuracy {acc}"
 
 
+@pytest.mark.slow
 def test_binary_auc_improves():
     X, y = make_synthetic_binary(n=4000)
     Xtr, ytr, Xte, yte = _split(X, y)
@@ -54,6 +55,7 @@ def test_binary_auc_improves():
     assert aucs[-1] > aucs[0]
 
 
+@pytest.mark.slow
 def test_multiclass():
     X, y = make_synthetic_multiclass()
     Xtr, ytr, Xte, yte = _split(X, y)
@@ -67,6 +69,7 @@ def test_multiclass():
     assert acc > 0.75, f"accuracy {acc}"
 
 
+@pytest.mark.slow
 def test_early_stopping():
     X, y = make_synthetic_regression()
     Xtr, ytr, Xte, yte = _split(X, y)
@@ -114,6 +117,7 @@ def test_goss():
     assert acc > 0.78
 
 
+@pytest.mark.slow
 def test_dart():
     X, y = make_synthetic_regression()
     train_set = lgb.Dataset(X, label=y)
@@ -134,6 +138,7 @@ def test_rf():
     assert acc > 0.78
 
 
+@pytest.mark.slow
 def test_l1_objective_renews_leaves():
     X, y = make_synthetic_regression()
     train_set = lgb.Dataset(X, label=y)

@@ -2,6 +2,7 @@
 use_quantized_grad / num_grad_quant_bins / quant_train_renew_leaf /
 stochastic_rounding config)."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -26,6 +27,7 @@ def _auc(y, p):
     return (r[y > 0.5].sum() - npos * (npos - 1) / 2) / (npos * (len(y) - npos))
 
 
+@pytest.mark.slow
 def test_quantized_close_to_fp32():
     X, y = _data()
     b_fp = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=20)
@@ -44,6 +46,7 @@ def test_quantized_renew_leaf():
     assert _auc(y, b.predict(X)) > 0.8
 
 
+@pytest.mark.slow
 def test_quantized_bins_and_rounding_params():
     X, y = _data(seed=10)
     for extra in ({"num_grad_quant_bins": 16},

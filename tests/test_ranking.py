@@ -38,6 +38,7 @@ def test_lambdarank_improves_ndcg():
     assert ndcg_trained > 0.75
 
 
+@pytest.mark.slow
 def test_rank_xendcg():
     X, y, sizes = make_synthetic_ranking(nq=120, seed=3)
     ds = lgb.Dataset(X, label=y, group=sizes)
@@ -83,6 +84,7 @@ def test_bagging_by_query():
     assert _ndcg_at(scores, y, qb) > 0.5
 
 
+@pytest.mark.slow
 def test_cv_lambdarank_group_propagation():
     X, y, sizes = make_synthetic_ranking(nq=60)
     ds = lgb.Dataset(X, label=y, group=sizes)

@@ -47,6 +47,7 @@ def test_classifier_string_labels():
     assert np.mean(pred == ys) > 0.8
 
 
+@pytest.mark.slow
 def test_eval_set_and_early_stopping():
     X, y = make_synthetic_regression(n=3000)
     rs = np.random.RandomState(5)

@@ -54,6 +54,7 @@ def test_sparse_training_and_predict_match_dense():
                                   b2.predict(Xs[:400], raw_score=True))
 
 
+@pytest.mark.slow
 def test_sparse_valid_set_and_subset():
     X, Xs, y = _make_sparse()
     tr = lgb.Dataset(Xs[:2000], label=y[:2000])

@@ -57,6 +57,7 @@ def _xla_route(bins, leaf_id, routing, leaf_chosen, leaf_feat, leaf_thr,
     return jnp.where(r_chosen & ~go_left, leaf_new[leaf_id], leaf_id), go_left
 
 
+@pytest.mark.slow
 def test_route_exact_and_hist_close():
     ds, X, y = _dataset()
     dd = ds.device_data()
@@ -195,6 +196,7 @@ def test_int8_hist_exact():
     np.testing.assert_allclose(np.asarray(slot_cnt), [float(N)], atol=1e-6)
 
 
+@pytest.mark.slow
 def test_stream_end_to_end_close():
     """Full training with the stream backend matches segsum predictions to
     bf16-accumulation tolerance."""

@@ -10,6 +10,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow  # heavy multi-model tier (PERF.md test tiers)
+
 
 def _data(n=4000, f=10, seed=13):
     rs = np.random.RandomState(seed)

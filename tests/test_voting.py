@@ -3,6 +3,7 @@
 Reference: src/treelearner/voting_parallel_tree_learner.cpp:104 (vote
 allreduce) and :396 (elected-feature histogram reduce)."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -15,6 +16,7 @@ def _data(n=6000, f=20, seed=17):
     return X, y
 
 
+@pytest.mark.slow
 def test_voting_close_to_serial():
     X, y = _data()
     params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
@@ -32,6 +34,7 @@ def test_voting_close_to_serial():
     assert mse_v < mse_s * 2.0 + 1e-3, (mse_v, mse_s)
 
 
+@pytest.mark.slow
 def test_voting_falls_back_for_categorical():
     rs = np.random.RandomState(5)
     X = rs.randn(2000, 5)
