@@ -213,15 +213,17 @@ class GBDT:
         # captured arrays are embedded in the HLO as constants, and a 10M-row
         # packed bin matrix (hundreds of MB) blows up compilation
         self._packed = packed
-        self._grow_fn = jax.jit(
-            functools.partial(grow_tree, layout=dd.layout, routing=dd.routing,
-                              params=self._grow_params,
-                              monotone=self._monotone_array(),
-                              interaction_groups=self._interaction_group_masks(),
-                              forced=self._parse_forced_splits(),
-                              cegb_coupled=self._cegb_coupled_array(),
-                              mesh=self.mesh if self._mesh_stream else None,
-                              row_axis=self._row_axis))
+        self._grow_partial = functools.partial(
+            grow_tree, layout=dd.layout, routing=dd.routing,
+            params=self._grow_params,
+            monotone=self._monotone_array(),
+            interaction_groups=self._interaction_group_masks(),
+            forced=self._parse_forced_splits(),
+            cegb_coupled=self._cegb_coupled_array(),
+            mesh=self.mesh if self._mesh_stream else None,
+            row_axis=self._row_axis)
+        self._grow_fn = jax.jit(self._grow_partial)
+        self._grow_fn_k = None
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
                            if self._grow_params.has_cegb else None)
         self._voting = False
@@ -432,6 +434,9 @@ class GBDT:
             has_monotone=self._monotone_array() is not None,
             monotone_penalty=c.monotone_penalty,
             monotone_intermediate=self._monotone_intermediate(),
+            monotone_advanced=(self._monotone_array() is not None
+                               and self.config.monotone_constraints_method
+                               == "advanced"),
             path_smooth=c.path_smooth,
             has_interaction=self._interaction_group_masks() is not None,
             extra_trees=c.extra_trees,
@@ -535,12 +540,8 @@ class GBDT:
                 f"has {F} features")
         if not np.any(arr):
             return None
-        if self.config.monotone_constraints_method == "advanced":
-            log_warning(
-                "monotone_constraints_method='advanced' (per-threshold "
-                "refinement) is not implemented; using 'intermediate'")
-        elif self.config.monotone_constraints_method not in (
-                "basic", "intermediate"):
+        if self.config.monotone_constraints_method not in (
+                "basic", "intermediate", "advanced"):
             log_warning(
                 f"monotone_constraints_method="
                 f"{self.config.monotone_constraints_method!r} is not "
@@ -792,6 +793,40 @@ class GBDT:
             setattr(self.objective, a, v)
         return out[:5]
 
+    def _grow_classes(self, grad, hess, mask, col_mask, gh_scales, k: int):
+        """Grow all K class trees inside one jitted lax.scan (one launch
+        per iteration instead of K; reference: the per-class tree loop in
+        GBDT::TrainOneIter, gbdt.cpp:412)."""
+        if self._grow_fn_k is None:
+            grow = self._grow_partial
+            needs_key = self._needs_grow_key
+
+            def _fn(bins, grad2, hess2, mask, colm, packed, scales, keys):
+                def body(_, xs):
+                    g, h, key1, sc = xs
+                    arrays, lid = grow(
+                        bins, g, h, mask, colm,
+                        key=(key1 if needs_key else None),
+                        packed=packed, cegb_used=None, gh_scales=sc)
+                    return None, (arrays, lid)
+
+                _, out = jax.lax.scan(
+                    body, None, (grad2.T, hess2.T, keys, scales))
+                return out
+
+            self._grow_fn_k = jax.jit(_fn)
+        keys = jnp.stack([
+            jax.random.PRNGKey((self.config.extra_seed or 3) * 1000003
+                               + self.iter_ * (k + 1) + kk)
+            for kk in range(k)])
+        scales = (jnp.transpose(gh_scales) if gh_scales is not None
+                  else jnp.zeros((k, 2), jnp.float32))
+        arrays_k, leaf_k = self._grow_fn_k(
+            self.dd.bins, grad, hess, mask, col_mask, self._packed,
+            scales, keys)
+        return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
+                for kk in range(k)]
+
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
@@ -841,6 +876,18 @@ class GBDT:
             if self.config.use_quantized_grad:
                 grad, hess, gh_scales = self._quantize_gh(grad, hess)
         new_arrays = []
+        # class-parallel growth as ONE compiled program: a lax.scan over the
+        # K gradient columns replaces K separate grow launches (the
+        # reference's class-parallel trees, num_tree_per_iteration_; each
+        # launch costs fixed dispatch overhead on a tunneled TPU)
+        k_results = None
+        if (k > 1 and not self.config.linear_tree
+                and self._cegb_used is None and not self._voting
+                and not (self.config.use_quantized_grad
+                         and self.config.quant_train_renew_leaf)):
+            with global_timer.scope("GBDT::TrainTree"), self._grow_x64_ctx():
+                k_results = self._grow_classes(grad, hess, mask, col_mask,
+                                               gh_scales, k)
         for kk in range(k):
             g = grad if k == 1 else grad[:, kk]
             h = hess if k == 1 else hess[:, kk]
@@ -852,11 +899,15 @@ class GBDT:
             sc = None
             if gh_scales is not None:
                 sc = gh_scales if k == 1 else gh_scales[:, kk]
-            with global_timer.scope("GBDT::TrainTree"), self._grow_x64_ctx():
-                arrays, leaf_id = self._grow_fn(
-                    self.dd.bins, g, h, mask, col_mask, key=gkey,
-                    packed=self._packed, cegb_used=self._cegb_used,
-                    gh_scales=sc)
+            if k_results is not None:
+                arrays, leaf_id = k_results[kk]
+            else:
+                with global_timer.scope("GBDT::TrainTree"), \
+                        self._grow_x64_ctx():
+                    arrays, leaf_id = self._grow_fn(
+                        self.dd.bins, g, h, mask, col_mask, key=gkey,
+                        packed=self._packed, cegb_used=self._cegb_used,
+                        gh_scales=sc)
             if self._cegb_used is not None:
                 L = self._grow_params.num_leaves
                 ni_mask = jnp.arange(L) < (arrays.num_leaves - 1)

@@ -63,6 +63,11 @@ class GrowParams(NamedTuple):
     # IntermediateLeafConstraints), instead of the basic method's frozen
     # split-midpoint bounds
     monotone_intermediate: bool = False
+    # advanced method: per-threshold constraint refinement — each leaf's
+    # output bound becomes a function of the split threshold, derived from
+    # the ACTUAL outputs of the constraining (contiguous) leaves
+    # (monotone_constraints.hpp:859 AdvancedLeafConstraints)
+    monotone_advanced: bool = False
     path_smooth: float = 0.0
     has_interaction: bool = False
     extra_trees: bool = False
@@ -122,6 +127,8 @@ class _GrowState(NamedTuple):
     rect_hi: jax.Array          # (L, F) i32
     leaf_in_mono: jax.Array     # (L,) bool — leaf under a monotone split
                                 # (IntermediateLeafConstraints::leaf_is_in_monotone_subtree_)
+    adv_vmin: jax.Array         # (L, F, Bmax) f32 — advanced-method constraint
+    adv_vmax: jax.Array         # slabs (see advanced_constraint_slabs)
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
     cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
     round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
@@ -164,6 +171,110 @@ def intermediate_monotone_bounds(anc_left, anc_right, node_mono, leaf_out,
         jnp.where(anc_right & inc, lmax[None, :], -big),
         jnp.where(anc_left & dec, rmax[None, :], -big)), axis=1)
     return lo, hi
+
+
+def advanced_constraint_slabs(anc_l, anc_r, node_mono, node_depth, node_feat,
+                              node_thr, node_num, rect_lo, rect_hi, leaf_out,
+                              bmax: int, big):
+    """Per-(leaf, feature, bin) constraint value slabs for the ADVANCED
+    monotone method (monotone_constraints.hpp:859 AdvancedLeafConstraints).
+
+    The reference recomputes, per scanned leaf P and feature f, a
+    piecewise-constant constraint over f's thresholds from the ACTUAL
+    outputs of the constraining leaves (GoUpToFindConstrainingLeaves /
+    GoDownToFindConstrainingLeaves / UpdateConstraints). Dense equivalent:
+
+      * a leaf Q constrains P through exactly ONE ancestor — their LCA
+        (Q sits in the opposite subtree of precisely that node);
+      * the walk's (feature, side) dedup gate (OppositeChildShouldBeUpdated)
+        becomes `recorded[P, lca]`, and its descent pruning
+        (ShouldKeepGoingLeftRight) becomes a rectangle-overlap check of Q
+        against every recorded plane deeper than the LCA;
+      * UpdateConstraints' threshold slices are Q's bin-space interval on f
+        (leaf hyperrectangles), and the piecewise max/min over constraining
+        leaves is a per-bin max/min.
+
+    Returns (v_min, v_max): (L, F, bmax) f32 — v_min[P, f, b] is the max
+    over min-constraining leaves whose f-interval covers bin b of their
+    output (-big where none), v_max the min over max-constraining leaves
+    (+big where none). The scan turns these into per-threshold child bounds
+    with prefix/suffix running extrema."""
+    L = anc_l.shape[0]
+    anc = anc_l | anc_r                      # (P leaves, B nodes)
+    # recorded[P, B]: numerical ancestor with no deeper same-(feat, side)
+    same_feat = node_feat[:, None] == node_feat[None, :]       # (B', B)
+    deeper = node_depth[:, None] > node_depth[None, :]         # (B', B)
+    sides_eq = anc_r[:, :, None] == anc_r[:, None, :]          # (P, B', B)
+    blocked = jnp.any(anc[:, :, None] & node_num[None, :, None]
+                      & same_feat[None] & sides_eq & deeper[None], axis=1)
+    recorded = anc & node_num[None, :] & ~blocked              # (P, B)
+
+    # LCA of every (P, Q) leaf pair
+    common = anc[:, None, :] & anc[None, :, :]                 # (P, Q, B)
+    d_masked = jnp.where(common, node_depth[None, None, :], -1)
+    lca = jnp.argmax(d_masked, axis=2)                         # (P, Q)
+    has_common = jnp.max(d_masked, axis=2) >= 0
+    lca_depth = node_depth[lca]
+    arQ = jnp.arange(L)
+    rec_at = jnp.take_along_axis(recorded, lca, axis=1)        # (P, Q)
+    mono_at = node_mono[lca]
+    sideP = jnp.take_along_axis(anc_r, lca, axis=1)            # P right of LCA
+    sideQ = anc_r[arQ[None, :], lca]                           # Q right of LCA
+    opposite = sideP != sideQ
+    # polarity: Q constrains P's MIN iff (mono>0 & P right) | (mono<0 & P left)
+    upd_min = jnp.where(mono_at > 0, sideP, ~sideP)
+    # reach: Q's rectangle must be compatible with every recorded plane of
+    # P's chain deeper than the LCA (side taken from P's path)
+    okR = rect_hi[:, node_feat] > (node_thr[None, :] + 1)      # (Q, B)
+    okL = rect_lo[:, node_feat] <= node_thr[None, :]           # (Q, B)
+    ok2 = jnp.where(anc_r[:, None, :], okR[None], okL[None])   # (P, Q, B)
+    bad = jnp.any(recorded[:, None, :]
+                  & (node_depth[None, None, :] > lca_depth[:, :, None])
+                  & ~ok2, axis=2)
+    C = has_common & rec_at & (mono_at != 0) & opposite & ~bad  # (P, Q)
+
+    # Constraint slice of Q on P's threshold axis for feature f
+    # (UpdateConstraints it_start/it_end): the intersection
+    #   [max(Plo - 1, Qlo_eff), min(Phi, Qhi_eff))
+    # where the P-side lower bound extends ONE bin below P's interval (the
+    # up-walk records a right-descent's threshold itself, not threshold+1)
+    # and Q's bound FACING the LCA's plane is dropped when the LCA splits
+    # on f — that is exactly how an across-the-plane neighbour lands on
+    # P's boundary bin and, via the prefix/suffix extrema, constrains only
+    # the adjacent child at every threshold.
+    bb = jnp.arange(bmax)
+    F_dim = rect_lo.shape[1]
+    BIGI = jnp.asarray(2 ** 30, jnp.int32)
+    f_iota = jnp.arange(F_dim)
+
+    def _slab(cmask_all, upd_sel, fill, reduce_fn):
+        def one(args):
+            crow, plo, phi, lca_row = args
+            thrA = node_thr[lca_row]                           # (Q,)
+            featA = node_feat[lca_row]
+            numA = node_num[lca_row]
+            q_right = anc_r[jnp.arange(L), lca_row]            # Q right of A
+            facing = (f_iota[None, :] == featA[:, None]) & numA[:, None]
+            qlo_eff = jnp.where(
+                facing & q_right[:, None]
+                & (rect_lo[:, :] == (thrA + 1)[:, None]),
+                -BIGI, rect_lo)
+            qhi_eff = jnp.where(
+                facing & ~q_right[:, None]
+                & (rect_hi[:, :] == (thrA + 1)[:, None]),
+                BIGI, rect_hi)
+            lo_s = jnp.maximum(plo[None, :] - 1, qlo_eff)      # (Q, F)
+            hi_s = jnp.minimum(phi[None, :], qhi_eff)
+            sel = (crow[:, None, None]
+                   & (bb[None, None, :] >= lo_s[:, :, None])
+                   & (bb[None, None, :] < hi_s[:, :, None]))   # (Q, F, bmax)
+            vals = jnp.where(sel, leaf_out[:, None, None], fill)
+            return reduce_fn(vals, axis=0)                     # (F, bmax)
+        return jax.lax.map(one, (cmask_all & upd_sel, rect_lo, rect_hi, lca))
+
+    v_min = _slab(C, upd_min, -big, jnp.max)
+    v_max = _slab(C, ~upd_min, big, jnp.min)
+    return v_min, v_max
 
 
 def feature_local_bin(group_bin: jax.Array, feat: jax.Array,
@@ -219,6 +330,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
     use_mono = params.has_monotone and monotone is not None
     use_imono = use_mono and params.monotone_intermediate
+    use_amono = use_imono and params.monotone_advanced
     use_inter = params.has_interaction and interaction_groups is not None
     use_smooth = params.path_smooth > 0.0
     use_output = use_mono or use_smooth
@@ -407,6 +519,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         rect_lo=jnp.zeros((L, F) if use_imono else (1, 1), i32),
         rect_hi=jnp.full((L, F) if use_imono else (1, 1), 2 ** 30, i32),
         leaf_in_mono=jnp.zeros(L if use_imono else 1, bool),
+        adv_vmin=jnp.full((L, F, Bmax) if use_amono else (1, 1, 1), -BIG, f32),
+        adv_vmax=jnp.full((L, F, Bmax) if use_amono else (1, 1, 1), BIG, f32),
         used_feat=used0,
         cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
         round_idx=jnp.asarray(0, i32),
@@ -659,17 +773,43 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 # order); heavy work (routing/histograms) stays batched.
                 def _one_split(i, carry):
                     (lo_v, hi_v, lov, anc_l, anc_r, nmono, ndepth,
-                     rlo, rhi, inmono, bchg) = carry
+                     rlo, rhi, inmono, bchg_min, bchg_max, avmn,
+                     avmx) = carry
                     val = pair_valid[i]
                     o = jnp.where(val, pair_old[i], L)
                     nw = jnp.where(val, pair_new[i], L)
                     nd = jnp.where(val, pair_node[i], L)
                     o_c = pair_old[i]                       # unclamped index
-                    ol_i, or_i = constrained_child_outputs(
-                        lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
-                        params.lambda_l1, params.lambda_l2,
-                        lo_v[o_c], hi_v[o_c],
-                        params.path_smooth, lov[o_c])
+                    if use_amono:
+                        # per-threshold bounds from the PRE-round slabs — the
+                        # bounds the scan used when it chose this split
+                        bbA = jnp.arange(Bmax)
+                        vmn = st.adv_vmin[o_c, feat[i]]
+                        vmx = st.adv_vmax[o_c, feat[i]]
+                        left_m = bbA <= thr[i]
+                        a_lo_l = jnp.max(jnp.where(left_m, vmn, -BIG))
+                        a_hi_l = jnp.min(jnp.where(left_m, vmx, BIG))
+                        a_lo_r = jnp.max(jnp.where(~left_m, vmn, -BIG))
+                        a_hi_r = jnp.min(jnp.where(~left_m, vmx, BIG))
+                        cat_sp = (dirf[i] & 2) != 0
+                        a_lo_l = jnp.where(cat_sp, -BIG, a_lo_l)
+                        a_hi_l = jnp.where(cat_sp, BIG, a_hi_l)
+                        a_lo_r = jnp.where(cat_sp, -BIG, a_lo_r)
+                        a_hi_r = jnp.where(cat_sp, BIG, a_hi_r)
+                        ol_i, _ = constrained_child_outputs(
+                            lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
+                            params.lambda_l1, params.lambda_l2,
+                            a_lo_l, a_hi_l, params.path_smooth, lov[o_c])
+                        _, or_i = constrained_child_outputs(
+                            lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
+                            params.lambda_l1, params.lambda_l2,
+                            a_lo_r, a_hi_r, params.path_smooth, lov[o_c])
+                    else:
+                        ol_i, or_i = constrained_child_outputs(
+                            lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
+                            params.lambda_l1, params.lambda_l2,
+                            lo_v[o_c], hi_v[o_c],
+                            params.path_smooth, lov[o_c])
                     lov = lov.at[o].set(ol_i.astype(f32), mode="drop") \
                              .at[nw].set(or_i.astype(f32), mode="drop")
                     anc_o_l = anc_l[o_c]                    # PROPER ancestors
@@ -710,7 +850,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     splittable = st.best_gain > NEG_INF / 2
 
                     def _walk(j, wc):
-                        lo_w, hi_w, bad, seen, chg = wc
+                        (lo_w, hi_w, bad, seen, chgmin, chgmax,
+                         avmn_w, avmx_w) = wc
                         d = depth_o - 1 - j
                         one = anc_o_l | anc_o_r
                         at_d = one & (ndepth == d) & \
@@ -735,8 +876,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                          jnp.maximum(lo_w, vmax), lo_w)
                         # leaves whose entry actually tightened need their
                         # best split re-found (leaves_to_update_; Update*
-                        # AndReturnBoolIfChanged semantics)
-                        chg = chg | (hi_n < hi_w) | (lo_n > lo_w)
+                        # AndReturnBoolIfChanged semantics). The advanced
+                        # entry applies the value as a whole-slab clamp AND
+                        # always reports changed ("could have been
+                        # unconstrained"), flagging a fresh lazy rebuild of
+                        # the touched SIDE (AdvancedFeatureConstraints::
+                        # UpdateMin/UpdateMax with trigger_a_recompute)
+                        if use_amono:
+                            t_min = target & ~upd_max
+                            t_max = target & upd_max
+                            chgmin = chgmin | t_min
+                            chgmax = chgmax | t_max
+                            avmn_w = jnp.where(
+                                t_min[:, None, None],
+                                jnp.maximum(avmn_w, vmax[:, None, None]),
+                                avmn_w)
+                            avmx_w = jnp.where(
+                                t_max[:, None, None],
+                                jnp.minimum(avmx_w, vmin[:, None, None]),
+                                avmx_w)
+                        else:
+                            chgmin = chgmin | (hi_n < hi_w) | (lo_n > lo_w)
                         hi_w, lo_w = hi_n, lo_n
                         # extend the reachability prune with A's plane
                         okP = jnp.where(side_r, rhi[:, Af] > At + 1,
@@ -744,12 +904,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         bad = bad | (recorded & ~okP)
                         seen = seen.at[Af, side_r.astype(i32)].set(
                             seen[Af, side_r.astype(i32)] | recorded)
-                        return lo_w, hi_w, bad, seen, chg
+                        return (lo_w, hi_w, bad, seen, chgmin, chgmax,
+                                avmn_w, avmx_w)
 
-                    lo_v, hi_v, _, _, bchg = jax.lax.fori_loop(
+                    (lo_v, hi_v, _, _, bchg_min, bchg_max, avmn,
+                     avmx) = jax.lax.fori_loop(
                         0, jnp.maximum(depth_o, 0), _walk,
                         (lo_v, hi_v, jnp.zeros(L, bool),
-                         jnp.zeros((F, 2), bool), bchg))
+                         jnp.zeros((F, 2), bool), bchg_min, bchg_max,
+                         avmn, avmx))
 
                     # ---- bookkeeping: ancestry, rectangles, node info ----
                     anc_l = anc_l.at[nw].set(anc_o_l, mode="drop")
@@ -768,21 +931,61 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                   rlo[o_c, sf]), mode="drop")
                     inmono = inmono.at[o].set(flag, mode="drop") \
                                    .at[nw].set(flag, mode="drop")
+                    if use_amono:
+                        # AdvancedConstraintEntry semantics: the right child
+                        # CLONES the left's piecewise slabs, then both get the
+                        # split's scalar clamp across all (feature, bin)
+                        # (UpdateConstraintsWithOutputs with lazy=false);
+                        # walk-touched leaves are only FLAGGED — their slabs
+                        # rebuild fresh at the next scan (lazy recompute)
+                        avmn = avmn.at[nw].set(avmn[o_c], mode="drop")
+                        avmx = avmx.at[nw].set(avmx[o_c], mode="drop")
+                        up_hi_o = g_num & (m_split > 0)
+                        up_lo_o = g_num & (m_split < 0)
+                        avmx = avmx.at[o].set(
+                            jnp.where(up_hi_o, jnp.minimum(avmx[o_c], or_i),
+                                      avmx[o_c]), mode="drop")
+                        avmn = avmn.at[o].set(
+                            jnp.where(up_lo_o, jnp.maximum(avmn[o_c], or_i),
+                                      avmn[o_c]), mode="drop")
+                        avmn = avmn.at[nw].set(
+                            jnp.where(up_hi_o, jnp.maximum(avmn[nw], ol_i),
+                                      avmn[nw]), mode="drop")
+                        avmx = avmx.at[nw].set(
+                            jnp.where(up_lo_o, jnp.minimum(avmx[nw], ol_i),
+                                      avmx[nw]), mode="drop")
                     return (lo_v, hi_v, lov, anc_l, anc_r, nmono, ndepth,
-                            rlo, rhi, inmono, bchg)
+                            rlo, rhi, inmono, bchg_min, bchg_max, avmn, avmx)
 
                 carry = jax.lax.fori_loop(
                     0, S, _one_split,
                     (st.out_lo, st.out_hi, st2.leaf_out,
                      st2.anc_left, st2.anc_right, st2.node_mono,
                      st2.node_depth, st2.rect_lo, st2.rect_hi,
-                     st2.leaf_in_mono, jnp.zeros(L, bool)))
+                     st2.leaf_in_mono, jnp.zeros(L, bool),
+                     jnp.zeros(L, bool), st.adv_vmin, st.adv_vmax))
                 st2 = st2._replace(out_lo=carry[0], out_hi=carry[1],
                                    leaf_out=carry[2], anc_left=carry[3],
                                    anc_right=carry[4], node_mono=carry[5],
                                    node_depth=carry[6], rect_lo=carry[7],
                                    rect_hi=carry[8], leaf_in_mono=carry[9])
-                imono_changed = carry[10]
+                imono_changed = carry[10] | carry[11]
+                if use_amono:
+                    # fresh slabs ONLY for walk-flagged leaves — and only the
+                    # flagged SIDE, min taking precedence (the lazy
+                    # RecomputeConstraintsIfNeeded rebuilds ONE
+                    # FeatureMinOrMaxConstraints then clears both flags);
+                    # everyone else keeps the inherited/clamped slabs
+                    v_mn, v_mx = advanced_constraint_slabs(
+                        st2.anc_left, st2.anc_right, st2.node_mono,
+                        st2.node_depth, st2.split_feature, st2.threshold_bin,
+                        (st2.dir_flags & 2) == 0, st2.rect_lo, st2.rect_hi,
+                        st2.leaf_out, Bmax, BIG)
+                    fm_min = carry[10][:, None, None]
+                    fm_max = (carry[11] & ~carry[10])[:, None, None]
+                    st2 = st2._replace(
+                        adv_vmin=jnp.where(fm_min, v_mn, carry[12]),
+                        adv_vmax=jnp.where(fm_max, v_mx, carry[13]))
             elif use_output:
                 lo_p = st.out_lo[pair_old]
                 hi_p = st.out_hi[pair_old]
@@ -863,6 +1066,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
                               st2.cnt[ids2],
                               col_mask=cmask2,
+                              adv_bounds=((st2.adv_vmin[ids2],
+                                           st2.adv_vmax[ids2])
+                                          if use_amono else None),
                               out_lo=st2.out_lo[ids2] if use_output else None,
                               out_hi=st2.out_hi[ids2] if use_output else None,
                               slot_depth=st2.depth[ids2] if use_mono else None,

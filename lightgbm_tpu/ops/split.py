@@ -105,6 +105,23 @@ def constrained_child_outputs(lg, lh, lc, rg, rh, rc, l1, l2, lo, hi,
     return ol, orr
 
 
+def adv_child_bounds(v_min, v_max, big):
+    """Per-threshold child output bounds from constraint slabs: the LEFT
+    child at threshold t spans bins [lo, t] so its bound is the running
+    extremum up to t; the RIGHT child spans (t, hi) so its bound is the
+    suffix extremum from t+1 (reference: the cumulative constraint the
+    scan applies per threshold, InitCumulativeConstraints + Update)."""
+    ax = v_min.ndim - 1
+    lo_l = jax.lax.cummax(v_min, axis=ax)
+    hi_l = jax.lax.cummin(v_max, axis=ax)
+    sfx_max = jnp.flip(jax.lax.cummax(jnp.flip(v_min, -1), axis=ax), -1)
+    sfx_min = jnp.flip(jax.lax.cummin(jnp.flip(v_max, -1), axis=ax), -1)
+    pad = [(0, 0)] * (v_min.ndim - 1) + [(0, 1)]
+    lo_r = jnp.pad(sfx_max, pad, constant_values=-big)[..., 1:]
+    hi_r = jnp.pad(sfx_min, pad, constant_values=big)[..., 1:]
+    return lo_l, hi_l, lo_r, hi_r
+
+
 def _layout_is_identity(layout: FeatureLayout, num_groups: int,
                         bmax: int) -> bool:
     """True when features map 1:1 onto groups with no EFB bundling, so the
@@ -187,6 +204,7 @@ def find_best_splits(
     parent_out: Optional[jax.Array] = None,  # (S,) parent (smoothed) outputs
     extra_key: Optional[jax.Array] = None,   # PRNG key — extra_trees random thresholds
     cegb_penalty: Optional[jax.Array] = None,  # (S, F) gain penalty (CEGB)
+    adv_bounds=None,   # (v_min, v_max) (S, F, Bmax) — advanced monotone slabs
 ) -> SplitResult:
     """Monotone constraints use the reference's "basic" method
     (monotone_constraints.hpp BasicLeafConstraints): candidate outputs are clipped
@@ -208,7 +226,14 @@ def find_best_splits(
     pg = parent_g[:, None, None]
     ph = parent_h[:, None, None]
     pc = parent_c[:, None, None]
-    use_output_gain = (monotone is not None) or (path_smooth > 0.0)
+    use_output_gain = (monotone is not None) or (path_smooth > 0.0) \
+        or (adv_bounds is not None)
+    if adv_bounds is not None:
+        # ADVANCED monotone method: per-threshold child bounds from the
+        # constraint slabs (monotone_constraints.hpp:859 — the scan's
+        # constraint varies with the threshold)
+        a_lo_l, a_hi_l, a_lo_r, a_hi_r = adv_child_bounds(
+            adv_bounds[0], adv_bounds[1], -NEG_INF)
     mono_b = monotone[None, :, None] if monotone is not None else None
     lo_b = out_lo[:, None, None] if out_lo is not None else -jnp.inf
     hi_b = out_hi[:, None, None] if out_hi is not None else jnp.inf
@@ -235,9 +260,17 @@ def find_best_splits(
     def split_gain(lg, lh, lc, rc):
         rg, rh = pg - lg, ph - lh
         if use_output_gain:
-            ol, orr = constrained_child_outputs(
-                lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
-                path_smooth, po_b)
+            if adv_bounds is not None:
+                ol, _ = constrained_child_outputs(
+                    lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
+                    a_lo_l, a_hi_l, path_smooth, po_b)
+                _, orr = constrained_child_outputs(
+                    lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
+                    a_lo_r, a_hi_r, path_smooth, po_b)
+            else:
+                ol, orr = constrained_child_outputs(
+                    lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
+                    path_smooth, po_b)
             gain = leaf_gain_given_output(lg, lh, lambda_l1, lambda_l2, ol) + \
                    leaf_gain_given_output(rg, rh, lambda_l1, lambda_l2, orr)
             if mono_b is not None:

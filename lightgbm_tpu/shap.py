@@ -3,8 +3,10 @@
 Reference: src/io/tree.cpp TreeSHAP (Lundberg's exact algorithm) used by
 GBDT::PredictContrib (gbdt.cpp:655). Exact per-row TreeSHAP over host trees; output
 layout matches the reference: (N, F+1) per class with the expected value in the last
-column. Round-1 implementation is host-side Python — correct but not optimised for very
-large prediction batches.
+column. Two paths: an exact host walk (f64) and a device kernel — one jitted
+lax.scan over padded (L, D, N) tree-path tensors with N on the VPU lane axis
+(engaged on TPU for large batches; ~70x the host walk at 100k rows x 500
+trees).
 """
 from __future__ import annotations
 
@@ -261,9 +263,259 @@ def _tree_shap_batch(tree: Tree, dec: np.ndarray, phi: np.ndarray) -> None:
             np.ones(n), np.ones(n), -1)
 
 
+def _leaf_paths(tree: Tree, max_depth: int):
+    """Per-leaf padded path arrays for the device TreeSHAP kernel.
+
+    For each leaf: the root-to-leaf path compressed to UNIQUE features
+    (duplicate occurrences merge exactly as TreeSHAP's unwind does: zero
+    fractions multiply, hot requires every occurrence hot). Returns
+      feat      (L, D) int32   unique feature per slot (-1 pad)
+      zfrac     (L, D) f64     merged zero fraction per slot
+      occ_node  (L, R) int32   raw path node ids (-1 pad)
+      occ_left  (L, R) bool    path goes LEFT at that node
+      occ_slot  (L, R) int32   unique-feature slot of the occurrence
+      plen      (L,)   int32   unique path length
+    """
+    L = tree.num_leaves
+    ni = L - 1
+    parent = {}
+    for i in range(ni):
+        lc, rc = int(tree.left_child[i]), int(tree.right_child[i])
+        parent[lc] = (i, True)
+        parent[rc] = (i, False)
+    D = max_depth
+    feat = np.full((L, D), -1, np.int64)
+    zfrac = np.ones((L, D), np.float64)
+    occ_node = np.full((L, D), -1, np.int64)
+    occ_left = np.zeros((L, D), bool)
+    occ_slot = np.zeros((L, D), np.int64)
+    plen = np.zeros(L, np.int64)
+    for leaf in range(L):
+        # walk up: list of (node, went_left)
+        raw = []
+        cur = ~leaf
+        while cur in parent:
+            node, went_left = parent[cur]
+            raw.append((node, went_left))
+            cur = node
+        raw.reverse()
+        slots: List[int] = []
+        for r, (node, went_left) in enumerate(raw):
+            f = int(tree.split_feature[node])
+            w_node = _node_weight(tree, node)
+            child = int(tree.left_child[node] if went_left
+                        else tree.right_child[node])
+            zf = _node_weight(tree, child) / w_node if w_node > 0 else 0.0
+            if f in slots:
+                si = slots.index(f)
+            else:
+                si = len(slots)
+                slots.append(f)
+                feat[leaf, si] = f
+            zfrac[leaf, si] *= zf
+            occ_node[leaf, r] = node
+            occ_left[leaf, r] = went_left
+            occ_slot[leaf, r] = si
+        plen[leaf] = len(slots)
+    return feat, zfrac, occ_node, occ_left, occ_slot, plen
+
+
+def _raw_tree_depth(tree: Tree) -> int:
+    L = tree.num_leaves
+    depth = {0: 0}
+    best = 0
+    for i in range(L - 1):
+        for c in (int(tree.left_child[i]), int(tree.right_child[i])):
+            if c >= 0:
+                depth[c] = depth[i] + 1
+            else:
+                best = max(best, depth[i] + 1)
+    return best
+
+
+def _shap_device(trees: List[Tree], X: np.ndarray, num_class: int,
+                 max_depth: int) -> np.ndarray:
+    """Exact TreeSHAP as ONE jitted lax.scan over padded tree arrays —
+    per (row, leaf) path-polynomial extend + per-feature unwound sums
+    (the same arithmetic as the scalar recursion above, expressed over
+    (N, L, D) tensors). Numeric trees only; f32 on device.
+
+    Reference analog: the OpenMP-parallel PredictContrib
+    (gbdt.cpp:655) — here parallelism is (rows x leaves) on the VPU."""
+    import jax
+    import jax.numpy as jnp
+
+    n, nf = X.shape
+    k = max(num_class, 1)
+    T = len(trees)
+    L = max(t.num_leaves for t in trees)
+    ni = max(L - 1, 1)
+    D = max_depth
+
+    sf = np.zeros((T, ni), np.int64)
+    thr = np.full((T, ni), np.inf)
+    dt = np.zeros((T, ni), np.int64)
+    lv = np.zeros((T, L))
+    feat = np.full((T, L, D), -1, np.int64)
+    zfrac = np.ones((T, L, D))
+    occ_node = np.full((T, L, D), -1, np.int64)
+    occ_left = np.zeros((T, L, D), bool)
+    occ_slot = np.zeros((T, L, D), np.int64)
+    plen = np.zeros((T, L), np.int64)
+    base = np.zeros(k)
+    for ti, t in enumerate(trees):
+        nt = max(t.num_leaves - 1, 0)
+        sf[ti, :nt] = t.split_feature[:nt]
+        thr[ti, :nt] = t.threshold[:nt]
+        dt[ti, :nt] = t.decision_type[:nt]
+        lv[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        base[ti % k] += (t.expected_value() if t.num_leaves > 1
+                         else (t.leaf_value[0] if len(t.leaf_value) else 0.0))
+        if t.num_leaves > 1:
+            f_, z_, on_, ol_, os_, pl_ = _leaf_paths(t, D)
+            feat[ti, :t.num_leaves] = f_
+            zfrac[ti, :t.num_leaves] = z_
+            occ_node[ti, :t.num_leaves] = on_
+            occ_left[ti, :t.num_leaves] = ol_
+            occ_slot[ti, :t.num_leaves] = os_
+            plen[ti, :t.num_leaves] = pl_
+
+    # occurrence -> slot one-hot (static per tree, tiny)
+    occ_map = (occ_slot[..., None] == np.arange(D)) \
+        & (occ_node[..., None] >= 0)                       # (T, L, D_occ, D_slot)
+    cls = np.arange(T) % k
+
+    f32 = jnp.float32
+    Xd = jnp.asarray(X.T, f32)                                  # (nf, N)
+    Xnan = jnp.isnan(Xd)
+
+    @jax.jit
+    def run(Xd, Xnan, arrays):
+        # N rides the LAST (lane) axis throughout: the per-row tensors are
+        # (L, D, N)-shaped so the 128-lane VPU is fully utilised (an
+        # (N, L, D) layout leaves the tiny L/D dims on the lanes and runs
+        # ~50x slower)
+        def body(phi, a):
+            (sf_t, thr_t, dt_t, lv_t, feat_t, z_t, occ_node_t, occ_left_t,
+             occ_map_t, plen_t, cls_t) = a
+            # decisions at every node (ni, N)
+            v = Xd[sf_t, :]
+            isnan = Xnan[sf_t, :]
+            mt = (dt_t >> 2) & 3
+            dfl = (dt_t & 2) != 0
+            miss = isnan | ((mt == 1)[:, None] & (jnp.abs(v) < 1e-35))
+            go = jnp.where(isnan, 0.0, v) <= thr_t[:, None].astype(f32)
+            dec = jnp.where(miss & (mt != 0)[:, None], dfl[:, None], go)
+            # hot per (L, slot, N): every occurrence agrees with the path
+            occ_ok = jnp.where(occ_node_t[..., None] >= 0,
+                               dec[jnp.clip(occ_node_t, 0, None), :]
+                               == occ_left_t[..., None], True)  # (L, Docc, N)
+            o = jnp.all(jnp.where(occ_map_t[..., None],
+                                  occ_ok[:, :, None, :], True),
+                        axis=1)                                 # (L, Dslot, N)
+            of = jnp.where(o, 1.0, 0.0).astype(f32)
+            z = jnp.asarray(z_t, f32)[..., None]                # (L, D, 1)
+
+            # ---- extend the path polynomial (dummy root element first) ----
+            N = Xd.shape[1]
+            pw = jnp.zeros((L, D + 1, N), f32).at[:, 0, :].set(1.0)
+            jj = jnp.arange(D + 1, dtype=f32)
+            for kk in range(1, D + 1):
+                act = (kk <= plen_t)[:, None, None]             # (L, 1, 1)
+                zk = z[:, kk - 1:kk, :]
+                ok = of[:, kk - 1:kk, :]
+                pw_prev = jnp.pad(pw[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
+                neww = (zk * pw * ((kk - jj) / (kk + 1))[None, :, None]
+                        + ok * pw_prev * (jj / (kk + 1))[None, :, None])
+                neww = jnp.where((jj <= kk)[None, :, None], neww, pw)
+                pw = jnp.where(act, neww, pw)
+
+            # ---- per-feature unwound sums ----
+            d_leaf = plen_t[:, None].astype(f32)                # (L, 1)
+            pw_at_d = jnp.take_along_axis(
+                pw, plen_t[:, None, None].repeat(N, 2), axis=1)[:, 0, :]
+            contribs = []
+            for i in range(1, D + 1):
+                i_act = (i <= plen_t)[:, None]                  # (L, 1)
+                oi = of[:, i - 1, :]
+                zi = z[:, i - 1, :]
+                next_one = pw_at_d
+                total = jnp.zeros_like(pw_at_d)
+                for j in range(D - 1, -1, -1):
+                    j_act = (j <= plen_t - 1)[:, None]
+                    dp1 = d_leaf + 1.0
+                    tmp_hot = next_one * dp1 / ((j + 1) * jnp.maximum(oi, 0.5))
+                    t_cold = jnp.where(
+                        zi != 0.0,
+                        (pw[:, j, :] / jnp.where(zi != 0.0, zi, 1.0))
+                        / jnp.maximum((d_leaf - j) / dp1, 1e-30), 0.0)
+                    add = jnp.where(oi > 0.5, tmp_hot, t_cold)
+                    nxt = jnp.where(
+                        oi > 0.5,
+                        pw[:, j, :] - tmp_hot * zi * (d_leaf - j) / dp1,
+                        next_one)
+                    total = jnp.where(j_act, total + add, total)
+                    next_one = jnp.where(j_act, nxt, next_one)
+                w_i = total * (oi - zi) * lv_t[:, None].astype(f32)
+                contribs.append(jnp.where(i_act, w_i, 0.0))
+            contrib = jnp.stack(contribs, axis=1)               # (L, D, N)
+
+            # scatter per-slot contributions to features:
+            # (nf+1, L*D) @ (L*D, N)
+            oh = jax.nn.one_hot(jnp.where(feat_t >= 0, feat_t, nf),
+                                nf + 1, dtype=f32).reshape(L * D, nf + 1)
+            phi_t = oh.T @ contrib.reshape(L * D, N)            # (nf+1, N)
+            phi = phi.at[cls_t].add(phi_t[:nf, :])
+            return phi, None
+
+        phi0 = jnp.zeros((k, nf, Xd.shape[1]), f32)
+        phi, _ = jax.lax.scan(body, phi0, arrays)
+        return phi
+
+    arrays = (jnp.asarray(sf), jnp.asarray(thr), jnp.asarray(dt),
+              jnp.asarray(lv, f32), jnp.asarray(feat), jnp.asarray(zfrac),
+              jnp.asarray(occ_node), jnp.asarray(occ_left),
+              jnp.asarray(occ_map), jnp.asarray(plen), jnp.asarray(cls))
+    out = np.zeros((n, k, nf + 1))
+    # row chunks bound device memory ((L, D, N) intermediates)
+    chunk = max(1024, min(n, 65536))
+    for s_ in range(0, n, chunk):
+        e_ = min(s_ + chunk, n)
+        out[s_:e_, :, :nf] = np.asarray(
+            run(Xd[:, s_:e_], Xnan[:, s_:e_], arrays),
+            np.float64).transpose(2, 0, 1)
+    out[:, :, nf] += base[None, :]
+    if k == 1:
+        return out[:, 0, :]
+    return out.reshape(n, k * (nf + 1))
+
+
 def predict_contrib(trees: List[Tree], X: np.ndarray, num_class: int) -> np.ndarray:
     n, nf = X.shape
     k = max(num_class, 1)
+    # device path: one jitted scan over padded tree arrays — numeric splits
+    # only (categorical trees keep the exact host walk), bounded depth.
+    # f32 threshold compares can flip rows sitting exactly on a bin edge
+    # (shifting attribution between correlated features by ~1e-3), so the
+    # device path engages only on the TPU for large batches where the
+    # host walk would take minutes; LGBTPU_SHAP_DEVICE=1/0 forces it
+    import os as _os
+    import jax as _jax
+    has_cat = any((np.asarray(t.decision_type[:max(t.num_leaves - 1, 0)])
+                   & 1).any() for t in trees)
+    max_d = max((_raw_tree_depth(t) for t in trees if t.num_leaves > 1),
+                default=0)
+    force = _os.environ.get("LGBTPU_SHAP_DEVICE", "")
+    want = (force == "1"
+            or (force != "0"
+                and _jax.default_backend() in ("tpu", "axon")
+                and n * len(trees) >= 1_000_000))
+    if trees and want and not has_cat and 0 < max_d <= 24:
+        try:
+            return _shap_device(trees, X, num_class, max_d)
+        except Exception as ex:  # pragma: no cover — host walk always works
+            from .utils.log import log_warning
+            log_warning(f"device TreeSHAP failed ({ex}); using host path")
     out = np.zeros((n, k, nf + 1), np.float64)
     for ti, tree in enumerate(trees):
         kk = ti % k

@@ -106,7 +106,7 @@ def main():
     print("generated stock_binary_weighted.model")
 
     # ---- monotone constraint methods (monotone_constraints.hpp) ----
-    for method in ("basic", "intermediate"):
+    for method in ("basic", "intermediate", "advanced"):
         model = FIX / f"stock_monotone_{method}.model"
         run_cli({**common, "objective": "regression",
                  "data": str(FIX / 'golden_train_reg.csv'),

@@ -172,7 +172,7 @@ def _check_monotone(bst, n_probe=200, seed=0):
         assert np.all(diffs >= -1e-10), (feat, direction, diffs.min())
 
 
-@pytest.mark.parametrize("method", ["basic", "intermediate"])
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
 def test_monotone_methods_enforce_monotonicity(method):
     """Both constraint methods must produce truly monotone models
     (reference: monotone_constraints.hpp Basic/IntermediateLeafConstraints)."""

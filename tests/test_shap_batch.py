@@ -39,3 +39,31 @@ def test_shap_additivity():
     contrib = bst.predict(X[:100], pred_contrib=True)
     raw = bst.predict(X[:100], raw_score=True)
     np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-8)
+
+
+def test_device_shap_matches_host_walk():
+    """The jitted device TreeSHAP must reproduce the exact host walk
+    (f32 tolerance; off-boundary test rows)."""
+    import os
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import shap as shap_mod
+    rs = np.random.RandomState(3)
+    X = rs.randn(800, 8)
+    y = X[:, 0] + np.sin(2 * X[:, 1]) + 0.1 * rs.randn(800)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    Xt = rs.randn(300, 8)
+    os.environ["LGBTPU_SHAP_DEVICE"] = "1"
+    try:
+        dev = bst.predict(Xt, pred_contrib=True)
+    finally:
+        os.environ["LGBTPU_SHAP_DEVICE"] = "0"
+        host = bst.predict(Xt, pred_contrib=True)
+        del os.environ["LGBTPU_SHAP_DEVICE"]
+    np.testing.assert_allclose(dev, host, rtol=2e-4, atol=2e-5)
+    # additivity: contributions sum to the raw prediction
+    pred = np.asarray(bst.predict(Xt, raw_score=True))
+    np.testing.assert_allclose(np.asarray(dev).sum(axis=1), pred,
+                               rtol=1e-4, atol=1e-4)
