@@ -7,7 +7,7 @@ with common LightGBM user code:
     import lightgbm_tpu as lgb
     bst = lgb.train(params, lgb.Dataset(X, label=y))
 """
-from .basic import Booster, Dataset
+from .basic import Sequence, Booster, Dataset
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .engine import CVBooster, cv, train
 from .utils.log import LightGBMError, register_logger

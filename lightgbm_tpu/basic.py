@@ -8,6 +8,7 @@ code ports by changing the import.
 """
 from __future__ import annotations
 
+import abc
 import copy
 import os
 from pathlib import Path
@@ -94,6 +95,29 @@ def _is_scipy_sparse(data) -> bool:
         return False
 
 
+class Sequence(abc.ABC):
+    """Generic batched random-access data interface for STREAMING dataset
+    construction (reference: python-package basic.py:841 Sequence +
+    LGBM_DatasetCreateFromSampledColumn / DatasetPushRows, c_api.h).
+
+    Subclasses implement `__len__` and `__getitem__` (int -> (F,) row,
+    slice -> (n, F) block). Construction makes two passes: random-access
+    row sampling finds the bin mappers, then batches of `batch_size` rows
+    stream through binning into the uint8 bin matrix — the float64 feature
+    matrix is NEVER materialized (8x less peak memory than dense ingest).
+    """
+
+    batch_size = 4096
+
+    @abc.abstractmethod
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
 class Dataset:
     """Training/validation dataset with lazy binning (reference: basic.py:1692)."""
 
@@ -110,6 +134,8 @@ class Dataset:
         self._categorical_feature_arg = categorical_feature
         self._predictor = None
         self._dist = None
+        self.raw_seq = None
+        self.raw_arrow = None
 
         if isinstance(data, (str, Path)) and self._is_binary_file(data):
             if reference is not None:
@@ -169,7 +195,50 @@ class Dataset:
             if position is None:
                 position = extras.get("position")
         self.raw_sparse = None
-        if _is_scipy_sparse(data):
+        self.raw_seq = None
+        self.raw_arrow = None
+        if type(data).__module__.startswith("pyarrow"):
+            import pyarrow as pa
+            if isinstance(data, pa.RecordBatch):
+                data = pa.Table.from_batches([data])
+            if isinstance(data, pa.Table):
+                # columnar ingestion: each column bins straight from the
+                # Arrow buffers (zero-copy numpy views where the chunk
+                # layout allows) — the (N, F) float64 matrix is never
+                # materialized (reference: include/LightGBM/arrow.h
+                # chunked-array C-stream ingestion)
+                self.raw_arrow = data
+                self.raw_data = None
+                self._pandas_names = [str(c) for c in data.column_names]
+                pandas_cat = []
+                self._pandas_cat_idx = []
+                self.num_data_ = int(data.num_rows)
+                self.num_feature_ = int(data.num_columns)
+                self.label = (None if label is None
+                              else np.asarray(label, np.float64).reshape(-1))
+                self.weight = (None if weight is None
+                               else np.asarray(weight, np.float64).reshape(-1))
+                self.init_score = (None if init_score is None
+                                   else np.asarray(init_score, np.float64))
+                self.position = (None if position is None else
+                                 np.asarray(position, np.int32).reshape(-1))
+                self.group = (None if group is None else
+                              np.asarray(group, np.int64).reshape(-1))
+                self.binned = None
+                self._device = None
+                self._resolved_feature_names = None
+                return
+        if isinstance(data, Sequence) or (
+                isinstance(data, (list, tuple)) and data
+                and all(isinstance(c, Sequence) for c in data)):
+            seqs = [data] if isinstance(data, Sequence) else list(data)
+            self.raw_seq = seqs
+            self.raw_data = None
+            self._pandas_names, pandas_cat = None, []
+            self.num_data_ = int(sum(len(q) for q in seqs))
+            first = np.asarray(seqs[0][0], np.float64).reshape(-1)
+            self.num_feature_ = int(first.shape[0])
+        elif _is_scipy_sparse(data):
             # CSR/CSC kept sparse end-to-end: bin mappers from sampled
             # non-zeros + implicit-zero counts, EFB from CSC structure,
             # binned matrix scattered in O(nnz) — the dense X is never
@@ -314,6 +383,10 @@ class Dataset:
         cfg = Config.from_params(self.params)
         if self._dist is not None:
             return self._construct_distributed(cfg)
+        if self.raw_seq is not None:
+            return self._construct_streaming(cfg)
+        if self.raw_arrow is not None:
+            return self._construct_arrow(cfg)
         sparse = self.raw_sparse is not None
         if self.reference is not None:
             ref = self.reference.construct()
@@ -377,6 +450,114 @@ class Dataset:
         if self.free_raw_data:
             self.raw_data = None
             self.raw_sparse = None
+        return self
+
+    def _arrow_col(self, f: int) -> np.ndarray:
+        col = self.raw_arrow.column(f)
+        try:
+            return col.to_numpy(zero_copy_only=True)
+        except Exception:
+            # chunked / nullable columns fall back to one column-sized copy
+            return np.asarray(col.to_numpy(zero_copy_only=False), np.float64)
+
+    def _construct_arrow(self, cfg) -> "Dataset":
+        """Columnar construction from a pyarrow Table: sampling, bin-mapper
+        search, EFB grouping and binning all read one column at a time from
+        the Arrow buffers (reference: arrow.h ArrowChunkedArray ingestion —
+        the dense matrix is never built)."""
+        from .binning import (BinMapper, construct_binned_columns,
+                              load_forced_bins)
+        n, F = self.num_data_, self.num_feature_
+        cats = set(self._resolve_categorical())
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_n = min(n, cfg.bin_construct_sample_cnt)
+        idx = (np.arange(n) if n <= sample_n
+               else np.sort(rng.choice(n, sample_n, replace=False)))
+        forced = load_forced_bins(cfg.forcedbins_filename, F,
+                                  sorted(cats)) or [None] * F
+        mbf = cfg.max_bin_by_feature
+        mappers = []
+        samples = []
+        for f in range(F):
+            col = np.asarray(self._arrow_col(f), np.float64)
+            sc = col[idx]
+            samples.append(sc)
+            mb = cfg.max_bin if mbf is None else int(mbf[f])
+            if f in cats:
+                mappers.append(BinMapper.find_categorical(
+                    sc, mb, cfg.min_data_in_bin, cfg.use_missing))
+            else:
+                mappers.append(BinMapper.find_numerical(
+                    sc, mb, cfg.min_data_in_bin, cfg.use_missing,
+                    cfg.zero_as_missing, forced_bounds=forced[f]))
+        groups = None
+        if cfg.enable_bundle:
+            sample_bins = [mappers[f].transform(samples[f]) for f in range(F)]
+            groups = find_feature_groups(sample_bins, mappers,
+                                         enable_bundle=True)
+        del samples
+        self.binned = construct_binned_columns(
+            lambda f: np.asarray(self._arrow_col(f), np.float64), n, F,
+            mappers, groups)
+        if self.free_raw_data:
+            self.raw_arrow = None
+        return self
+
+    def _construct_streaming(self, cfg) -> "Dataset":
+        """Two-pass streaming construction from Sequence sources: sampled
+        random access finds bin mappers + EFB groups, then rows stream
+        through binning batch by batch into the uint8 matrix (reference:
+        two-round sampling + push-rows, dataset_loader.cpp:258 /
+        DatasetPushRows)."""
+        from dataclasses import replace
+        from .binning import load_forced_bins
+        seqs = self.raw_seq
+        n = self.num_data_
+        cats = self._resolve_categorical()
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_n = min(n, cfg.bin_construct_sample_cnt)
+        idx = (np.arange(n) if n <= sample_n
+               else np.sort(rng.choice(n, sample_n, replace=False)))
+        # map global indices to (sequence, local) and fetch via slices of
+        # contiguous runs (reference Sequence contract: int + slice access)
+        bounds = np.concatenate([[0], np.cumsum([len(q) for q in seqs])])
+        sample = np.empty((len(idx), self.num_feature_), np.float64)
+        pos = 0
+        for qi, q in enumerate(seqs):
+            loc = idx[(idx >= bounds[qi]) & (idx < bounds[qi + 1])] - bounds[qi]
+            for i in loc:
+                sample[pos] = np.asarray(q[int(i)], np.float64).reshape(-1)
+                pos += 1
+        mappers = find_bin_mappers(
+            sample, max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+            categorical_features=cats, use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing, sample_cnt=len(sample) + 1,
+            seed=cfg.data_random_seed,
+            max_bin_by_feature=cfg.max_bin_by_feature,
+            forced_bins=load_forced_bins(cfg.forcedbins_filename,
+                                         self.num_feature_, cats))
+        groups = None
+        if cfg.enable_bundle:
+            sample_bins = [mappers[f].transform(sample[:, f])
+                           for f in range(self.num_feature_)]
+            groups = find_feature_groups(sample_bins, mappers,
+                                         enable_bundle=True)
+        # stream batches through binning into the final uint8 matrix
+        proto = construct_binned(sample[:1], mappers, groups)
+        bins = np.empty((n, proto.bins.shape[1]), proto.bins.dtype)
+        row = 0
+        for q in seqs:
+            bs = max(int(getattr(q, "batch_size", 4096) or 4096), 1)
+            for s_ in range(0, len(q), bs):
+                chunk = np.asarray(q[s_:min(s_ + bs, len(q))], np.float64)
+                if chunk.ndim == 1:
+                    chunk = chunk.reshape(1, -1)
+                bins[row:row + len(chunk)] = construct_binned(
+                    chunk, mappers, groups).bins
+                row += len(chunk)
+        self.binned = replace(proto, bins=bins, num_data=n)
+        if self.free_raw_data:
+            self.raw_seq = None
         return self
 
     def _construct_distributed(self, cfg) -> "Dataset":
@@ -997,6 +1178,8 @@ class Booster:
             ni = max(t.num_leaves - 1, 0)
             if ni and (np.asarray(t.decision_type[:ni]) & 1).any():
                 return None    # categorical splits: host path
+            if ni and ((np.asarray(t.decision_type[:ni]) >> 2) & 3 == 1).any():
+                return None    # zero-as-missing default routing: host path
         from .binning import construct_binned
         from .pallas.predict_kernel import (build_predict_tables,
                                             predict_stream, tree_max_depth)

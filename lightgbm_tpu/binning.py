@@ -613,7 +613,18 @@ def _group_layout(groups: List[List[int]], bin_mappers: List[BinMapper],
 def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
                      groups: Optional[List[List[int]]] = None) -> BinnedData:
     """Bin a raw (N, F) float matrix into the dense group-bin layout."""
-    n, num_features = data.shape
+    return construct_binned_columns(lambda f: data[:, f], data.shape[0],
+                                    data.shape[1], bin_mappers, groups)
+
+
+def construct_binned_columns(get_col, n: int, num_features: int,
+                             bin_mappers: List[BinMapper],
+                             groups: Optional[List[List[int]]] = None
+                             ) -> BinnedData:
+    """Column-accessor variant of construct_binned: `get_col(f)` yields one
+    feature column at a time, so columnar sources (Arrow tables) bin without
+    ever materializing the (N, F) float64 matrix (reference: the zero-copy
+    Arrow chunked-array ingestion, include/LightGBM/arrow.h)."""
     assert len(bin_mappers) == num_features
     if groups is None:
         groups = [[f] for f in range(num_features)]
@@ -625,7 +636,7 @@ def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
     for gi, g in enumerate(groups):
         if len(g) == 1:
             f = g[0]
-            b = bin_mappers[f].transform(data[:, f])
+            b = bin_mappers[f].transform(get_col(f))
             bins[:, gi] = b.astype(dtype)
             feature_offsets[f] = group_offsets[gi]
         else:
@@ -633,7 +644,7 @@ def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
             col = np.zeros(n, dtype=np.int64)
             for f in g:
                 m = bin_mappers[f]
-                b = m.transform(data[:, f]).astype(np.int64)
+                b = m.transform(get_col(f)).astype(np.int64)
                 nondef = b != m.default_bin
                 # shift: feature-local non-default bins map to
                 # [in_group, in_group + num_bins - 1); default stays 0 in the bundle

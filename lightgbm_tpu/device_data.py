@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .binning import BIN_CATEGORICAL, MISSING_NONE, BinnedData
+from .binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                      MISSING_ZERO, BinnedData)
 from .ops.grow import RoutingLayout
 from .ops.split import FeatureLayout
 
@@ -44,6 +45,7 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
     span_start = np.zeros(F, np.int32)
     default_bin = np.zeros(F, np.int32)
     bundled = np.zeros(F, bool)
+    mzero_bin = np.full(F, -1, np.int32)
 
     for gi, feats in enumerate(binned.group_features):
         base = gi * Bmax
@@ -58,8 +60,13 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
             default_bin[f] = m.default_bin
             if m.bin_type == BIN_CATEGORICAL:
                 is_cat[f] = True
-            elif m.missing_type != MISSING_NONE:
+            elif m.missing_type == MISSING_NAN:
                 nan_bin[f] = nb - 1
+            elif m.missing_type == MISSING_ZERO:
+                # zeros are the missing value (zero_as_missing): they live
+                # in the default bin and follow the split's default
+                # direction (reference: MissingType::Zero, bin.h:28)
+                mzero_bin[f] = m.default_bin
         else:
             in_group = 1
             for f in feats:
@@ -79,8 +86,10 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
                 bundled[f] = True
                 if m.bin_type == BIN_CATEGORICAL:
                     is_cat[f] = True
-                elif m.missing_type != MISSING_NONE:
+                elif m.missing_type == MISSING_NAN:
                     nan_bin[f] = nb - 1
+                elif m.missing_type == MISSING_ZERO:
+                    mzero_bin[f] = d
                 in_group += nb - 1
 
     layout = FeatureLayout(
@@ -90,6 +99,7 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
         nan_bin=jnp.asarray(nan_bin),
         is_cat=jnp.asarray(is_cat),
         num_bins=jnp.asarray(num_bins),
+        mzero_bin=jnp.asarray(mzero_bin),
     )
     routing = RoutingLayout(
         feat_group=jnp.asarray(feat_group),
@@ -98,6 +108,7 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
         bundled=jnp.asarray(bundled),
         nan_bin=jnp.asarray(nan_bin),
         num_bins=jnp.asarray(num_bins),
+        mzero_bin=jnp.asarray(mzero_bin),
     )
     return layout, routing, Bmax
 

@@ -224,46 +224,43 @@ class GBDT:
             row_axis=self._row_axis)
         self._grow_fn = jax.jit(self._grow_partial)
         self._grow_fn_k = None
+        self._iter_fn = None
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
                            if self._grow_params.has_cegb else None)
         self._voting = False
         if config.tree_learner == "voting" and self.mesh is not None:
             from ..parallel.voting import (grow_tree_voting,
-                                           make_voting_splitter,
-                                           voting_supported)
-            if voting_supported(dd.layout, dd.routing) and \
-                    not self._grow_params.has_categorical:
-                gp = self._grow_params
-                if (gp.has_monotone or gp.has_interaction or gp.has_cegb
-                        or gp.extra_trees or gp.bynode_fraction < 1.0
-                        or gp.path_smooth > 0.0
-                        or self._parse_forced_splits() is not None):
-                    raise LightGBMError(
-                        "tree_learner=voting does not support monotone/"
-                        "interaction constraints, forced splits, path "
-                        "smoothing, extra_trees, feature_fraction_bynode, or "
-                        "cegb_*; remove those parameters or use "
-                        "tree_learner=data")
-                if config.top_k <= 0:
-                    raise LightGBMError(
-                        f"top_k should be greater than 0, got {config.top_k}")
-                S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
-                sp_root = make_voting_splitter(self.mesh, 1, dd.max_bins,
-                                               config.top_k, config)
-                sp = make_voting_splitter(self.mesh, 2 * S, dd.max_bins,
-                                          config.top_k, config)
+                                           make_voting_splitter)
+            gp = self._grow_params
+            if (gp.has_monotone or gp.has_interaction or gp.has_cegb
+                    or gp.extra_trees or gp.bynode_fraction < 1.0
+                    or gp.path_smooth > 0.0
+                    or self._parse_forced_splits() is not None):
+                raise LightGBMError(
+                    "tree_learner=voting does not support monotone/"
+                    "interaction constraints, forced splits, path "
+                    "smoothing, extra_trees, feature_fraction_bynode, or "
+                    "cegb_*; remove those parameters or use "
+                    "tree_learner=data")
+            if config.top_k <= 0:
+                raise LightGBMError(
+                    f"top_k should be greater than 0, got {config.top_k}")
+            S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+            sp_root = make_voting_splitter(self.mesh, 1, dd.max_bins,
+                                           config.top_k, config,
+                                           layout=dd.layout)
+            sp = make_voting_splitter(self.mesh, 2 * S, dd.max_bins,
+                                      config.top_k, config,
+                                      layout=dd.layout)
+            routing = dd.routing
 
-                def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
-                             cegb_used=None, gh_scales=None):
-                    return grow_tree_voting(bins, g, h, mask, colm,
-                                            sp_root, sp, gp)
+            def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
+                         cegb_used=None, gh_scales=None):
+                return grow_tree_voting(bins, g, h, mask, colm,
+                                        sp_root, sp, gp, routing)
 
-                self._grow_fn = jax.jit(_vote_fn)
-                self._voting = True
-            else:
-                log_warning(
-                    "tree_learner=voting requires a numeric, unbundled, "
-                    "NaN-free layout; falling back to data-parallel")
+            self._grow_fn = jax.jit(_vote_fn)
+            self._voting = True
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
         self._finished_check_every = (
@@ -729,6 +726,55 @@ class GBDT:
         pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, pad)
 
+    def _ensure_grad_meta(self):
+        if getattr(self, "_grad_attr_names", None) is None:
+            objective = self.objective
+            self._grad_attr_names = [
+                a for a in objective.data_bound_attrs()
+                if getattr(objective, a, None) is not None]
+            # per-iteration state (e.g. lambdarank position biases) threads
+            # through the jit as argument + output so the trace stays pure
+            self._grad_state_names = list(objective.state_attrs())
+
+    def _gradient_graph(self, score, bound, pad_mask, qkey):
+        """Traced gradient chain shared by the fused-gradient and
+        fused-iteration jits: rebinds the objective's captured arrays from
+        `bound`, evaluates gradients (in double under hist_precision=double
+        — the reference's score_t arithmetic), pads/masks, optionally
+        quantizes. Returns (g, h, gq, hq, scales_or_None, new_state)."""
+        objective, num_data = self.objective, self.num_data
+        quant = self.config.use_quantized_grad
+        qbins = self.config.num_grad_quant_bins
+        qstoch = self.config.stochastic_rounding
+        double = self._grow_params.hist_double
+        attr_names = self._grad_attr_names + self._grad_state_names
+        state_names = self._grad_state_names
+        old = {a: getattr(objective, a) for a in attr_names}
+        for a in attr_names:
+            setattr(objective, a, bound[a])
+        try:
+            s = score[:num_data]
+            if double:
+                g, h = objective.get_gradients(s.astype(jnp.float64))
+                g = g.astype(jnp.float32)
+                h = h.astype(jnp.float32)
+            else:
+                g, h = objective.get_gradients(s)
+            new_state = {a: getattr(objective, a) for a in state_names}
+        finally:
+            for a in attr_names:
+                setattr(objective, a, old[a])
+        n = score.shape[0]
+        if n != num_data:
+            pad = [(0, n - num_data)] + [(0, 0)] * (g.ndim - 1)
+            g, h = jnp.pad(g, pad), jnp.pad(h, pad)
+        pm = pad_mask if g.ndim == 1 else pad_mask[:, None]
+        g, h = g * pm, h * pm
+        if quant:
+            gq, hq, sc = quantize_gh(g, h, qkey, qbins, qstoch)
+            return g, h, gq, hq, sc, new_state
+        return g, h, g, h, None, new_state
+
     def _boost_padded(self):
         """Gradients + pad masking as ONE compiled program. Eagerly, the
         ~10-op gradient chain costs one runtime launch each (~0.5 ms fixed
@@ -737,50 +783,10 @@ class GBDT:
         during tracing (closure-captured device arrays embed as HLO
         constants, which breaks remote compilation at 10M rows)."""
         if self._grad_fn is None:
-            objective, num_data = self.objective, self.num_data
-            quant = self.config.use_quantized_grad
-            qbins = self.config.num_grad_quant_bins
-            qstoch = self.config.stochastic_rounding
-            self._grad_attr_names = [
-                a for a in objective.data_bound_attrs()
-                if getattr(objective, a, None) is not None]
-            # per-iteration state (e.g. lambdarank position biases) threads
-            # through the jit as argument + output so the trace stays pure
-            self._grad_state_names = list(objective.state_attrs())
-            attr_names = self._grad_attr_names + self._grad_state_names
-            state_names = self._grad_state_names
-
-            double = self._grow_params.hist_double
+            self._ensure_grad_meta()
 
             def _fn(score, bound, pad_mask, qkey):
-                old = {a: getattr(objective, a) for a in attr_names}
-                for a in attr_names:
-                    setattr(objective, a, bound[a])
-                try:
-                    s = score[:num_data]
-                    if double:
-                        # reference arithmetic: gradients evaluated in double,
-                        # stored as score_t=float32 (objective_function.h)
-                        g, h = objective.get_gradients(s.astype(jnp.float64))
-                        g = g.astype(jnp.float32)
-                        h = h.astype(jnp.float32)
-                    else:
-                        g, h = objective.get_gradients(s)
-                    new_state = {a: getattr(objective, a)
-                                 for a in state_names}
-                finally:
-                    for a in attr_names:
-                        setattr(objective, a, old[a])
-                n = score.shape[0]
-                if n != num_data:
-                    pad = [(0, n - num_data)] + [(0, 0)] * (g.ndim - 1)
-                    g, h = jnp.pad(g, pad), jnp.pad(h, pad)
-                pm = pad_mask if g.ndim == 1 else pad_mask[:, None]
-                g, h = g * pm, h * pm
-                if quant:
-                    gq, hq, sc = quantize_gh(g, h, qkey, qbins, qstoch)
-                    return g, h, gq, hq, sc, new_state
-                return g, h, g, h, None, new_state
+                return self._gradient_graph(score, bound, pad_mask, qkey)
 
             self._grad_fn = jax.jit(_fn)
         qkey = jax.random.PRNGKey(
@@ -827,6 +833,73 @@ class GBDT:
         return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
                 for kk in range(k)]
 
+    def _can_fuse_iteration(self) -> bool:
+        """Whole-iteration fusion (gradients -> grow -> score update as ONE
+        launch): k=1, no host-synced leaf work, no per-tree feature-usage
+        carry."""
+        c = self.config
+        # TPU only: the win is launch count (~3x fewer dispatches through
+        # the tunnel); on CPU the wider fused program lets XLA re-fuse the
+        # gradient chain with last-ulp differences, which would break the
+        # serial-vs-distributed byte-identical property the tests assert.
+        # LGBTPU_FUSE_ITER=1/0 forces the choice (tests, experiments)
+        import os as _os
+        force = _os.environ.get("LGBTPU_FUSE_ITER", "")
+        if force == "0":
+            return False
+        return ((force == "1" or jax.default_backend() in ("tpu", "axon"))
+                and self.num_tree_per_iteration == 1
+                and not c.linear_tree
+                and not self._voting
+                and self._cegb_used is None
+                and self.objective is not None
+                and not self.objective.need_renew_leaf
+                and not (c.use_quantized_grad and c.quant_train_renew_leaf))
+
+    def _iter_fused(self):
+        """gradients + tree growth + train-score update as ONE compiled
+        program — each separate launch costs fixed dispatch latency on a
+        tunneled TPU, and the fast path needs only one."""
+        if self._iter_fn is None:
+            self._ensure_grad_meta()
+            grow = self._grow_partial
+            gather = None
+            if self._use_leaf_gather_kernel:
+                from ..pallas.stream_kernel import leaf_gather
+                gather = leaf_gather
+
+            def _fn(score, bound, pad_mask, qkey, bins, colm, packed, rate,
+                    gkey):
+                g, h, gq, hq, sc, new_state = self._gradient_graph(
+                    score, bound, pad_mask, qkey)
+                arrays, leaf_id = grow(bins, gq, hq, pad_mask, colm,
+                                       key=gkey, packed=packed,
+                                       cegb_used=None, gh_scales=sc)
+                lv = arrays.leaf_value * rate
+                if gather is not None:
+                    delta = gather(leaf_id, lv)
+                else:
+                    delta = lv[leaf_id]
+                return score + delta, arrays, leaf_id, new_state
+
+            self._iter_fn = jax.jit(_fn)
+        qkey = jax.random.PRNGKey(
+            (self.config.data_random_seed + 11) * 131071 + self.iter_)
+        gkey = None
+        if self._needs_grow_key:
+            gkey = jax.random.PRNGKey(
+                (self.config.extra_seed or 3) * 1000003 + self.iter_ * 2)
+        bound = {a: getattr(self.objective, a)
+                 for a in self._grad_attr_names + self._grad_state_names}
+        with self._grow_x64_ctx():
+            new_score, arrays, leaf_id, new_state = self._iter_fn(
+                self.score, bound, self._pad_mask, qkey, self.dd.bins,
+                self._feature_mask(), self._packed,
+                jnp.float32(self._shrinkage_rate()), gkey)
+        for a, v in new_state.items():
+            setattr(self.objective, a, v)
+        return new_score, arrays, leaf_id
+
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
@@ -840,6 +913,29 @@ class GBDT:
                      and self.objective.jit_safe_gradients
                      and not self.sample_strategy.is_active()
                      and self._row_sharding is None)
+        if fast_path and self._can_fuse_iteration():
+            with global_timer.scope("GBDT::FusedIter"):
+                new_score, arrays, leaf_id = self._iter_fused()
+            bias = 0.0
+            if (self.iter_ == 0 or self._average_output) and \
+                    self.init_scores[0] != 0.0:
+                bias = self.init_scores[0]
+            self.score = new_score
+            self._lazy_trees.append({"arrays": arrays,
+                                     "rate": self._shrinkage_rate(),
+                                     "bias": bias})
+            for vi, vset in enumerate(self.valid_sets):
+                vdd = self._valid_device_data(vset)
+                self._valid_scores[vi] = self._add_tree_arrays_to_score(
+                    self._valid_scores[vi], arrays, vdd, 0,
+                    self._shrinkage_rate())
+            self._finished_dev = arrays.num_leaves <= 1
+            self.iter_ += 1
+            if self.iter_ % self._finished_check_every == 0:
+                if bool(self._finished_dev):
+                    self._trim_trailing_trivial()
+                    return True
+            return False
         quant_done = False
         if fast_path:
             # no bagging: the in-bag mask IS the pad mask, and the gradient

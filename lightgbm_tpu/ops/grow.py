@@ -93,6 +93,7 @@ class RoutingLayout(NamedTuple):
     bundled: jax.Array          # (F,) bool — True if in a multi-feature bundle
     nan_bin: jax.Array          # (F,) i32 — feature-local NaN bin, -1 if none
     num_bins: jax.Array         # (F,) i32
+    mzero_bin: jax.Array = None  # (F,) i32 — zero-as-missing bin, -1 if none
 
 
 class _GrowState(NamedTuple):
@@ -708,7 +709,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 is_cat = (r_dir & 2) != 0
                 default_left = (r_dir & 1) != 0
                 is_nan = (routing.nan_bin[r_feat] >= 0) & (fb == routing.nan_bin[r_feat])
-                go_left_num = jnp.where(is_nan, default_left, fb <= r_thr)
+                mzb_r = (routing.mzero_bin[r_feat]
+                         if routing.mzero_bin is not None
+                         else jnp.full_like(r_feat, -1))
+                is_miss = is_nan | ((mzb_r >= 0) & (fb == mzb_r))
+                go_left_num = jnp.where(is_miss, default_left, fb <= r_thr)
                 # flat gather of one bit per row avoids materialising (N, Bmax)
                 go_left_cat = leaf_bits.reshape(-1)[st.leaf_id * Bmax + fb]
                 go_left = jnp.where(is_cat, go_left_cat, go_left_num)

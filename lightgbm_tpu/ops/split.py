@@ -35,6 +35,7 @@ class FeatureLayout(NamedTuple):
     nan_bin: jax.Array         # (F,) int32 — NaN bin position, -1 if feature has none
     is_cat: jax.Array          # (F,) bool
     num_bins: jax.Array        # (F,) int32
+    mzero_bin: jax.Array = None  # (F,) int32 — zero-as-missing bin, -1 if none
 
 
 class SplitResult(NamedTuple):
@@ -256,6 +257,25 @@ def find_best_splits(
     nan_g = jnp.where(has_nan, nan_g, 0.0)
     nan_h = jnp.where(has_nan, nan_h, 0.0)
     nan_c = jnp.where(has_nan, nan_c, 0.0)
+    # zero-as-missing (MissingType::Zero): the default bin's content leaves
+    # BOTH accumulating sides and follows the scan direction, and the scans
+    # SKIP_DEFAULT_BIN (reference: FindBestThresholdSequentially's
+    # skip_default_bin — the reverse scan never evaluates threshold
+    # default_bin-1, the forward scan never evaluates threshold default_bin)
+    mzb = (layout.mzero_bin if layout.mzero_bin is not None
+           else jnp.full(F, -1, jnp.int32))
+    has_mz = (mzb >= 0)[None, :, None]
+    mz_idx = jnp.maximum(mzb, 0)
+    z_g = jnp.where(has_mz, jnp.take_along_axis(
+        hg, mz_idx[None, :, None].repeat(S, 0), axis=-1), 0.0)
+    z_h = jnp.where(has_mz, jnp.take_along_axis(
+        hh, mz_idx[None, :, None].repeat(S, 0), axis=-1), 0.0)
+    z_c = jnp.where(has_mz, jnp.take_along_axis(
+        hc, mz_idx[None, :, None].repeat(S, 0), axis=-1), 0.0)
+    miss_g = nan_g + z_g                   # a feature has at most one kind
+    miss_h = nan_h + z_h
+    miss_c = nan_c + z_c
+    has_miss = has_nan | has_mz
 
     def split_gain(lg, lh, lc, rc):
         rg, rh = pg - lg, ph - lh
@@ -305,21 +325,35 @@ def find_best_splits(
     # e.g. an inflated left-cumsum can report right = 3 when the right bins
     # round to 5 — and stock's min_data_in_leaf gate uses the scan's own
     # estimate, so the gate must too.
+    # effective cumsums EXCLUDE the zero-as-missing bin once passed
+    past_z = has_mz & (bin_iota >= mzb[None, :, None])
+    cg_eff = cg - jnp.where(past_z, z_g, 0.0)
+    ch_eff = ch - jnp.where(past_z, z_h, 0.0)
+    cc_eff = cc - jnp.where(past_z, z_c, 0.0)
     ccDB = jnp.take_along_axis(
-        cc, jnp.maximum(jnp.broadcast_to(data_bins - 1, cc.shape[:2] + (1,)),
-                        0), axis=-1)                       # (S, F, 1)
-    rc_rev = ccDB - cc                                     # right rounded counts
+        cc_eff,
+        jnp.maximum(jnp.broadcast_to(data_bins - 1, cc.shape[:2] + (1,)),
+                    0), axis=-1)                           # (S, F, 1)
+    rc_rev = ccDB - cc_eff                                 # right rounded counts
     lc_rev = pc - rc_rev
-    lc_fwd = cc
-    rc_fwd = pc - cc
-    # rev: missing left — left side = cumsum at t + NaN bin contents
-    gain_rev = split_gain(cg + nan_g, ch + nan_h, lc_rev, rc_rev)
-    # fwd: missing right — left side = plain cumsum at t (NaN features only)
-    gain_fwd = jnp.where(has_nan, split_gain(cg, ch, lc_fwd, rc_fwd), NEG_INF)
-    # rev thresholds: t in [0, data_bins-2]; fwd adds t = data_bins-1
-    # ("NaN vs the rest") for NaN features
-    gain_rev = jnp.where(bin_iota < (data_bins - 1), gain_rev, NEG_INF)
-    gain_fwd = jnp.where(bin_iota < data_bins, gain_fwd, NEG_INF)
+    lc_fwd = cc_eff
+    rc_fwd = pc - cc_eff
+    # rev: missing left — left side = cumsum at t + missing-bin contents
+    gain_rev = split_gain(cg_eff + miss_g, ch_eff + miss_h, lc_rev, rc_rev)
+    # fwd: missing right — left side = plain cumsum at t (missing-typed
+    # features only)
+    gain_fwd = jnp.where(has_miss, split_gain(cg_eff, ch_eff, lc_fwd, rc_fwd),
+                         NEG_INF)
+    # rev thresholds: t in [0, data_bins-2] minus the skipped default-bin
+    # position for zero-as-missing; fwd adds t = data_bins-1 ("NaN vs the
+    # rest") for NaN features but stays within [0, data_bins-2] minus the
+    # default bin for zero-as-missing
+    rev_skip = has_mz & (bin_iota == mzb[None, :, None] - 1)
+    fwd_skip = has_mz & (bin_iota == mzb[None, :, None])
+    fwd_hi = jnp.where(has_mz, data_bins - 1, data_bins)
+    gain_rev = jnp.where((bin_iota < (data_bins - 1)) & ~rev_skip,
+                         gain_rev, NEG_INF)
+    gain_fwd = jnp.where((bin_iota < fwd_hi) & ~fwd_skip, gain_fwd, NEG_INF)
 
     # relative (vs parent) gain so per-feature penalties compose before the argmax
     parent_term_num = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
@@ -372,8 +406,10 @@ def find_best_splits(
         def pick(a3):
             return a3[ar, best_f, t]
 
-        lg = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
-        lh = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
+        lg = pick(cg_eff) + jnp.where(
+            dflt_l, pick(jnp.broadcast_to(miss_g, cg.shape)), 0.0)
+        lh = pick(ch_eff) + jnp.where(
+            dflt_l, pick(jnp.broadcast_to(miss_h, ch.shape)), 0.0)
         lc = jnp.where(dflt_l, pick(jnp.broadcast_to(lc_rev, cg.shape)),
                        pick(jnp.broadcast_to(lc_fwd, cg.shape)))
         rel_gain = jnp.where(rel_gain > min_gain_to_split, rel_gain, NEG_INF)
@@ -467,8 +503,10 @@ def find_best_splits(
     def pick(a3):
         return a3[ar, best_f, t]
 
-    lg_num = pick(cg) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_g, cg.shape)), 0.0)
-    lh_num = pick(ch) + jnp.where(dflt_l, pick(jnp.broadcast_to(nan_h, ch.shape)), 0.0)
+    lg_num = pick(cg_eff) + jnp.where(
+        dflt_l, pick(jnp.broadcast_to(miss_g, cg.shape)), 0.0)
+    lh_num = pick(ch_eff) + jnp.where(
+        dflt_l, pick(jnp.broadcast_to(miss_h, ch.shape)), 0.0)
     lc_num = jnp.where(dflt_l, pick(jnp.broadcast_to(lc_rev, cg.shape)),
                        pick(jnp.broadcast_to(lc_fwd, cg.shape)))
     lg_oh, lh_oh, lc_oh = pick(hg), pick(hh), pick(hc)

@@ -75,7 +75,7 @@ if _ABLATE:
 # table row indices
 (T_CHOSEN, T_NEWID_LO, T_NEWID_HI, T_WORD_LO, T_WORD_HI, T_SHIFT, T_SPAN,
  T_DEFBIN, T_BUNDLED, T_HASNAN, T_NANBIN, T_NBINS, T_THR, T_DEFLEFT, T_ISCAT,
- T_SLOT_L, T_SLOT_R, T_SLOT_KEEP) = range(18)
+ T_SLOT_L, T_SLOT_R, T_SLOT_KEEP, T_HASMZ, T_MZBIN) = range(20)
 
 
 def _digits(v):
@@ -147,9 +147,12 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     fb_b = jnp.where((ls >= 0) & (ls < nbins - 1), ls + ge_def, defbin)
     fb = jnp.where(bundled_i > 0, fb_b, gb)
 
+    has_mz_i = iv[T_HASMZ:T_HASMZ + 1, :]
+    mzbin = iv[T_MZBIN:T_MZBIN + 1, :]
     is_nan_i = has_nan_i * jnp.where(fb == nanbin, 1, 0)
+    is_mz_i = has_mz_i * jnp.where(fb == mzbin, 1, 0)
     le_thr = jnp.where(fb <= thr, 1, 0)
-    go_left_i = jnp.where(is_nan_i > 0, defleft_i, le_thr)
+    go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
     if has_cat:
         # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, then pick fb
         br = jax.lax.dot_general(bits_ref[...].astype(bf16), leaf_oh,
@@ -505,4 +508,8 @@ def build_route_tables(leaf_chosen, leaf_feat, leaf_thr, leaf_dir, leaf_newid,
     rows = rows.at[T_SLOT_L].set(slot_left1.astype(f32))
     rows = rows.at[T_SLOT_R].set(slot_right1.astype(f32))
     rows = rows.at[T_SLOT_KEEP].set(slot_keep1.astype(f32))
+    mzb = (routing.mzero_bin[feat] if routing.mzero_bin is not None
+           else jnp.full_like(feat, -1))
+    rows = rows.at[T_HASMZ].set((mzb >= 0).astype(f32))
+    rows = rows.at[T_MZBIN].set(jnp.maximum(mzb, 0).astype(f32))
     return rows

@@ -7,16 +7,24 @@ them). This trades a tiny amount of split quality for communication volume
 O(2k * B) instead of O(F * B) per round — the mode a DCN-connected TPU pod
 uses when the feature count is large.
 
-TPU re-design: the grower state keeps PER-DEVICE local histograms (leading
-device axis sharded over the mesh via shard_map); each round
-  1. every device builds local child histograms from its row shard (segsum),
+TPU re-design: per-device local histograms live inside shard_map over the
+data axis; each round
+  1. every device builds local GROUP histograms from its row shard (segsum)
+     and gathers them to per-FEATURE histograms (EFB bundles residual-fill
+     against the LOCAL per-slot parent sums — the fill is linear in both the
+     histogram and the parent, so the psum of locally-filled histograms
+     equals the globally-filled one),
   2. computes local per-feature best gains and votes for its top-k features,
-  3. `psum` of the one-hot votes elects the global top-2k features,
+  3. `psum` of the one-hot votes elects the global top-2k features per slot,
   4. `psum` reduces ONLY the elected features' histogram columns,
-  5. the best split among elected features is computed identically everywhere.
-Scope: numeric features without EFB bundling (the reference's voting learner
-also specializes the dense numeric path); the engine falls back to
-tree_learner=data otherwise.
+  5. the FULL split scan (ops.split.find_best_splits — NaN directions,
+     scan-order tie-breaks, categorical one-hot/sorted-subset) runs on the
+     elected subset, vmapped over slots with per-slot gathered sub-layouts,
+     identically on every device.
+
+All training layouts are supported — EFB bundles, NaN bins, and categorical
+features ride the same scan as the serial learner (the reference's voting
+learner handles every layout too).
 """
 from __future__ import annotations
 
@@ -28,79 +36,115 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.split import leaf_output, leaf_term
+from ..ops.grow import RoutingLayout, feature_local_bin
+from ..ops.split import (DIR_CATEGORICAL, DIR_DEFAULT_LEFT, FeatureLayout,
+                         categorical_left_bitset, find_best_splits,
+                         gather_feature_histograms, leaf_output, leaf_term,
+                         round_int)
 from ..tree import TreeArrays
 from ..utils.log import log_warning
 from .mesh import DATA_AXIS
 
 NEG_INF = -1e30
+EPS_HESS = 1e-15
 
 
-def _per_feature_best(hist, parent_g, parent_h, parent_c, lambda_l1, lambda_l2,
-                      min_data_in_leaf, min_sum_hessian_in_leaf):
-    """Numeric split scan returning PER-FEATURE bests: hist (S, F, B, 3) ->
-    (gain (S,F), thr (S,F), left sums (S,F,3)). Simplified (no NaN bins/EFB:
-    voting mode guards for that layout)."""
-    cg = jnp.cumsum(hist[..., 0], axis=-1)
-    ch = jnp.cumsum(hist[..., 1], axis=-1)
-    cc = jnp.cumsum(hist[..., 2], axis=-1)
-    pg = parent_g[:, None, None]
-    ph = parent_h[:, None, None]
-    pc = parent_c[:, None, None]
-
-    rg, rh, rc = pg - cg, ph - ch, pc - cc
-    gain = (leaf_term(cg, ch, lambda_l1, lambda_l2)
-            + leaf_term(rg, rh, lambda_l1, lambda_l2)
-            - leaf_term(pg, ph, lambda_l1, lambda_l2))
-    ok = ((cc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
-          (ch >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
-    B = hist.shape[2]
-    t_valid = jnp.arange(B)[None, None, :] < (B - 1)
-    gain = jnp.where(ok & t_valid, gain, NEG_INF)
-    thr = jnp.argmax(gain, axis=-1)                       # (S, F)
-    bestg = jnp.take_along_axis(gain, thr[..., None], -1)[..., 0]
-    lg = jnp.take_along_axis(cg, thr[..., None], -1)[..., 0]
-    lh = jnp.take_along_axis(ch, thr[..., None], -1)[..., 0]
-    lc = jnp.take_along_axis(cc, thr[..., None], -1)[..., 0]
-    return bestg, thr, lg, lh, lc
-
-
-def voting_split_round(bins_s, slot_s, grad_s, hess_s, cnt_s, parent_g,
-                       parent_h, parent_c, col_mask, *, num_slots, bmax,
-                       top_k, lambda_l1, lambda_l2, min_data_in_leaf,
-                       min_sum_hessian_in_leaf, min_gain_to_split, axis):
-    """One voting round, called INSIDE shard_map over the data axis.
-
-    bins_s/slot_s/...: this device's row shard. parent sums are replicated.
-    Returns replicated (gain, feature, threshold, left sums) for S slots."""
+def _local_feature_hists(bins_s, slot_s, grad_s, hess_s, cnt_s, layout,
+                         num_slots, bmax):
+    """This device's per-feature histograms (S, F, B, 3) with EFB residual
+    fill against the LOCAL per-slot parent sums, plus those local parents."""
     S, B = num_slots, bmax
-    n, F = bins_s.shape
     valid = slot_s >= 0
     s = jnp.where(valid, slot_s, 0)
     w = jnp.stack([grad_s, hess_s, cnt_s], -1) * valid[:, None]
 
-    def per_feature(col):
+    def per_group(col):
         ids = s * B + col.astype(jnp.int32)
         h = jax.ops.segment_sum(w, ids, num_segments=S * B)
         return h.reshape(S, B, 3)
 
-    hist_loc = jnp.transpose(jax.lax.map(per_feature, bins_s.T), (1, 0, 2, 3))
+    hist_g = jnp.transpose(jax.lax.map(per_group, bins_s.T), (1, 0, 2, 3))
+    pg = jax.ops.segment_sum(grad_s * valid, s, num_segments=S)
+    ph = jax.ops.segment_sum(hess_s * valid, s, num_segments=S)
+    pc = jax.ops.segment_sum(cnt_s * valid, s, num_segments=S)
+    hist_f = gather_feature_histograms(hist_g, layout, pg, ph, pc)
+    return hist_f, pg, ph, pc
 
-    # local parent sums for the vote gains (reference: local FindBestSplits)
-    pg_loc = jax.ops.segment_sum(grad_s * valid, s, num_segments=S)
-    ph_loc = jax.ops.segment_sum(hess_s * valid, s, num_segments=S)
-    pc_loc = jax.ops.segment_sum(cnt_s * valid, s, num_segments=S)
 
-    gain_loc, _, _, _, _ = _per_feature_best(
-        hist_loc, pg_loc, ph_loc, pc_loc, lambda_l1, lambda_l2,
-        min_data_in_leaf, min_sum_hessian_in_leaf)
+def _vote_gain_scan(hist_f, pg, ph, pc, layout, lambda_l1, lambda_l2,
+                    min_data_in_leaf, min_sum_hessian_in_leaf):
+    """Per-feature best-gain scan for the local VOTES only (both missing
+    directions for numeric features; categorical features vote with their
+    best one-hot gain — the reference ranks votes by local best gain)."""
+    hg, hh, hc = hist_f[..., 0], hist_f[..., 1], hist_f[..., 2]
+    cg = jnp.cumsum(hg, -1)
+    ch = jnp.cumsum(hh, -1)
+    cc = jnp.cumsum(hc, -1)
+    pgb = pg[:, None, None]
+    phb = ph[:, None, None]
+    pcb = pc[:, None, None]
+
+    def gains(lg, lh, lc):
+        rg, rh, rc = pgb - lg, phb - lh, pcb - lc
+        g = (leaf_term(lg, lh, lambda_l1, lambda_l2)
+             + leaf_term(rg, rh, lambda_l1, lambda_l2)
+             - leaf_term(pgb, phb, lambda_l1, lambda_l2))
+        ok = ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
+              (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
+        return jnp.where(ok, g, NEG_INF)
+
+    B = hg.shape[-1]
+    S = hg.shape[0]
+    nbins = layout.num_bins
+    nan_bin = layout.nan_bin
+    has_nan = (nan_bin >= 0)[None, :, None]
+    nidx = jnp.maximum(nan_bin, 0)
+    nan_g = jnp.where(has_nan, jnp.take_along_axis(
+        hg, nidx[None, :, None].repeat(S, 0), -1), 0.0)
+    nan_h = jnp.where(has_nan, jnp.take_along_axis(
+        hh, nidx[None, :, None].repeat(S, 0), -1), 0.0)
+    nan_c = jnp.where(has_nan, jnp.take_along_axis(
+        hc, nidx[None, :, None].repeat(S, 0), -1), 0.0)
+    data_bins = jnp.where(nan_bin[None, :, None] >= 0,
+                          nbins[None, :, None] - 1, nbins[None, :, None])
+    biota = jnp.arange(B)[None, None, :]
+    g_rev = jnp.where(biota < data_bins - 1,
+                      gains(cg + nan_g, ch + nan_h, cc + nan_c), NEG_INF)
+    g_fwd = jnp.where(has_nan & (biota < data_bins),
+                      gains(cg, ch, cc), NEG_INF)
+    num_best = jnp.max(jnp.maximum(g_rev, g_fwd), axis=-1)       # (S, F)
+    vm_res = layout.valid_mask | (
+        (jnp.arange(B)[None, :] == layout.residual_pos[:, None])
+        & (layout.residual_pos >= 0)[:, None])
+    cat_best = jnp.max(jnp.where(vm_res[None],
+                                 gains(hg, hh, hc), NEG_INF), axis=-1)
+    return jnp.where(layout.is_cat[None, :], cat_best, num_best)
+
+
+def voting_split_round(bins_s, slot_s, grad_s, hess_s, cnt_s, parent_g,
+                       parent_h, parent_c, col_mask, *, layout, num_slots,
+                       bmax, top_k, scan_kw, vote_kw, cat_kw, axis):
+    """One voting round, called INSIDE shard_map over the data axis.
+
+    Returns replicated per-slot winners: (gain, GLOBAL feature id,
+    threshold, dir_flags, left g/h/c, cat bitset (B,))."""
+    S, B = num_slots, bmax
+    F = layout.gather_idx.shape[0]
+    # validity incl. the residual-filled EFB default bin (the gathered
+    # histograms carry it even though the stored layout does not)
+    vm_res = layout.valid_mask | (
+        (jnp.arange(B)[None, :] == layout.residual_pos[:, None])
+        & (layout.residual_pos >= 0)[:, None])
+    hist_loc, pg_loc, ph_loc, pc_loc = _local_feature_hists(
+        bins_s, slot_s, grad_s, hess_s, cnt_s, layout, S, B)
+
+    gain_loc = _vote_gain_scan(hist_loc, pg_loc, ph_loc, pc_loc, layout,
+                               **vote_kw)
     gain_loc = jnp.where(col_mask[None, :], gain_loc, NEG_INF)
 
     # ---- vote: local top-k features per slot (GlobalVoting, :104) ----
     k = min(top_k, F)
     top_gain, local_top = jax.lax.top_k(gain_loc, k)      # (S, k)
-    # masked / splitless features carry NEG_INF gain; they must not receive
-    # votes (the reference only proposes valid local splits)
     vote_w = (top_gain > NEG_INF / 2).astype(jnp.float32)
     votes = jnp.zeros((S, F)).at[
         jnp.arange(S)[:, None], local_top].add(vote_w)
@@ -113,42 +157,81 @@ def voting_split_round(bins_s, slot_s, grad_s, hess_s, cnt_s, parent_g,
         hist_loc, elected[:, :, None, None], axis=1)      # (S, 2k, B, 3)
     hist_elec = jax.lax.psum(hist_elec, axis)
 
-    gain_e, thr_e, lg_e, lh_e, lc_e = _per_feature_best(
-        hist_elec, parent_g, parent_h, parent_c, lambda_l1, lambda_l2,
-        min_data_in_leaf, min_sum_hessian_in_leaf)
-    elected_mask = jnp.take_along_axis(
-        jnp.broadcast_to(col_mask[None, :], (S, F)), elected, axis=1)
-    gain_e = jnp.where(elected_mask, gain_e, NEG_INF)
-    best = jnp.argmax(gain_e, axis=-1)                    # (S,)
+    # ---- full scan on the elected subset (vmapped per slot: each slot has
+    # its own elected set, hence its own gathered sub-layout) ----
+    iota_gather = (jnp.arange(k2, dtype=jnp.int32)[:, None] * B
+                   + jnp.arange(B, dtype=jnp.int32)[None, :])
+
+    def scan_one(h_e, pg1, ph1, pc1, e_s):
+        # the elected histograms are ALREADY residual-filled (the local
+        # gather filled EFB default bins before the psum), so the sub-layout
+        # must mark the residual position VALID and not fill again
+        sub = FeatureLayout(
+            gather_idx=iota_gather,
+            valid_mask=vm_res[e_s],
+            residual_pos=jnp.full(k2, -1, jnp.int32),
+            nan_bin=layout.nan_bin[e_s],
+            is_cat=layout.is_cat[e_s],
+            num_bins=layout.num_bins[e_s],
+            mzero_bin=(layout.mzero_bin[e_s]
+                       if layout.mzero_bin is not None else None))
+        res = find_best_splits(
+            h_e[None, :, :, :2], pg1[None], ph1[None], pc1[None],
+            layout=sub, col_mask=col_mask[e_s][None], **scan_kw)
+        return jax.tree.map(lambda a: a[0], res)
+
+    res = jax.vmap(scan_one)(hist_elec, parent_g, parent_h, parent_c,
+                             elected)
     ar = jnp.arange(S)
-    gain = gain_e[ar, best]
-    gain = jnp.where(gain > min_gain_to_split, gain, NEG_INF)
-    return (gain.astype(jnp.float32),
-            elected[ar, best].astype(jnp.int32),
-            thr_e[ar, best].astype(jnp.int32),
-            lg_e[ar, best], lh_e[ar, best], lc_e[ar, best])
+    feat_global = elected[ar, res.feature]
+
+    # categorical winners: recompute the left-side bin membership from the
+    # reduced histogram (identical on every device)
+    hist_win = hist_elec[ar, res.feature, :, :2]           # (S, B, 2)
+    vm_win = vm_res[feat_global]
+    cnt_factor = parent_c / jnp.maximum(parent_h, EPS_HESS)
+    bitset = categorical_left_bitset(
+        hist_win, res.threshold, res.dir_flags, vm_win,
+        cat_kw["cat_smooth"], cat_kw["min_data_per_group"], cnt_factor)
+
+    return (res.gain.astype(jnp.float32), feat_global.astype(jnp.int32),
+            res.threshold.astype(jnp.int32), res.dir_flags.astype(jnp.int32),
+            res.left_sum_g, res.left_sum_h, res.left_count, bitset)
 
 
 def make_voting_splitter(mesh: Mesh, num_slots: int, bmax: int, top_k: int,
-                         cfg) -> "callable":
-    """shard_map-wrapped voting split finder bound to the mesh."""
+                         cfg, layout=None) -> "callable":
+    """shard_map-wrapped voting split finder bound to the mesh + layout."""
     try:
         from jax import shard_map
     except ImportError:
         from jax.experimental.shard_map import shard_map
     axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-    fn = functools.partial(
-        voting_split_round, num_slots=num_slots, bmax=bmax, top_k=top_k,
+    scan_kw = dict(
         lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
         min_data_in_leaf=max(cfg.min_data_in_leaf, 1),
         min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-        min_gain_to_split=cfg.min_gain_to_split, axis=axis)
+        min_gain_to_split=cfg.min_gain_to_split,
+        cat_l2=cfg.cat_l2, cat_smooth=cfg.cat_smooth,
+        max_cat_threshold=cfg.max_cat_threshold,
+        max_cat_to_onehot=cfg.max_cat_to_onehot,
+        min_data_per_group=cfg.min_data_per_group)
+    vote_kw = dict(
+        lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+        min_data_in_leaf=max(cfg.min_data_in_leaf, 1),
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+    cat_kw = dict(cat_smooth=cfg.cat_smooth,
+                  min_data_per_group=cfg.min_data_per_group)
+    fn = functools.partial(
+        voting_split_round, layout=layout, num_slots=num_slots, bmax=bmax,
+        top_k=top_k, scan_kw=scan_kw, vote_kw=vote_kw, cat_kw=cat_kw,
+        axis=axis)
     row = P(axis)
     rep = P()
     kwargs = dict(mesh=mesh,
                   in_specs=(P(axis, None), row, row, row, row,
                             rep, rep, rep, rep),
-                  out_specs=(rep, rep, rep, rep, rep, rep))
+                  out_specs=(rep,) * 8)
     try:
         return shard_map(fn, check_vma=False, **kwargs)
     except TypeError:
@@ -159,26 +242,22 @@ def make_voting_splitter(mesh: Mesh, num_slots: int, bmax: int, top_k: int,
 
 
 def voting_supported(layout, routing) -> bool:
-    """Numeric, unbundled layouts only (scope of the voting specialization)."""
-    try:
-        is_cat = np.asarray(layout.is_cat)
-        bundled = np.asarray(routing.bundled)
-        nan_bin = np.asarray(routing.nan_bin)
-    except Exception:
-        return False
-    return (not is_cat.any()) and (not bundled.any()) and (nan_bin < 0).all()
+    """Every training layout is supported (EFB / NaN / categorical)."""
+    return True
 
 
 class _VoteState(NamedTuple):
     leaf_id: jax.Array
     split_feature: jax.Array
     threshold_bin: jax.Array
+    dir_flags: jax.Array
     left_child: jax.Array
     right_child: jax.Array
     split_gain: jax.Array
     internal_value: jax.Array
     internal_weight: jax.Array
     internal_count: jax.Array
+    cat_bitset: jax.Array
     sum_g: jax.Array
     sum_h: jax.Array
     cnt: jax.Array
@@ -187,6 +266,8 @@ class _VoteState(NamedTuple):
     best_gain: jax.Array
     best_feat: jax.Array
     best_thr: jax.Array
+    best_dir: jax.Array
+    best_bits: jax.Array
     best_left_g: jax.Array
     best_left_h: jax.Array
     best_left_c: jax.Array
@@ -195,33 +276,38 @@ class _VoteState(NamedTuple):
 
 
 def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
-                     splitter, params) -> Tuple[TreeArrays, jax.Array]:
-    """Voting-parallel batched leaf-wise growth (numeric/unbundled layouts).
+                     splitter, params, routing: RoutingLayout
+                     ) -> Tuple[TreeArrays, jax.Array]:
+    """Voting-parallel batched leaf-wise growth (all layouts).
 
     Unlike ops.grow.grow_tree there is NO global histogram state: every round
     re-derives child best-splits through the elected-feature voting reduce
     (reference: voting_parallel_tree_learner.cpp Train loop)."""
-    N, F = bins.shape
+    N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
     f32, i32 = jnp.float32, jnp.int32
+    Bmax = params_bmax = None
 
     def leaf_out(g, h):
         return leaf_output(g, h, params.lambda_l1, params.lambda_l2,
                            params.max_delta_step)
 
     root_g, root_h, root_c = jnp.sum(grad), jnp.sum(hess), jnp.sum(cnt_w)
-    g0, f0, t0, lg0, lh0, lc0 = splitter_root(
+    (g0, f0, t0, d0, lg0, lh0, lc0, b0) = splitter_root(
         bins, jnp.zeros(N, i32), grad, hess, cnt_w, root_g[None],
         root_h[None], root_c[None], col_mask)
+    Bmax = b0.shape[-1]
 
     state = _VoteState(
         leaf_id=jnp.zeros(N, i32),
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
+        dir_flags=jnp.zeros(L, i32),
         left_child=jnp.zeros(L, i32), right_child=jnp.zeros(L, i32),
         split_gain=jnp.zeros(L, f32),
         internal_value=jnp.zeros(L, f32), internal_weight=jnp.zeros(L, f32),
         internal_count=jnp.zeros(L, f32),
+        cat_bitset=jnp.zeros((L, Bmax), bool),
         sum_g=jnp.zeros(L, f32).at[0].set(root_g),
         sum_h=jnp.zeros(L, f32).at[0].set(root_h),
         cnt=jnp.zeros(L, f32).at[0].set(root_c),
@@ -229,6 +315,8 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
         best_gain=jnp.full(L, NEG_INF, f32).at[0].set(g0[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(f0[0]),
         best_thr=jnp.zeros(L, i32).at[0].set(t0[0]),
+        best_dir=jnp.zeros(L, i32).at[0].set(d0[0]),
+        best_bits=jnp.zeros((L, Bmax), bool).at[0].set(b0[0]),
         best_left_g=jnp.zeros(L, f32).at[0].set(lg0[0]),
         best_left_h=jnp.zeros(L, f32).at[0].set(lh0[0]),
         best_left_c=jnp.zeros(L, f32).at[0].set(lc0[0]),
@@ -248,17 +336,20 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
         order = jnp.argsort(-cand)
         ranks = jnp.arange(L)
         chosen = (ranks < jnp.minimum(remaining, S)) & (cand[order] > 0)
-        k = jnp.sum(chosen.astype(i32))
+        k = jnp.sum(chosen, dtype=i32)
         pair_valid = jnp.arange(S) < k
-        pair_old = jnp.where(pair_valid, order[:S], 0)
-        pair_new = jnp.where(pair_valid, cur + jnp.arange(S), 0)
-        pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S), 0)
+        pair_old = jnp.where(pair_valid, order[:S].astype(i32), 0)
+        pair_new = jnp.where(pair_valid, cur + jnp.arange(S, dtype=i32), 0)
+        pair_node = jnp.where(pair_valid, (cur - 1) + jnp.arange(S, dtype=i32),
+                              0)
         node_idx = jnp.where(pair_valid, pair_node, drop)
         new_idx = jnp.where(pair_valid, pair_new, drop)
         old_idx = jnp.where(pair_valid, pair_old, drop)
 
         feat = st.best_feat[pair_old]
         thr = st.best_thr[pair_old]
+        dirf = st.best_dir[pair_old]
+        bits = st.best_bits[pair_old]
         gain = st.best_gain[pair_old]
         pg, ph, pc = st.sum_g[pair_old], st.sum_h[pair_old], st.cnt[pair_old]
         lg, lh, lc = (st.best_left_g[pair_old], st.best_left_h[pair_old],
@@ -268,11 +359,13 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
         st2 = st._replace(
             split_feature=st.split_feature.at[node_idx].set(feat, mode="drop"),
             threshold_bin=st.threshold_bin.at[node_idx].set(thr, mode="drop"),
+            dir_flags=st.dir_flags.at[node_idx].set(dirf, mode="drop"),
             split_gain=st.split_gain.at[node_idx].set(gain, mode="drop"),
             internal_value=st.internal_value.at[node_idx].set(
                 leaf_out(pg, ph), mode="drop"),
             internal_weight=st.internal_weight.at[node_idx].set(ph, mode="drop"),
             internal_count=st.internal_count.at[node_idx].set(pc, mode="drop"),
+            cat_bitset=st.cat_bitset.at[node_idx].set(bits, mode="drop"),
             left_child=st.left_child.at[node_idx].set(~pair_old, mode="drop"),
             right_child=st.right_child.at[node_idx].set(~pair_new, mode="drop"),
         )
@@ -289,15 +382,33 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
             leaf_parent=(st2.leaf_parent.at[old_idx].set(pair_node, mode="drop")
                                         .at[new_idx].set(pair_node, mode="drop")))
 
-        # route rows (numeric, unbundled: stored bin IS the feature bin)
+        # ---- route rows: EFB feature-local bins, NaN default direction,
+        # categorical bitsets (same semantics as ops.grow's non-stream path)
         leaf_chosen = jnp.zeros(L, bool).at[old_idx].set(pair_valid, mode="drop")
         leaf_new = jnp.zeros(L, i32).at[old_idx].set(pair_new, mode="drop")
         leaf_feat = jnp.zeros(L, i32).at[old_idx].set(feat, mode="drop")
         leaf_thr = jnp.zeros(L, i32).at[old_idx].set(thr, mode="drop")
+        leaf_dir = jnp.zeros(L, i32).at[old_idx].set(dirf, mode="drop")
+        leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bits,
+                                                               mode="drop")
+        r_chosen = leaf_chosen[st.leaf_id]
         r_feat = leaf_feat[st.leaf_id]
-        gb = jnp.take_along_axis(bins, r_feat[:, None], axis=1)[:, 0]
-        go_left = gb.astype(i32) <= leaf_thr[st.leaf_id]
-        new_leaf = jnp.where(leaf_chosen[st.leaf_id] & ~go_left,
+        r_grp = routing.feat_group[r_feat]
+        gb = jnp.take_along_axis(bins, r_grp[:, None].astype(i32),
+                                 axis=1)[:, 0]
+        fb = feature_local_bin(gb, r_feat, routing)
+        r_thr = leaf_thr[st.leaf_id]
+        r_dir = leaf_dir[st.leaf_id]
+        is_cat = (r_dir & DIR_CATEGORICAL) != 0
+        default_left = (r_dir & DIR_DEFAULT_LEFT) != 0
+        is_nan = (routing.nan_bin[r_feat] >= 0) & (fb == routing.nan_bin[r_feat])
+        mzb_r = (routing.mzero_bin[r_feat] if routing.mzero_bin is not None
+                 else jnp.full_like(r_feat, -1))
+        is_miss = is_nan | ((mzb_r >= 0) & (fb == mzb_r))
+        go_left_num = jnp.where(is_miss, default_left, fb <= r_thr)
+        go_left_cat = leaf_bits.reshape(-1)[st.leaf_id * Bmax + fb]
+        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+        new_leaf = jnp.where(r_chosen & ~go_left,
                              leaf_new[st.leaf_id], st.leaf_id)
 
         st2 = st2._replace(
@@ -313,12 +424,14 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
 
         # children best splits through the voting reduce (2S slots)
         slot_map = jnp.full(L, -1, i32)
-        slot_map = slot_map.at[old_idx].set(jnp.arange(S), mode="drop")
-        slot_map = slot_map.at[new_idx].set(S + jnp.arange(S), mode="drop")
+        slot_map = slot_map.at[old_idx].set(jnp.arange(S, dtype=i32),
+                                            mode="drop")
+        slot_map = slot_map.at[new_idx].set(S + jnp.arange(S, dtype=i32),
+                                            mode="drop")
         slot2 = slot_map[new_leaf]
         ids2 = jnp.concatenate([pair_old, pair_new])
         valid2 = jnp.concatenate([pair_valid, pair_valid])
-        g2, f2, t2, lg2, lh2, lc2 = splitter(
+        (g2, f2, t2, d2, lg2, lh2, lc2, b2) = splitter(
             bins, slot2, grad, hess, cnt_w, st2.sum_g[ids2], st2.sum_h[ids2],
             st2.cnt[ids2], col_mask)
         ids2_m = jnp.where(valid2, ids2, drop)
@@ -326,6 +439,8 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
             best_gain=st2.best_gain.at[ids2_m].set(g2, mode="drop"),
             best_feat=st2.best_feat.at[ids2_m].set(f2, mode="drop"),
             best_thr=st2.best_thr.at[ids2_m].set(t2, mode="drop"),
+            best_dir=st2.best_dir.at[ids2_m].set(d2, mode="drop"),
+            best_bits=st2.best_bits.at[ids2_m].set(b2, mode="drop"),
             best_left_g=st2.best_left_g.at[ids2_m].set(lg2, mode="drop"),
             best_left_h=st2.best_left_h.at[ids2_m].set(lh2, mode="drop"),
             best_left_c=st2.best_left_c.at[ids2_m].set(lc2, mode="drop"))
@@ -334,15 +449,14 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
     final = jax.lax.while_loop(cond, body, state)
     leaf_value = leaf_out(final.sum_g, final.sum_h)
     leaf_value = jnp.where(final.num_leaves_cur > 1, leaf_value, 0.0)
-    Bmax = 1
     tree = TreeArrays(
         split_feature=final.split_feature, threshold_bin=final.threshold_bin,
-        dir_flags=jnp.zeros(L, i32), left_child=final.left_child,
+        dir_flags=final.dir_flags, left_child=final.left_child,
         right_child=final.right_child, split_gain=final.split_gain,
         internal_value=final.internal_value,
         internal_weight=final.internal_weight,
         internal_count=final.internal_count,
-        cat_bitset=jnp.zeros((L, Bmax), bool),
+        cat_bitset=final.cat_bitset,
         leaf_value=leaf_value, leaf_weight=final.sum_h, leaf_count=final.cnt,
         leaf_parent=final.leaf_parent, num_leaves=final.num_leaves_cur,
         leaf_depth=final.depth)

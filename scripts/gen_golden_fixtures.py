@@ -147,6 +147,27 @@ def main():
              "predict_raw_score": "true", "verbosity": -1}, FIX)
     print("generated stock_forcedbins.model")
 
+    # ---- zero_as_missing (MissingType::Zero) ----
+    rs3 = np.random.RandomState(21)
+    nz = 600
+    Xz = rs3.randn(nz, 4).round(4)
+    Xz[rs3.rand(nz, 4) < 0.35] = 0.0
+    yz = (Xz[:, 0] + 0.5 * Xz[:, 1] - Xz[:, 2] + 0.1 * rs3.randn(nz)).round(5)
+    zam_csv = FIX / "golden_train_zam.csv"
+    write_csv(zam_csv, yz, Xz)
+    with open(FIX / "golden_X_zam.csv", "w") as fh:
+        for row in Xz:
+            fh.write(",".join(f"{v:.6g}" for v in row) + "\n")
+    model = FIX / "stock_zam.model"
+    run_cli({**common, "objective": "regression", "data": str(zam_csv),
+             "zero_as_missing": "true",
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X_zam.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_zam.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_zam.model")
+
     # ---- refit on perturbed labels (Application task=refit) ----
     rs2 = np.random.RandomState(13)
     flip = rs2.rand(len(y_bin)) < 0.15

@@ -248,3 +248,24 @@ def test_no_trailing_trivial_trees():
     assert bst.num_trees() < finished_at + 1
     assert trees[-1].num_leaves > 1
     assert bst.engine.iter_ == bst.num_trees()
+
+
+def test_fused_iteration_matches_unfused():
+    """The whole-iteration fused program (gradients -> grow -> score update
+    as one launch) must reproduce the step-by-step path to float tolerance."""
+    import os
+    rs = np.random.RandomState(11)
+    X = rs.randn(2000, 8)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rs.randn(2000) > 0).astype(float)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5}
+    os.environ["LGBTPU_FUSE_ITER"] = "1"
+    try:
+        fused = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+        assert fused.engine._iter_fn is not None, "fused path did not engage"
+    finally:
+        os.environ["LGBTPU_FUSE_ITER"] = "0"
+        plain = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+        del os.environ["LGBTPU_FUSE_ITER"]
+    np.testing.assert_allclose(fused.predict(X), plain.predict(X),
+                               rtol=1e-4, atol=1e-5)

@@ -85,3 +85,21 @@ def test_init_distributed_single_process():
                              coordinator_address="127.0.0.1:41999")
     except lgb.LightGBMError:
         pass
+
+
+def test_pyarrow_columnar_binning_matches_dense():
+    """The Arrow columnar path (binning straight from column buffers, no
+    dense matrix) must produce bit-identical bins to dense ingestion."""
+    pa = pytest.importorskip("pyarrow")
+    rs = np.random.RandomState(9)
+    X = rs.randn(1200, 5)
+    X[::7, 1] = np.nan
+    y = X[:, 0] + 0.1 * rs.randn(1200)
+    table = pa.table({f"c{i}": X[:, i] for i in range(5)})
+    ds_a = lgb.Dataset(table, label=y)
+    ds_d = lgb.Dataset(X, label=y)
+    ds_a.construct(), ds_d.construct()
+    assert ds_a.raw_arrow is not None or ds_a.binned is not None
+    np.testing.assert_array_equal(np.asarray(ds_a.binned.bins),
+                                  np.asarray(ds_d.binned.bins))
+    assert ds_a.binned.group_features == ds_d.binned.group_features

@@ -35,15 +35,31 @@ def test_voting_close_to_serial():
 
 
 @pytest.mark.slow
-def test_voting_falls_back_for_categorical():
-    rs = np.random.RandomState(5)
-    X = rs.randn(2000, 5)
-    X[:, 3] = rs.randint(0, 5, 2000)
-    y = X[:, 0] + (X[:, 3] == 2)
-    bst = lgb.train({"objective": "regression", "num_leaves": 15,
-                     "verbosity": -1, "tree_learner": "voting",
-                     "min_data_in_leaf": 5},
-                    lgb.Dataset(X, label=y, categorical_feature=[3]),
-                    num_boost_round=3)
-    assert not bst.engine._voting
-    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
+def test_voting_handles_all_layouts():
+    """The PV-Tree learner supports every training layout like the
+    reference's (voting_parallel_tree_learner.cpp handles categorical, NaN
+    and bundled features): the three test_distributed.py layouts must train
+    UNDER voting (no fallback) with competitive accuracy."""
+    from tests.test_distributed import _datasets
+
+    for name, params, data_kw, ds_kw in _datasets():
+        p = dict(params, num_leaves=15, verbosity=-1, min_data_in_leaf=5,
+                 tree_learner="voting", top_k=6)
+        ds = lgb.Dataset(data_kw["data"], label=data_kw["label"],
+                         weight=data_kw.get("weight"), **ds_kw)
+        bst = lgb.train(p, ds, num_boost_round=8)
+        assert bst.engine._voting, f"{name}: voting learner should be active"
+        serial = lgb.train(dict(p, tree_learner="serial"), lgb.Dataset(
+            data_kw["data"], label=data_kw["label"],
+            weight=data_kw.get("weight"), **ds_kw), num_boost_round=8)
+        pred = np.asarray(bst.predict(data_kw["data"]))
+        sref = np.asarray(serial.predict(data_kw["data"]))
+        y = np.asarray(data_kw["label"])
+        if params["objective"] == "binary":
+            acc = float(np.mean((pred > 0.5) == (y > 0.5)))
+            acc_s = float(np.mean((sref > 0.5) == (y > 0.5)))
+            assert acc > acc_s - 0.05, (name, acc, acc_s)
+        else:
+            c = np.corrcoef(pred, y)[0, 1]
+            c_s = np.corrcoef(sref, y)[0, 1]
+            assert c > c_s - 0.05, (name, c, c_s)
