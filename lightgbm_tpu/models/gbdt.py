@@ -220,6 +220,7 @@ class GBDT:
             interaction_groups=self._interaction_group_masks(),
             forced=self._parse_forced_splits(),
             cegb_coupled=self._cegb_coupled_array(),
+            cegb_lazy_pen=self._cegb_lazy_pen_array(),
             mesh=self.mesh if self._mesh_stream else None,
             row_axis=self._row_axis)
         self._grow_fn = jax.jit(self._grow_partial)
@@ -227,6 +228,12 @@ class GBDT:
         self._iter_fn = None
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
                            if self._grow_params.has_cegb else None)
+        # CEGB per-row feature-acquisition bitset (feature_used_in_data_,
+        # cegb hpp:66 — persists across ALL trees of the boosting run)
+        self._cegb_lazy = (jnp.zeros((dd.bins.shape[0], dd.num_features),
+                                     bool)
+                           if self._cegb_lazy_pen_array() is not None
+                           else None)
         self._voting = False
         if config.tree_learner == "voting" and self.mesh is not None:
             from ..parallel.voting import (grow_tree_voting,
@@ -255,7 +262,7 @@ class GBDT:
             routing = dd.routing
 
             def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
-                         cegb_used=None, gh_scales=None):
+                         cegb_used=None, cegb_lazy=None, gh_scales=None):
                 return grow_tree_voting(bins, g, h, mask, colm,
                                         sp_root, sp, gp, routing)
 
@@ -452,10 +459,19 @@ class GBDT:
             has_cegb=(c.cegb_penalty_split > 0.0
                       or (c.cegb_penalty_feature_coupled is not None
                           and len(np.atleast_1d(
-                              c.cegb_penalty_feature_coupled)) > 0)),
+                              c.cegb_penalty_feature_coupled)) > 0)
+                      or (c.cegb_penalty_feature_lazy is not None
+                          and len(np.atleast_1d(
+                              c.cegb_penalty_feature_lazy)) > 0)),
             cegb_tradeoff=c.cegb_tradeoff,
             cegb_penalty_split=c.cegb_penalty_split,
         )
+
+    def _cegb_lazy_pen_array(self):
+        v = self.config.cegb_penalty_feature_lazy
+        if v is None or len(np.atleast_1d(v)) == 0:
+            return None
+        return jnp.asarray(np.atleast_1d(v), jnp.float32)
 
     def _cegb_coupled_array(self):
         c = self.config
@@ -587,11 +603,12 @@ class GBDT:
         def _nonempty(v):
             return v is not None and len(np.atleast_1d(v)) > 0
 
-        if _nonempty(c.cegb_penalty_feature_lazy):
+        if _nonempty(c.cegb_penalty_feature_lazy) and \
+                len(np.atleast_1d(c.cegb_penalty_feature_lazy)) != \
+                self.dd.num_features:
             raise LightGBMError(
-                "cegb_penalty_feature_lazy (per-row on-demand feature costs) is "
-                "not implemented; cegb_penalty_split and "
-                "cegb_penalty_feature_coupled are supported")
+                "cegb_penalty_feature_lazy should be the same size as the "
+                "feature count")
         if _nonempty(c.cegb_penalty_feature_coupled) and \
                 len(np.atleast_1d(c.cegb_penalty_feature_coupled)) != \
                 self.dd.num_features:
@@ -1000,10 +1017,14 @@ class GBDT:
             else:
                 with global_timer.scope("GBDT::TrainTree"), \
                         self._grow_x64_ctx():
-                    arrays, leaf_id = self._grow_fn(
+                    out = self._grow_fn(
                         self.dd.bins, g, h, mask, col_mask, key=gkey,
                         packed=self._packed, cegb_used=self._cegb_used,
-                        gh_scales=sc)
+                        cegb_lazy=self._cegb_lazy, gh_scales=sc)
+                    if len(out) == 3:
+                        arrays, leaf_id, self._cegb_lazy = out
+                    else:
+                        arrays, leaf_id = out
             if self._cegb_used is not None:
                 L = self._grow_params.num_leaves
                 ni_mask = jnp.arange(L) < (arrays.num_leaves - 1)

@@ -132,6 +132,8 @@ class _GrowState(NamedTuple):
     adv_vmax: jax.Array         # slabs (see advanced_constraint_slabs)
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
     cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
+    cegb_lazy: jax.Array        # (N, F) bool — per-row feature acquisition
+                                # bitset (CEGB lazy costs; (1,1) dummy when off)
     round_idx: jax.Array        # () i32 — for PRNG folding (bynode / extra_trees)
     best_gain: jax.Array
     best_feat: jax.Array
@@ -299,7 +301,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               interaction_groups: Optional[jax.Array] = None,
               key: Optional[jax.Array] = None,
               packed=None, forced=None, cegb_coupled=None,
-              cegb_used=None,
+              cegb_used=None, cegb_lazy=None, cegb_lazy_pen=None,
               gh_scales: Optional[jax.Array] = None,
               mesh=None, row_axis: Optional[str] = None,
               ) -> Tuple[TreeArrays, jax.Array]:
@@ -337,6 +339,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     use_output = use_mono or use_smooth
     use_bynode = params.bynode_fraction < 1.0 and key is not None
     use_cegb = params.has_cegb
+    use_lazy = use_cegb and cegb_lazy is not None and cegb_lazy_pen is not None
     use_extra = params.extra_trees and key is not None
     BIG = jnp.asarray(1e30, f32)
 
@@ -357,14 +360,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         path_smooth=params.path_smooth,
     )
 
-    def cegb_pen(counts, used_mask):
+    def cegb_pen(counts, used_mask, lazy_unused=None):
         """(R, F) CEGB gain penalty (DeltaGain, cegb hpp:80): tradeoff *
-        (penalty_split * n_leaf + coupled[f] * not-yet-used)."""
+        (penalty_split * n_leaf + coupled[f] * not-yet-used +
+        lazy[f] * rows-in-leaf-not-yet-charged-for-f)."""
         pen = params.cegb_tradeoff * params.cegb_penalty_split * counts[:, None]
         if cegb_coupled is not None:
             pen = pen + params.cegb_tradeoff * cegb_coupled[None, :] * \
                 (~used_mask)[None, :]
+        if lazy_unused is not None:
+            pen = pen + params.cegb_tradeoff * cegb_lazy_pen[None, :] * \
+                lazy_unused
         return jnp.broadcast_to(pen, (counts.shape[0], F))
+
+    def lazy_unused_counts(used, slot, nslots):
+        """(R, F) count of rows in each slot's leaf that have NOT yet paid
+        feature f's lazy acquisition cost (CalculateOndemandCosts,
+        cegb hpp:140: rows outside the feature_used_in_data_ bitset)."""
+        sv = jnp.where(slot >= 0, slot, nslots)
+        return jax.ops.segment_sum(
+            (~used).astype(jnp.float32), sv,
+            num_segments=nslots + 1)[:nslots]
 
     def node_col_mask(base_mask, used_feat_rows, rkey, rows):
         """Per-node feature mask: tree-level sampling & interaction-allowed &
@@ -485,9 +501,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               rows=1)
     cegb_used0 = (cegb_used if cegb_used is not None
                   else jnp.zeros(F, bool)) if use_cegb else None
+    root_lazy = (lazy_unused_counts(cegb_lazy, jnp.zeros(N, i32), 1)
+                 if use_lazy else None)
     root_split = find_splits(
         root_hist, root_g[None], root_h[None], root_c[None], col_mask=root_mask,
-        cegb_penalty=cegb_pen(root_c[None], cegb_used0) if use_cegb else None,
+        cegb_penalty=(cegb_pen(root_c[None], cegb_used0, root_lazy)
+                      if use_cegb else None),
         out_lo=(-BIG[None]) if use_output else None,
         out_hi=(BIG[None]) if use_output else None,
         slot_depth=jnp.zeros(1, i32) if use_mono else None,
@@ -524,6 +543,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         adv_vmax=jnp.full((L, F, Bmax) if use_amono else (1, 1, 1), BIG, f32),
         used_feat=used0,
         cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
+        cegb_lazy=(cegb_lazy if use_lazy else jnp.zeros((1, 1), bool)),
         round_idx=jnp.asarray(0, i32),
         best_gain=jnp.full(L, NEG_INF, hdt).at[0].set(root_split.gain[0]),
         best_feat=jnp.zeros(L, i32).at[0].set(root_split.feature[0]),
@@ -1025,6 +1045,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 f_m = jnp.where(pair_valid, feat, F + 1)
                 st2 = st2._replace(cegb_used=st2.cegb_used.at[f_m].set(
                     True, mode="drop"))
+            if use_lazy:
+                # charge the split leaves' rows for their split feature
+                # (UpdateLeafBestSplits -> InsertBitset, cegb hpp:126)
+                lz_chosen = jnp.zeros(L, bool).at[old_idx].set(
+                    pair_valid, mode="drop")
+                lz_feat = jnp.zeros(L, i32).at[old_idx].set(feat, mode="drop")
+                rch = lz_chosen[st.leaf_id]
+                rft = lz_feat[st.leaf_id]
+                mark = (jnp.arange(F, dtype=i32)[None, :] == rft[:, None]) \
+                    & rch[:, None]
+                st2 = st2._replace(cegb_lazy=st2.cegb_lazy | mark)
 
             # ---- histogram subtraction for the larger siblings ----
             smaller_id = smaller_id_pre
@@ -1063,6 +1094,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             rkey = (jax.random.fold_in(key, 2 + st.round_idx)
                     if key is not None else None)
             rows2 = L if use_imono else 2 * S
+            len_ids2 = rows2
             cmask2 = node_col_mask(st.col_mask[None, :],
                                    st2.used_feat[ids2] if use_inter
                                    else jnp.zeros((rows2, F), bool),
@@ -1080,8 +1112,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               parent_out=st2.leaf_out[ids2] if use_output else None,
                               extra_key=(jax.random.fold_in(key, 100000 + st.round_idx)
                                          if use_extra else None),
-                              cegb_penalty=(cegb_pen(st2.cnt[ids2],
-                                                     st2.cegb_used)
+                              cegb_penalty=(cegb_pen(
+                                  st2.cnt[ids2], st2.cegb_used,
+                                  lazy_unused_counts(
+                                      st2.cegb_lazy,
+                                      jnp.full(L, -1, i32).at[
+                                          jnp.where(valid2, ids2, drop)].set(
+                                          jnp.arange(len_ids2, dtype=i32),
+                                          mode="drop")[st2.leaf_id],
+                                      len_ids2) if use_lazy else None)
                                             if use_cegb else None))
             ids2_m = jnp.where(valid2, ids2, drop)
             st2 = st2._replace(
@@ -1143,4 +1182,6 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_parent=final.leaf_parent, num_leaves=final.num_leaves_cur,
         leaf_depth=final.depth,
     )
+    if use_lazy:
+        return tree, final.leaf_id[:N], final.cegb_lazy
     return tree, final.leaf_id[:N]

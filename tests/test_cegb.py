@@ -42,8 +42,39 @@ def test_split_penalty_shrinks_trees():
     assert l1 < l0
 
 
-def test_lazy_penalty_raises():
+def test_lazy_penalty_suppresses_costly_features():
+    """Lazy (per-row on-demand) acquisition costs: a feature's cost is the
+    sum over the leaf's rows that have NOT paid it yet
+    (CalculateOndemandCosts, cegb hpp:140); expensive features are avoided
+    while cheap ones stay usable."""
+    X, y = _data(seed=6)
+    b0 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=8)
+    b1 = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                    "cegb_penalty_feature_lazy": [0.0] * 5 + [1e5] * 5},
+                   lgb.Dataset(X, label=y), num_boost_round=8)
+    imp0 = b0.feature_importance()
+    imp1 = b1.feature_importance()
+    assert imp0[5:].sum() > 0, "baseline should use the signal features"
+    assert imp1[5:].sum() < imp0[5:].sum()
+    # cheap features keep working
+    assert imp1[:5].sum() > 0
+
+
+def test_lazy_penalty_charges_rows_once():
+    """Once a leaf's rows have paid a feature, re-splitting THOSE rows on
+    it is free — with a moderate per-row cost the model still trains (the
+    first profitable acquisition amortizes; reference: the
+    feature_used_in_data_ bitset persists across trees)."""
+    X, y = _data(seed=7)
+    pen = [0.05] * 10
+    b = lgb.train({**BASE, "cegb_penalty_feature_lazy": pen},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    pred = np.asarray(b.predict(X))
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_lazy_penalty_wrong_length_raises():
     X, y = _data(seed=6)
     with pytest.raises(lgb.LightGBMError):
-        lgb.train({**BASE, "cegb_penalty_feature_lazy": [1.0] * 10},
+        lgb.train({**BASE, "cegb_penalty_feature_lazy": [1.0]},
                   lgb.Dataset(X, label=y), num_boost_round=2)

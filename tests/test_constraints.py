@@ -128,11 +128,9 @@ def test_path_smooth_and_extra_trees():
 def test_unimplemented_params_raise():
     X = np.random.rand(100, 3)
     y = np.random.rand(100)
-    # linear_tree / use_quantized_grad / forcedsplits_filename / cegb split+
-    # coupled penalties are implemented now (see their test files); the lazy
-    # cegb penalty remains unimplemented and must fail loudly, as must invalid
-    # enums and a missing forced-splits file
-    for bad in ({"cegb_penalty_feature_lazy": [1.0, 1.0, 1.0]},
+    # invalid enums, wrong-sized penalty vectors and missing forced-splits
+    # files must fail loudly
+    for bad in ({"cegb_penalty_feature_lazy": [1.0]},          # wrong length
                 {"hist_precision": "quad"},
                 {"forcedsplits_filename": "/nonexistent/f.json"}):
         ds = lgb.Dataset(X, label=y)
