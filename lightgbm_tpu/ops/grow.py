@@ -130,6 +130,9 @@ class _GrowState(NamedTuple):
                                 # (IntermediateLeafConstraints::leaf_is_in_monotone_subtree_)
     adv_vmin: jax.Array         # (L, F, Bmax) f32 — advanced-method constraint
     adv_vmax: jax.Array         # slabs (see advanced_constraint_slabs)
+    adv_split_ok: jax.Array     # (L, F) bool — sticky per-(leaf, feature)
+                                # is_splittable_ (advanced method; (1,1) dummy
+                                # when off). Children inherit, scans update.
     used_feat: jax.Array        # (L, F) bool — features on the leaf's path (interaction)
     cegb_used: jax.Array        # (F,) bool — features used anywhere in the model
     cegb_lazy: jax.Array        # (N, F) bool — per-row feature acquisition
@@ -511,7 +514,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         out_hi=(BIG[None]) if use_output else None,
         slot_depth=jnp.zeros(1, i32) if use_mono else None,
         parent_out=root_out[None] if use_output else None,
-        extra_key=jax.random.fold_in(key, 1) if use_extra else None)
+        extra_key=jax.random.fold_in(key, 1) if use_extra else None,
+        adv_bounds=((jnp.full((1, F, Bmax), -BIG, f32),
+                     jnp.full((1, F, Bmax), BIG, f32))
+                    if use_amono else None))
 
     hist = jnp.zeros((L, G, Bmax, 2), hdt).at[0].set(root_hist[0])
     state = _GrowState(
@@ -541,6 +547,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         leaf_in_mono=jnp.zeros(L if use_imono else 1, bool),
         adv_vmin=jnp.full((L, F, Bmax) if use_amono else (1, 1, 1), -BIG, f32),
         adv_vmax=jnp.full((L, F, Bmax) if use_amono else (1, 1, 1), BIG, f32),
+        adv_split_ok=(jnp.ones((L, F), bool).at[0].set(root_split.feat_ok[0])
+                      if use_amono else jnp.ones((1, 1), bool)),
         used_feat=used0,
         cegb_used=(cegb_used0 if use_cegb else jnp.zeros(1, bool)),
         cegb_lazy=(cegb_lazy if use_lazy else jnp.zeros((1, 1), bool)),
@@ -806,16 +814,30 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     nd = jnp.where(val, pair_node[i], L)
                     o_c = pair_old[i]                       # unclamped index
                     if use_amono:
-                        # per-threshold bounds from the PRE-round slabs — the
-                        # bounds the scan used when it chose this split
+                        # bounds the WINNING scan used when it chose this
+                        # split: the reverse scan walks the cumulative
+                        # segments per threshold, the forward scan's
+                        # cumulative indices never advance so its left child
+                        # reads bin 0 and its right child the whole-slab
+                        # extrema (CumulativeFeatureConstraint::Update only
+                        # decrements; default_left records the winner)
                         bbA = jnp.arange(Bmax)
                         vmn = st.adv_vmin[o_c, feat[i]]
                         vmx = st.adv_vmax[o_c, feat[i]]
                         left_m = bbA <= thr[i]
-                        a_lo_l = jnp.max(jnp.where(left_m, vmn, -BIG))
-                        a_hi_l = jnp.min(jnp.where(left_m, vmx, BIG))
-                        a_lo_r = jnp.max(jnp.where(~left_m, vmn, -BIG))
-                        a_hi_r = jnp.min(jnp.where(~left_m, vmx, BIG))
+                        was_rev = (dirf[i] & 1) != 0        # DIR_DEFAULT_LEFT
+                        a_lo_l = jnp.where(
+                            was_rev, jnp.max(jnp.where(left_m, vmn, -BIG)),
+                            vmn[0])
+                        a_hi_l = jnp.where(
+                            was_rev, jnp.min(jnp.where(left_m, vmx, BIG)),
+                            vmx[0])
+                        a_lo_r = jnp.where(
+                            was_rev, jnp.max(jnp.where(~left_m, vmn, -BIG)),
+                            jnp.max(vmn))
+                        a_hi_r = jnp.where(
+                            was_rev, jnp.min(jnp.where(~left_m, vmx, BIG)),
+                            jnp.min(vmx))
                         cat_sp = (dirf[i] & 2) != 0
                         a_lo_l = jnp.where(cat_sp, -BIG, a_lo_l)
                         a_hi_l = jnp.where(cat_sp, BIG, a_hi_l)
@@ -1083,6 +1105,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 # RecomputeBestSplitForLeaf redraws GetByNode only for
                 # recomputed leaves, serial_tree_learner.cpp:1053)
                 ids2 = jnp.arange(L)
+                if use_amono:
+                    # fresh children inherit the parent's sticky
+                    # is_splittable_ flags (FindBestSplits propagates
+                    # parent-unsplittable to both children without scanning,
+                    # serial_tree_learner.cpp:399)
+                    st2 = st2._replace(adv_split_ok=st2.adv_split_ok.at[
+                        new_idx].set(st2.adv_split_ok[pair_old], mode="drop"))
                 child2 = jnp.zeros(L, bool) \
                     .at[old_idx].set(pair_valid, mode="drop") \
                     .at[new_idx].set(pair_valid, mode="drop")
@@ -1105,6 +1134,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                               col_mask=cmask2,
                               adv_bounds=((st2.adv_vmin[ids2],
                                            st2.adv_vmax[ids2])
+                                          if use_amono else None),
+                              splittable=(st2.adv_split_ok[ids2]
                                           if use_amono else None),
                               out_lo=st2.out_lo[ids2] if use_output else None,
                               out_hi=st2.out_hi[ids2] if use_output else None,
@@ -1132,6 +1163,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 best_left_h=st2.best_left_h.at[ids2_m].set(res.left_sum_h, mode="drop"),
                 best_left_c=st2.best_left_c.at[ids2_m].set(res.left_count, mode="drop"),
             )
+            if use_amono:
+                # flags refresh only for leaves that actually rescanned
+                # (each FindBestThreshold call rewrites is_splittable_,
+                # feature_histogram.hpp:196; skipped leaves keep theirs)
+                st2 = st2._replace(adv_split_ok=jnp.where(
+                    valid2[:, None], res.feat_ok, st2.adv_split_ok))
             return st2._replace(num_leaves_cur=cur + k, progressed=k > 0,
                                 round_idx=st.round_idx + 1)
 

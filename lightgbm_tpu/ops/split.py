@@ -50,6 +50,10 @@ class SplitResult(NamedTuple):
     right_sum_g: jax.Array
     right_sum_h: jax.Array
     right_count: jax.Array
+    # (S, F) bool — per-feature "a candidate passed the gain gate" mask
+    # (FeatureHistogram::is_splittable_, set by the scans and consumed by
+    # the advanced-monotone rescan cache). None unless adv_bounds was given.
+    feat_ok: Optional[jax.Array] = None
 
 
 def _threshold_l1(s, l1):
@@ -206,6 +210,7 @@ def find_best_splits(
     extra_key: Optional[jax.Array] = None,   # PRNG key — extra_trees random thresholds
     cegb_penalty: Optional[jax.Array] = None,  # (S, F) gain penalty (CEGB)
     adv_bounds=None,   # (v_min, v_max) (S, F, Bmax) — advanced monotone slabs
+    splittable=None,   # (S, F) bool — sticky is_splittable mask (advanced only)
 ) -> SplitResult:
     """Monotone constraints use the reference's "basic" method
     (monotone_constraints.hpp BasicLeafConstraints): candidate outputs are clipped
@@ -231,10 +236,20 @@ def find_best_splits(
         or (adv_bounds is not None)
     if adv_bounds is not None:
         # ADVANCED monotone method: per-threshold child bounds from the
-        # constraint slabs (monotone_constraints.hpp:859 — the scan's
-        # constraint varies with the threshold)
+        # constraint slabs (monotone_constraints.hpp:859). Only the REVERSE
+        # scan walks the piecewise segments: CumulativeFeatureConstraint's
+        # Update() only ever DECREMENTS its indices, so the forward scan's
+        # indices stay frozen at their init position — its left child reads
+        # the first segment's values and its right child the whole-array
+        # extrema, constant across thresholds (monotone_constraints.hpp:147
+        # Update + InitCumulativeConstraints(REVERSE=false); verified
+        # empirically against an instrumented stock CLI).
         a_lo_l, a_hi_l, a_lo_r, a_hi_r = adv_child_bounds(
             adv_bounds[0], adv_bounds[1], -NEG_INF)
+        adv_rev = (a_lo_l, a_hi_l, a_lo_r, a_hi_r)
+        adv_fwd = (adv_bounds[0][..., 0:1], adv_bounds[1][..., 0:1],
+                   jnp.max(adv_bounds[0], -1, keepdims=True),
+                   jnp.min(adv_bounds[1], -1, keepdims=True))
     mono_b = monotone[None, :, None] if monotone is not None else None
     lo_b = out_lo[:, None, None] if out_lo is not None else -jnp.inf
     hi_b = out_hi[:, None, None] if out_hi is not None else jnp.inf
@@ -277,16 +292,17 @@ def find_best_splits(
     miss_c = nan_c + z_c
     has_miss = has_nan | has_mz
 
-    def split_gain(lg, lh, lc, rc):
+    def split_gain(lg, lh, lc, rc, adv=None):
         rg, rh = pg - lg, ph - lh
         if use_output_gain:
             if adv_bounds is not None:
+                b_lo_l, b_hi_l, b_lo_r, b_hi_r = adv
                 ol, _ = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
-                    a_lo_l, a_hi_l, path_smooth, po_b)
+                    b_lo_l, b_hi_l, path_smooth, po_b)
                 _, orr = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
-                    a_lo_r, a_hi_r, path_smooth, po_b)
+                    b_lo_r, b_hi_r, path_smooth, po_b)
             else:
                 ol, orr = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
@@ -339,10 +355,14 @@ def find_best_splits(
     lc_fwd = cc_eff
     rc_fwd = pc - cc_eff
     # rev: missing left — left side = cumsum at t + missing-bin contents
-    gain_rev = split_gain(cg_eff + miss_g, ch_eff + miss_h, lc_rev, rc_rev)
+    adv_r = adv_rev if adv_bounds is not None else None
+    adv_f = adv_fwd if adv_bounds is not None else None
+    gain_rev = split_gain(cg_eff + miss_g, ch_eff + miss_h, lc_rev, rc_rev,
+                          adv=adv_r)
     # fwd: missing right — left side = plain cumsum at t (missing-typed
     # features only)
-    gain_fwd = jnp.where(has_miss, split_gain(cg_eff, ch_eff, lc_fwd, rc_fwd),
+    gain_fwd = jnp.where(has_miss,
+                         split_gain(cg_eff, ch_eff, lc_fwd, rc_fwd, adv=adv_f),
                          NEG_INF)
     # rev thresholds: t in [0, data_bins-2] minus the skipped default-bin
     # position for zero-as-missing; fwd adds t = data_bins-1 ("NaN vs the
@@ -373,6 +393,26 @@ def find_best_splits(
         return num_rel
 
     rel_rev, rel_fwd = _rel(gain_rev), _rel(gain_fwd)
+    if splittable is not None:
+        # advanced-monotone rescans skip features whose LAST scan of this
+        # leaf found no candidate above the gain gate (the sticky
+        # FeatureHistogram::is_splittable_ — RecomputeBestSplitForLeaf
+        # `continue`s them, serial_tree_learner.cpp:1083, and FindBestSplits
+        # propagates parent-false to fresh children, :399)
+        sp_b = splittable[:, :, None]
+        rel_rev = jnp.where(sp_b, rel_rev, NEG_INF)
+        rel_fwd = jnp.where(sp_b, rel_fwd, NEG_INF)
+    if adv_bounds is not None:
+        # is_splittable_ update: any threshold whose gain beats
+        # min_gain_shift (feature_histogram.hpp:919 — set before the cegb
+        # adjustment; the reference also flags before the monotone penalty,
+        # which coincides with this for the default penalty=0);
+        # categorical features are left unfiltered
+        feat_ok = (jnp.any(rel_rev > min_gain_to_split, axis=-1)
+                   | jnp.any(rel_fwd > min_gain_to_split, axis=-1)
+                   | layout.is_cat[None, :])
+    else:
+        feat_ok = None
 
     def _pick_num_best(rel_rev, rel_fwd):
         """Per-(slot, feature) winner with the reference's scan-order
@@ -419,7 +459,7 @@ def find_best_splits(
             threshold=t.astype(jnp.int32), dir_flags=dir_flags.astype(jnp.int32),
             left_sum_g=lg, left_sum_h=lh, left_count=lc,
             right_sum_g=parent_g - lg, right_sum_h=parent_h - lh,
-            right_count=parent_c - lc)
+            right_count=parent_c - lc, feat_ok=feat_ok)
 
     # ---------------- categorical ----------------
     is_cat = layout.is_cat[None, :, None]
@@ -538,7 +578,7 @@ def find_best_splits(
         dir_flags=dir_flags.astype(jnp.int32),
         left_sum_g=lg, left_sum_h=lh, left_count=lc,
         right_sum_g=parent_g - lg, right_sum_h=parent_h - lh,
-        right_count=parent_c - lc,
+        right_count=parent_c - lc, feat_ok=feat_ok,
     )
 
 
