@@ -16,6 +16,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Dataset", "Booster", "train", "cv", "CVBooster", "init_distributed",
+    "train_distributed",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "LightGBMError", "register_logger",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
@@ -35,4 +36,7 @@ def __getattr__(name):
     if name == "init_distributed":
         from .parallel.launcher import init_distributed
         return init_distributed
+    if name == "train_distributed":
+        from .parallel.cluster import train_distributed
+        return train_distributed
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

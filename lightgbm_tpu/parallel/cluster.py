@@ -1,0 +1,154 @@
+"""Local multi-process cluster launcher — the Dask orchestration analog.
+
+Reference: python-package/lightgbm/dask.py — `_train` (dask.py:124-215)
+discovers the workers holding data parts, assembles the `machines=` list,
+opens ports, and runs `_train_part` on every worker; the model of one worker
+becomes the result. The TPU-native redesign:
+
+  * worker discovery / machines list  -> a free localhost port +
+    `jax.distributed.initialize` (the process mesh IS the cluster)
+  * `client.scatter` of data parts    -> sharded FILE ingest: every rank
+    loads only its own row range (parallel/dist_data.py; queries stay
+    whole on one rank for ranking)
+  * `_train_part` per worker          -> the SAME SPMD `lgb.train` call in
+    every process with `tree_learner=data|feature|voting`
+  * result from one worker            -> rank 0 serializes the model (all
+    ranks hold identical trees — histogram psum makes training replicated)
+
+`train_distributed` below packages that recipe: it spawns N local worker
+processes (one per CPU device group — the same topology the multi-host
+tests and the driver's `dryrun_multichip` validate), trains over the file
+shards, and returns the finished Booster in the parent process. On a real
+TPU pod, run the body yourself instead: one process per host executing
+`lgb.init_distributed()` + `lgb.train(...)` (see parallel/launcher.py) —
+there is deliberately no pod-ssh automation here.
+
+The sklearn-style `DaskLGBM{Classifier,Regressor,Ranker}` wrappers are NOT
+mirrored: they exist to adapt dask collections to sklearn's fit(X, y), but
+the scatter mechanism here is file sharding, so the natural unit is the
+data path + params dict that `train_distributed` already takes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import LightGBMError, log_info
+
+_WORKER = r"""
+import json, os, sys
+spec = json.load(open(sys.argv[1]))
+rank = int(sys.argv[2])
+os.environ.pop("XLA_FLAGS", None)
+os.environ["JAX_PLATFORMS"] = spec["platform"]
+import jax
+jax.config.update("jax_platforms", spec["platform"])
+try:
+    from jax.extend.backend import clear_backends; clear_backends()
+except Exception:
+    pass
+jax.distributed.initialize(spec["coordinator"], num_processes=spec["nproc"],
+                           process_id=rank)
+if spec.get("cache_dir"):
+    jax.config.update("jax_compilation_cache_dir", spec["cache_dir"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(spec["data"])
+valid_sets = [lgb.Dataset(p, reference=ds) for p in spec["valid"]]
+evals = {}
+bst = lgb.train(spec["params"], ds, num_boost_round=spec["rounds"],
+                valid_sets=valid_sets,
+                valid_names=spec["valid_names"] or None,
+                callbacks=[lgb.record_evaluation(evals)] if valid_sets else None)
+if rank == 0:
+    json.dump({"model": bst.model_to_string(), "evals": evals,
+               "best_iteration": bst.best_iteration},
+              open(sys.argv[3], "w"))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def train_distributed(params: Dict[str, Any], data_path: str,
+                      num_boost_round: int = 100,
+                      num_processes: int = 2,
+                      valid_paths: Optional[List[str]] = None,
+                      valid_names: Optional[List[str]] = None,
+                      platform: str = "cpu",
+                      timeout: float = 1200.0,
+                      python: str = sys.executable):
+    """Train over `num_processes` local worker processes, each ingesting its
+    own row shard of `data_path` (and of each `valid_paths` entry), and
+    return the finished Booster.
+
+    The dask.py `_train` analog for one machine: workers connect through
+    `jax.distributed`, shard the file by rows (whole query groups per rank
+    for ranking objectives), and run the standard data-parallel SPMD
+    training program. Defaults to `tree_learner=data` when params don't
+    choose one. `evals_result_` and `best_iteration` from rank 0 are set on
+    the returned Booster."""
+    if num_processes < 2:
+        raise LightGBMError("train_distributed needs num_processes >= 2; "
+                            "call lgb.train directly for one process")
+    if not Path(data_path).exists():
+        raise LightGBMError(f"data_path not found: {data_path}")
+    params = dict(params)
+    params.setdefault("tree_learner", "data")
+    spec = {
+        "coordinator": f"localhost:{_free_port()}",
+        "nproc": num_processes,
+        "platform": platform,
+        "cache_dir": "/tmp/lgb_tpu_jax_cache",
+        "params": params,
+        "data": str(data_path),
+        "valid": [str(p) for p in (valid_paths or [])],
+        "valid_names": list(valid_names) if valid_names else None,
+        "rounds": int(num_boost_round),
+    }
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = platform
+    repo = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.TemporaryDirectory(prefix="lgb_tpu_cluster_") as td:
+        spec_path = os.path.join(td, "spec.json")
+        out_path = os.path.join(td, "result.json")
+        with open(spec_path, "w") as fh:
+            json.dump(spec, fh)
+        procs = [subprocess.Popen(
+            [python, "-c", _WORKER, spec_path, str(r), out_path],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(num_processes)]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=timeout)[0].decode())
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for r, (p, o) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                raise LightGBMError(
+                    f"worker {r}/{num_processes} failed "
+                    f"(exit {p.returncode}):\n{o[-4000:]}")
+        with open(out_path) as fh:
+            result = json.load(fh)
+    from ..basic import Booster
+    bst = Booster(model_str=result["model"])
+    bst.evals_result_ = result["evals"]
+    if result.get("best_iteration"):
+        bst.best_iteration = result["best_iteration"]
+    log_info(f"train_distributed: {num_processes} workers done, "
+             f"{bst.num_trees()} trees")
+    return bst

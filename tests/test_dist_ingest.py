@@ -291,3 +291,25 @@ def test_shard_loading_skips_blank_and_comment_lines(tmp_path):
     wc = np.concatenate([q[2]["weight"] for q in parts])
     np.testing.assert_allclose(wc, w)
     np.testing.assert_allclose(np.concatenate([q[1] for q in parts]), y)
+
+
+@pytest.mark.slow
+def test_train_distributed_launcher(tmp_path):
+    """lgb.train_distributed — the dask.py `_train` analog (dask.py:124-215):
+    spawns local workers, shards the file by rows, trains data-parallel, and
+    returns rank 0's Booster with evals_result_ attached. Must reproduce the
+    single-process model structurally (same psum'd histograms)."""
+    data = str(tmp_path / "train.csv")
+    _write_csv(data)
+    valid = str(tmp_path / "valid.csv")
+    _write_csv(valid, n=800, seed=9)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "hist_backend": "stream"}
+    bst = lgb.train_distributed(params, data, num_boost_round=5,
+                                num_processes=2, valid_paths=[valid],
+                                valid_names=["va"])
+    assert bst.num_trees() == 5
+    assert "va" in bst.evals_result_ and \
+        len(next(iter(bst.evals_result_["va"].values()))) == 5
+    ref = lgb.train(params, lgb.Dataset(data), num_boost_round=5)
+    _models_structurally_equal(ref.model_to_string(), bst.model_to_string())
