@@ -21,6 +21,7 @@ __all__ = [
     "LightGBMError", "register_logger",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
+    "plot_split_value_histogram",
 ]
 
 

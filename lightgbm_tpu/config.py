@@ -411,7 +411,11 @@ class Config:
     hist_precision: str = "auto"   # auto = single on the TPU stream
                                    # backend (reference GPU default,
                                    # gpu_use_dp=false); mixed = ~f32
-    max_splits_per_round: int = 64
+    # 0 = auto: 1 (exact best-first, the reference's leaf-wise order) on CPU
+    # backends, 64 (batched rounds feeding the MXU) on TPU / stream. Batched
+    # growth can deviate from best-first only when the leaf budget runs out
+    # mid-round (children of just-split leaves aren't candidates yet).
+    max_splits_per_round: int = 0
     mesh_shape: str = ""
     tpu_dtype: str = "f32"
 

@@ -403,7 +403,8 @@ class GBDT:
         and the (L, T) leaf one-hot resident in VMEM (~16 MB/core); the block
         row count steps down to 256 for wide layouts (stream_block_rows)."""
         L = max(self.config.num_leaves, 2)
-        S = 2 * min(max(1, self.config.max_splits_per_round), max(L - 1, 1))
+        cfg_s = self.config.max_splits_per_round
+        S = 2 * min(cfg_s if cfg_s > 0 else 64, max(L - 1, 1))
         G = self.dd.num_groups
         Bpad = -(-self.dd.max_bins // 8) * 8
         hist_bytes = G * Bpad * S * 4
@@ -412,17 +413,32 @@ class GBDT:
                 and onehot_bytes <= 8 * 2 ** 20
                 and S <= 2 * 255)   # slot ids must stay bf16-exact (<= 255)
 
+    def _resolved_max_splits(self) -> int:
+        """Per-round split budget. auto (0): 1 on CPU backends — exact
+        best-first, byte-faithful to the reference's leaf-wise order — and
+        64 on TPU / stream, where batched rounds keep the MXU fed. Batched
+        growth deviates from best-first only at the leaf-budget boundary:
+        the last round's slots go to current candidates while stock may
+        split higher-gain CHILDREN of leaves split moments earlier.
+        Intermediate/advanced monotone constraints force 1 regardless (each
+        split tightens other leaves' bounds before the next is chosen)."""
+        c = self.config
+        if self._monotone_intermediate():
+            return 1
+        if c.max_splits_per_round > 0:
+            return c.max_splits_per_round
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        if on_tpu or self._voting_planned \
+                or self._resolve_hist_backend() == "stream":
+            return 64   # PV-Tree is round-batched by design (top-2k election)
+        return 1
+
     def _make_grow_params(self) -> GrowParams:
         c = self.config
         return GrowParams(
             num_leaves=max(c.num_leaves, 2),
             max_depth=c.max_depth,
-            # intermediate/advanced monotone constraints are only sound under
-            # the reference's serial split order (each split tightens other
-            # leaves' bounds and re-finds their best splits before the next
-            # split is chosen) — force one split per round for them
-            max_splits_per_round=(1 if self._monotone_intermediate()
-                                  else max(1, c.max_splits_per_round)),
+            max_splits_per_round=self._resolved_max_splits(),
             lambda_l1=c.lambda_l1, lambda_l2=c.lambda_l2,
             min_data_in_leaf=c.min_data_in_leaf,
             min_sum_hessian_in_leaf=c.min_sum_hessian_in_leaf,

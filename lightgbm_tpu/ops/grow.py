@@ -361,6 +361,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         monotone=monotone if use_mono else None,
         monotone_penalty=params.monotone_penalty,
         path_smooth=params.path_smooth,
+        max_delta_step=params.max_delta_step,
     )
 
     def cegb_pen(counts, used_mask, lazy_unused=None):
@@ -846,17 +847,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         ol_i, _ = constrained_child_outputs(
                             lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
                             params.lambda_l1, params.lambda_l2,
-                            a_lo_l, a_hi_l, params.path_smooth, lov[o_c])
+                            a_lo_l, a_hi_l, params.path_smooth, lov[o_c],
+                            params.max_delta_step)
                         _, or_i = constrained_child_outputs(
                             lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
                             params.lambda_l1, params.lambda_l2,
-                            a_lo_r, a_hi_r, params.path_smooth, lov[o_c])
+                            a_lo_r, a_hi_r, params.path_smooth, lov[o_c],
+                            params.max_delta_step)
                     else:
                         ol_i, or_i = constrained_child_outputs(
                             lg[i], lh[i], lc[i], rg[i], rh[i], rc[i],
                             params.lambda_l1, params.lambda_l2,
                             lo_v[o_c], hi_v[o_c],
-                            params.path_smooth, lov[o_c])
+                            params.path_smooth, lov[o_c],
+                            params.max_delta_step)
                     lov = lov.at[o].set(ol_i.astype(f32), mode="drop") \
                              .at[nw].set(or_i.astype(f32), mode="drop")
                     anc_o_l = anc_l[o_c]                    # PROPER ancestors
@@ -1039,7 +1043,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 po = st.leaf_out[pair_old]
                 ol, orr = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, params.lambda_l1, params.lambda_l2,
-                    lo_p, hi_p, params.path_smooth, po)
+                    lo_p, hi_p, params.path_smooth, po,
+                    params.max_delta_step)
                 mid = (ol + orr) / 2.0
                 if use_mono:
                     mt = monotone[feat]

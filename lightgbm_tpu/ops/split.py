@@ -97,11 +97,17 @@ def monotone_penalty_factor(depth, penalty):
 
 
 def constrained_child_outputs(lg, lh, lc, rg, rh, rc, l1, l2, lo, hi,
-                              path_smooth=0.0, parent_out=None):
+                              path_smooth=0.0, parent_out=None,
+                              max_delta_step=0.0):
     """Child outputs under monotone bounds [lo, hi] and optional path smoothing —
-    used both inside the split scan and to propagate bounds after a split."""
+    used both inside the split scan and to propagate bounds after a split.
+    Clamp order matches CalculateSplittedLeafOutput (feature_histogram.hpp):
+    ridge output -> max_delta_step clamp -> smoothing -> monotone clip."""
     ol = -_threshold_l1(lg, l1) / (lh + l2 + EPS_HESS)
     orr = -_threshold_l1(rg, l1) / (rh + l2 + EPS_HESS)
+    if max_delta_step > 0.0:
+        ol = jnp.clip(ol, -max_delta_step, max_delta_step)
+        orr = jnp.clip(orr, -max_delta_step, max_delta_step)
     if path_smooth > 0.0 and parent_out is not None:
         ol = smooth_output(ol, lc, parent_out, path_smooth)
         orr = smooth_output(orr, rc, parent_out, path_smooth)
@@ -211,6 +217,7 @@ def find_best_splits(
     cegb_penalty: Optional[jax.Array] = None,  # (S, F) gain penalty (CEGB)
     adv_bounds=None,   # (v_min, v_max) (S, F, Bmax) — advanced monotone slabs
     splittable=None,   # (S, F) bool — sticky is_splittable mask (advanced only)
+    max_delta_step: float = 0.0,
 ) -> SplitResult:
     """Monotone constraints use the reference's "basic" method
     (monotone_constraints.hpp BasicLeafConstraints): candidate outputs are clipped
@@ -233,7 +240,7 @@ def find_best_splits(
     ph = parent_h[:, None, None]
     pc = parent_c[:, None, None]
     use_output_gain = (monotone is not None) or (path_smooth > 0.0) \
-        or (adv_bounds is not None)
+        or (adv_bounds is not None) or (max_delta_step > 0.0)
     if adv_bounds is not None:
         # ADVANCED monotone method: per-threshold child bounds from the
         # constraint slabs (monotone_constraints.hpp:859). Only the REVERSE
@@ -299,14 +306,14 @@ def find_best_splits(
                 b_lo_l, b_hi_l, b_lo_r, b_hi_r = adv
                 ol, _ = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
-                    b_lo_l, b_hi_l, path_smooth, po_b)
+                    b_lo_l, b_hi_l, path_smooth, po_b, max_delta_step)
                 _, orr = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2,
-                    b_lo_r, b_hi_r, path_smooth, po_b)
+                    b_lo_r, b_hi_r, path_smooth, po_b, max_delta_step)
             else:
                 ol, orr = constrained_child_outputs(
                     lg, lh, lc, rg, rh, rc, lambda_l1, lambda_l2, lo_b, hi_b,
-                    path_smooth, po_b)
+                    path_smooth, po_b, max_delta_step)
             gain = leaf_gain_given_output(lg, lh, lambda_l1, lambda_l2, ol) + \
                    leaf_gain_given_output(rg, rh, lambda_l1, lambda_l2, orr)
             if mono_b is not None:
@@ -375,8 +382,17 @@ def find_best_splits(
                          gain_rev, NEG_INF)
     gain_fwd = jnp.where((bin_iota < fwd_hi) & ~fwd_skip, gain_fwd, NEG_INF)
 
-    # relative (vs parent) gain so per-feature penalties compose before the argmax
-    parent_term_num = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
+    # relative (vs parent) gain so per-feature penalties compose before the
+    # argmax. Under max_delta_step the parent's gain shift is evaluated at
+    # its CLAMPED output (BeforeNumerical -> GetLeafGain<USE_MAX_OUTPUT>),
+    # so candidate gates see the same shift stock's scan does.
+    if max_delta_step > 0.0:
+        p_out_c = leaf_output(parent_g, parent_h, lambda_l1, lambda_l2,
+                              max_delta_step)
+        parent_term_num = leaf_gain_given_output(
+            parent_g, parent_h, lambda_l1, lambda_l2, p_out_c)
+    else:
+        parent_term_num = leaf_term(parent_g, parent_h, lambda_l1, lambda_l2)
 
     def _rel(num_gain):
         num_rel = num_gain - parent_term_num[:, None, None]
@@ -467,8 +483,14 @@ def find_best_splits(
 
     def split_gain_cat(lg, lh, lc):
         rg, rh, rc = pg - lg, ph - lh, pc - lc
-        gain = leaf_term(lg, lh, lambda_l1, cat_l2_total) + \
-               leaf_term(rg, rh, lambda_l1, cat_l2_total)
+        if max_delta_step > 0.0:
+            ol = leaf_output(lg, lh, lambda_l1, cat_l2_total, max_delta_step)
+            orr = leaf_output(rg, rh, lambda_l1, cat_l2_total, max_delta_step)
+            gain = leaf_gain_given_output(lg, lh, lambda_l1, cat_l2_total, ol) \
+                + leaf_gain_given_output(rg, rh, lambda_l1, cat_l2_total, orr)
+        else:
+            gain = leaf_term(lg, lh, lambda_l1, cat_l2_total) + \
+                   leaf_term(rg, rh, lambda_l1, cat_l2_total)
         ok = ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
               (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
         return jnp.where(ok, gain, NEG_INF)
@@ -510,7 +532,14 @@ def find_best_splits(
 
     # categorical rel gain uses the cat-regularised parent term (reference:
     # feature_histogram.hpp computes the gain shift with l2 + cat_l2)
-    parent_term_cat = leaf_term(parent_g, parent_h, lambda_l1, cat_l2_total)
+    if max_delta_step > 0.0:
+        p_out_cc = leaf_output(parent_g, parent_h, lambda_l1, cat_l2_total,
+                               max_delta_step)
+        parent_term_cat = leaf_gain_given_output(
+            parent_g, parent_h, lambda_l1, cat_l2_total, p_out_cc)
+    else:
+        parent_term_cat = leaf_term(parent_g, parent_h, lambda_l1,
+                                    cat_l2_total)
     cat_rel = cat_gain - parent_term_cat[:, None, None]
     cat_rel = jnp.where(cat_gain <= NEG_INF / 2, NEG_INF, cat_rel)
 

@@ -147,6 +147,32 @@ def main():
              "predict_raw_score": "true", "verbosity": -1}, FIX)
     print("generated stock_forcedbins.model")
 
+    # ---- regularized scan params (GetLeafGain/CalculateSplittedLeafOutput
+    # variants: path smoothing, L1/L2, depth cap, min-gain gate) ----
+    model = FIX / "stock_regularized.model"
+    run_cli({**common, "objective": "regression",
+             "data": str(FIX / 'golden_train_reg.csv'),
+             "path_smooth": "0.5", "lambda_l1": "0.5", "lambda_l2": "0.2",
+             "max_depth": "5", "min_gain_to_split": "0.01",
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_regularized.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_regularized.model")
+
+    # ---- max_delta_step (USE_MAX_OUTPUT: gains at clamped outputs) ----
+    model = FIX / "stock_maxdelta.model"
+    run_cli({**common, "objective": "regression",
+             "data": str(FIX / 'golden_train_reg.csv'),
+             "max_delta_step": "0.3",
+             "task": "train", "output_model": str(model)}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(model), "header": "false",
+             "output_result": str(FIX / "stock_pred_maxdelta.txt"),
+             "predict_raw_score": "true", "verbosity": -1}, FIX)
+    print("generated stock_maxdelta.model")
+
     # ---- zero_as_missing (MissingType::Zero) ----
     rs3 = np.random.RandomState(21)
     nz = 600

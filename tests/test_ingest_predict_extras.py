@@ -56,12 +56,16 @@ def test_pred_early_stop_binary():
     p_full = bst.predict(X, raw_score=True)
     p_es = bst.predict(X, raw_score=True, pred_early_stop=True,
                        pred_early_stop_freq=5, pred_early_stop_margin=2.0)
-    # confident rows freeze early: same SIGN everywhere, close where margin
-    # is small, possibly different magnitude where it stopped early
+    # confident rows freeze early: same SIGN almost everywhere; a frozen
+    # row's 2*|partial score| exceeded the margin at some checkpoint
+    # (reference prediction_early_stop.cpp:66: margin = 2*fabs(pred) >
+    # margin_threshold), so its magnitude may legitimately differ
     assert ((p_es > 0) == (p_full > 0)).mean() > 0.98
-    small = np.abs(p_full) < 0.5
-    if small.any():
-        np.testing.assert_allclose(p_es[small], p_full[small], atol=1.0)
+    # (1e-6: p_es may come from a different predictor path than p_full,
+    # so unfrozen rows agree only to float noise)
+    frozen = np.abs(p_es - p_full) > 1e-6
+    if frozen.any():
+        assert 2.0 * np.abs(p_es[frozen]).min() > 2.0
     # a tiny margin must cut more tree evaluations than a huge one: proxy via
     # difference from the full prediction
     p_tiny = bst.predict(X, raw_score=True, pred_early_stop=True,
