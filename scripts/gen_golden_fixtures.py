@@ -90,6 +90,18 @@ def main():
                  "predict_raw_score": "true", "verbosity": -1}, FIX)
         print(f"generated stock_{name}.model")
 
+    # ---- predict modes on the binary model (gbdt_prediction.cpp:
+    # PredictLeafIndex; TreeSHAP PredictContrib, tree.cpp:1103) ----
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(FIX / 'stock_binary.model'), "header": "false",
+             "output_result": str(FIX / "stock_pred_binary_leaf.txt"),
+             "predict_leaf_index": "true", "verbosity": -1}, FIX)
+    run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+             "input_model": str(FIX / 'stock_binary.model'), "header": "false",
+             "output_result": str(FIX / "stock_pred_binary_contrib.txt"),
+             "predict_contrib": "true", "verbosity": -1}, FIX)
+    print("generated leaf/contrib predictions")
+
     # ---- weighted training (reference: metadata.cpp LoadWeights) ----
     rs = np.random.RandomState(7)
     w = (0.5 + rs.rand(len(X))).round(4)
