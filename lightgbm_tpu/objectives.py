@@ -153,6 +153,12 @@ class Fair(ObjectiveFunction):
         hess = c * c / ((jnp.abs(diff) + c) ** 2)
         return self._apply_weight(grad, hess)
 
+    def boost_from_score(self):
+        # RegressionFairLoss does not override BoostFromScore — it inherits
+        # RegressionL2loss's weighted label mean (hpp:352 : public L2loss)
+        return float(jnp.mean(self.label)) if self.weight is None else \
+            float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+
 
 class Poisson(ObjectiveFunction):
     """reference: regression_objective.hpp:399 (log link)"""
@@ -212,9 +218,12 @@ class MAPE(ObjectiveFunction):
             self._mape_w = self._mape_w * self.weight
 
     def get_gradients(self, score):
+        # gradients scale by 1/max(1, |label|); hessians are the plain row
+        # weights — NOT the label weights (regression_objective.hpp:615-631:
+        # hessians[i] = 1.0f, or weights_[i] when weighted)
         diff = score - self.label
         grad = jnp.sign(diff) * self._mape_w
-        hess = self._mape_w
+        hess = jnp.ones_like(score) if self.weight is None else self.weight
         return grad, hess
 
     def data_bound_attrs(self):
@@ -437,46 +446,129 @@ class CrossEntropyLambda(ObjectiveFunction):
 # ---------------------------------------------------------------------------
 
 def _weighted_percentile(values, weights, alpha) -> float:
-    v = np.asarray(values, np.float64)
+    """The reference's PercentileFun / WeightedPercentileFun
+    (regression_objective.hpp:19,51), bit-faithful: the unweighted form
+    interpolates along the DESCENDING order at position (n-1)*(1-alpha)
+    with `v1 - (v1 - v2) * bias` evaluated in f64 and rounded to the label
+    dtype (label_t = float); the weighted form walks the weighted CDF with
+    upper_bound and interpolates only when the straddling weight gap
+    >= 1.0."""
+    v32 = np.asarray(values, np.float32)
+    n = len(v32)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return float(v32[0])
     if weights is None:
-        return float(np.quantile(v, alpha, method="lower")) if len(v) else 0.0
+        float_pos = (n - 1) * (1.0 - alpha)
+        pos = int(float_pos) + 1
+        if pos < 1:
+            return float(v32.max())
+        if pos >= n:
+            return float(v32.min())
+        bias = float_pos - (pos - 1)
+        desc = np.sort(v32)[::-1]
+        v1 = np.float64(desc[pos - 1])
+        v2 = np.float64(desc[pos])
+        return float(np.float32(v1 - (v1 - v2) * bias))
     w = np.asarray(weights, np.float64)
-    order = np.argsort(v)
+    order = np.argsort(v32, kind="stable")
     cw = np.cumsum(w[order])
-    idx = int(np.searchsorted(cw, alpha * cw[-1]))
-    idx = min(idx, len(v) - 1)
-    return float(v[order[idx]])
+    threshold = cw[-1] * alpha
+    pos = int(np.searchsorted(cw, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(v32[order[pos]])
+    v1 = np.float64(v32[order[pos - 1]])
+    v2 = np.float64(v32[order[pos]])
+    if cw[pos] - cw[pos - 1] >= 1.0:
+        return float(np.float32(
+            (threshold - cw[pos - 1]) / (cw[pos] - cw[pos - 1]) * (v2 - v1)
+            + v1))
+    return float(np.float32(v1))
 
 
 def _leaf_percentile(resid, leaf_id, num_leaves, alpha, weight, sample_mask):
-    """Per-leaf weighted percentile of residuals (device, sort-based).
+    """Per-leaf percentile of residuals (device, sort-based).
 
-    reference: RenewTreeOutput in regression_objective.hpp — recomputes each leaf's
-    output as the alpha-percentile of its residuals."""
+    reference: RenewTreeOutput in regression_objective.hpp — recomputes each
+    leaf's output as the alpha-percentile of its (in-bag) residuals, using
+    PercentileFun when the dataset is unweighted (interpolated order
+    statistics of the subset) and WeightedPercentileFun otherwise (weighted
+    CDF walked with upper_bound; interpolate only when the straddling
+    weight gap >= 1.0). Arithmetic in f64 like the reference's double
+    instantiation."""
     n = resid.shape[0]
-    w = jnp.ones_like(resid) if weight is None else weight
-    if sample_mask is not None:
-        w = w * sample_mask
+    iota = jnp.arange(n)
+    mask = (jnp.ones(n, bool) if sample_mask is None
+            else sample_mask.astype(bool))
     # two-key sort (leaf, residual): sort by residual, then stable sort by leaf
     o1 = jnp.argsort(resid)
     o2 = jnp.argsort(leaf_id[o1])  # jnp.argsort is stable
     order = o1[o2]
     sl = leaf_id[order]
-    sr = resid[order]
-    sw = w[order]
+    sr = resid[order].astype(jnp.float64) \
+        if jax.config.jax_enable_x64 else resid[order]
+    sm = mask[order]
+    # subset rank: position of each in-bag row among its leaf's in-bag rows
+    cm = jnp.cumsum(sm.astype(jnp.int32))
+    leaf_cnt = jax.ops.segment_sum(sm.astype(jnp.int32), sl,
+                                   num_segments=num_leaves)
+    leaf_start_cnt = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(leaf_cnt)[:-1]])
+    rank = cm - leaf_start_cnt[sl]          # 1-based among in-bag rows
+
+    def subset_value_at(asc_idx):
+        """value of the asc_idx-th (0-based) in-bag row per leaf."""
+        tgt = jnp.where(sm & (rank - 1 == jnp.clip(asc_idx, 0)[sl]), iota, n)
+        first = jax.ops.segment_min(tgt, sl, num_segments=num_leaves)
+        return sr[jnp.clip(first, 0, n - 1)]
+
+    c = leaf_cnt
+    if weight is None:
+        # PercentileFun: interpolate along the DESCENDING subset order at
+        # float_pos = (c-1)*(1-alpha); v1 = desc[pos-1], v2 = desc[pos]
+        float_pos = (c - 1).astype(sr.dtype) * (1.0 - alpha)
+        pos = jnp.floor(float_pos).astype(jnp.int32) + 1
+        bias = float_pos - (pos - 1)
+        i1 = c - pos                        # ascending index of desc[pos-1]
+        i2 = c - 1 - pos
+        v1 = subset_value_at(i1)
+        v2 = subset_value_at(i2)
+        ret = v1 - (v1 - v2) * bias
+        vmax = subset_value_at(c - 1)
+        vmin = subset_value_at(jnp.zeros_like(c))
+        ret = jnp.where(pos < 1, vmax, ret)
+        ret = jnp.where(pos >= c, vmin, ret)
+        ret = jnp.where(c <= 1, vmin, ret)
+        return jnp.where(c > 0, ret, 0.0).astype(resid.dtype)
+    # WeightedPercentileFun on the in-bag subset
+    sw = weight[order] * sm
     cw = jnp.cumsum(sw)
     leaf_tot = jax.ops.segment_sum(sw, sl, num_segments=num_leaves)
     leaf_start_w = jnp.concatenate([jnp.zeros(1), jnp.cumsum(leaf_tot)[:-1]])
-    # target cumulative weight per row's leaf
-    target = leaf_start_w[sl] + alpha * leaf_tot[sl]
-    hit = (cw >= target) & (sw > 0)
-    # first hit per leaf: segment_min over positions
-    pos = jnp.where(hit, jnp.arange(n), n)
-    first = jax.ops.segment_min(pos, sl, num_segments=num_leaves)
-    first = jnp.clip(first, 0, n - 1)
-    vals = sr[first]
-    ok = leaf_tot > 0
-    return jnp.where(ok, vals, 0.0)
+    cw_in = cw - leaf_start_w[sl]
+    threshold = alpha * leaf_tot
+    # pos = upper_bound(cdf, threshold): first in-bag row with cdf > thr
+    hit = sm & (cw_in > threshold[sl])
+    tgt = jnp.where(hit, iota, n)
+    first = jax.ops.segment_min(tgt, sl, num_segments=num_leaves)
+    pos_rank = jnp.where(first < n, rank[jnp.clip(first, 0, n - 1)],
+                         c + 1) - 1          # 0-based subset index of pos
+    pos_rank = jnp.minimum(pos_rank, c - 1)  # pos = min(pos, cnt-1)
+    v2 = subset_value_at(pos_rank)
+    v1 = subset_value_at(pos_rank - 1)
+    cdf_pos = jnp.where(first < n, cw_in[jnp.clip(first, 0, n - 1)],
+                        leaf_tot)            # in-leaf cdf at pos
+    # cdf at pos-1 = cdf_pos - weight at pos
+    w_pos = jnp.where(first < n, sw[jnp.clip(first, 0, n - 1)], 0.0)
+    cdf_prev = cdf_pos - w_pos
+    interp = (threshold - cdf_prev) / jnp.maximum(w_pos, 1e-300) \
+        * (v2 - v1) + v1
+    ret = jnp.where(w_pos >= 1.0, interp, v1)
+    ret = jnp.where((pos_rank <= 0) | (pos_rank >= c - 1), v2, ret)
+    ret = jnp.where(c <= 1, subset_value_at(jnp.zeros_like(c)), ret)
+    return jnp.where(c > 0, ret, 0.0).astype(resid.dtype)
 
 
 _OBJECTIVE_CLASSES = {

@@ -159,6 +159,33 @@ def main():
              "predict_raw_score": "true", "verbosity": -1}, FIX)
     print("generated stock_forcedbins.model")
 
+    # ---- deterministic objective families (regression_objective.hpp:
+    # percentile boost/renewal for l1/quantile/mape, log-link for
+    # poisson/gamma/tweedie, fair's L2-inherited mean boost) ----
+    ypos = (np.abs(y_reg) + 0.1).round(5)
+    pos_csv = FIX / "golden_train_pos.csv"
+    write_csv(pos_csv, ypos, X)
+    obj_cases = [
+        ("huber", train_csv.parent / "golden_train_reg.csv", {}),
+        ("fair", train_csv.parent / "golden_train_reg.csv", {}),
+        ("regression_l1", train_csv.parent / "golden_train_reg.csv", {}),
+        ("quantile", train_csv.parent / "golden_train_reg.csv",
+         {"alpha": "0.7"}),
+        ("poisson", pos_csv, {}),
+        ("gamma", pos_csv, {}),
+        ("tweedie", pos_csv, {}),
+        ("mape", pos_csv, {}),
+    ]
+    for obj, data, extra in obj_cases:
+        model = FIX / f"stock_obj_{obj}.model"
+        run_cli({**common, "objective": obj, "data": str(data), **extra,
+                 "task": "train", "output_model": str(model)}, FIX)
+        run_cli({"task": "predict", "data": str(FIX / 'golden_X.csv'),
+                 "input_model": str(model), "header": "false",
+                 "output_result": str(FIX / f"stock_pred_obj_{obj}.txt"),
+                 "predict_raw_score": "true", "verbosity": -1}, FIX)
+        print(f"generated stock_obj_{obj}.model")
+
     # ---- regularized scan params (GetLeafGain/CalculateSplittedLeafOutput
     # variants: path smoothing, L1/L2, depth cap, min-gain gate) ----
     model = FIX / "stock_regularized.model"
