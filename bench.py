@@ -1,6 +1,8 @@
-"""Benchmark: HIGGS-scale binary classification training throughput.
+"""Benchmark: the two north-star workloads (HIGGS binary + MSLR lambdarank).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per workload: {"metric", "value", "unit", "vs_baseline",
+"peak_hbm_gb", "host_rss_gb"}.  A plain `python bench.py` runs BOTH; set
+BENCH_TASK=higgs or BENCH_TASK=ranking to run just one.
 
 Baseline: LightGBM CPU trains HIGGS (10.5M rows x 28 features, num_leaves=255,
 lr=0.1, 500 iters) in 130.094 s => 0.2602 s/tree on a 28-core Haswell
@@ -89,10 +91,51 @@ def ndcg_at_k(y, score, sizes, k=10):
     return float(np.mean(out))
 
 
+def _rss_kb():
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        return 0
+
+
+def _memory_fields(rss_kb_at_start=0):
+    """Peak device HBM + host RSS, the reference's published memory metrics
+    (docs/Experiments.rst:166 0.897 GB CPU HIGGS; docs/GPU-Performance.rst:186
+    1067 MB GPU).  ru_maxrss is a process-lifetime peak, so when several
+    workloads run in one process the field is only attributable to THIS
+    workload if the peak moved while it ran; otherwise it is omitted."""
+    out = {}
+    rss = _rss_kb()
+    if rss > rss_kb_at_start:
+        out["host_rss_gb"] = round(rss / 2 ** 20, 3)
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if peak:
+            out["peak_hbm_gb"] = round(peak / 2 ** 30, 3)
+        else:
+            # tunnel devices report no allocator stats; live-array residency
+            # is the honest fallback (the training state persists on device,
+            # so this is within one histogram buffer of the true peak)
+            live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in jax.live_arrays())
+            out["device_hbm_gb"] = round(live / 2 ** 30, 3)
+    except Exception:
+        pass
+    return out
+
+
 def run_ranking():
     import lightgbm_tpu as lgb
 
-    n_docs = int(os.environ.get("BENCH_RANK_ROWS", 2_270_000))
+    rss0 = _rss_kb()
+    # BENCH_ROWS scales the HIGGS run; scale the ranking run by the same
+    # fraction unless BENCH_RANK_ROWS pins it explicitly, so quick checks
+    # (small BENCH_ROWS) stay quick with both workloads on by default
+    default_docs = round(2_270_000 * min(1.0, N_ROWS / HIGGS_ROWS))
+    n_docs = int(os.environ.get("BENCH_RANK_ROWS", default_docs))
     n_iters = int(os.environ.get("BENCH_RANK_ITERS", 20))
     gate = float(os.environ.get("BENCH_NDCG_GATE", 0.70))
     baseline_s_per_tree = 70.417 / 500.0   # MSLR CPU, Experiments.rst:117
@@ -138,9 +181,9 @@ def run_ranking():
                  f"holdout NDCG@10 {ndcg:.4f} "
                  f"{'>=' if ok else '< GATE '}{gate})"),
         "vs_baseline": round(vs_baseline, 3) if ok else 0.0,
-    }))
-    if not ok:
-        sys.exit(1)
+        **_memory_fields(rss0),
+    }), flush=True)
+    return ok
 
 
 def auc_score(y, p):
@@ -155,6 +198,7 @@ def auc_score(y, p):
 def main():
     import lightgbm_tpu as lgb
 
+    rss0 = _rss_kb()
     X, y = make_higgs_like(N_ROWS, N_FEATURES)
     n_test = min(500_000, N_ROWS // 10)
     X_tr, y_tr = X[:-n_test], y[:-n_test]
@@ -199,19 +243,30 @@ def main():
             "value": round(s_per_tree_full, 4),
             "unit": f"s/tree INVALID: AUC {auc:.4f} < gate {AUC_GATE}",
             "vs_baseline": 0.0,
-        }))
-        sys.exit(1)
+            **_memory_fields(rss0),
+        }), flush=True)
+        return False
     print(json.dumps({
         "metric": "higgs_like_train_s_per_tree_10p5M_rows",
         "value": round(s_per_tree_full, 4),
         "unit": (f"s/tree (lower is better; 10.5M rows, 255 leaves, 63 bins, "
                  f"holdout AUC {auc:.4f} >= {AUC_GATE})"),
         "vs_baseline": round(vs_baseline, 3),
-    }))
+        **_memory_fields(rss0),
+    }), flush=True)
+    return True
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_TASK", "") == "ranking":
-        run_ranking()
-    else:
-        main()
+    task = os.environ.get("BENCH_TASK", "")
+    if task not in ("", "higgs", "ranking"):
+        sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking")
+    ok = True
+    if task in ("", "higgs"):
+        ok = main() and ok
+    if task in ("", "ranking"):
+        import gc
+        gc.collect()   # drop the HIGGS matrices before the ranking ingest
+        ok = run_ranking() and ok
+    if not ok:
+        sys.exit(1)
