@@ -149,7 +149,6 @@ def run_ranking():
         "learning_rate": 0.1,
         "max_bin": 63,
         "verbosity": -1,
-        "max_splits_per_round": 64,
         "ndcg_eval_at": [10],
         # quantized-gradient training (reference: use_quantized_grad works
         # for ranking objectives too); the NDCG gate below verifies quality
@@ -209,7 +208,6 @@ def main():
         "learning_rate": 0.1,
         "max_bin": 63,
         "verbosity": -1,
-        "max_splits_per_round": 64,
         # Quantized-gradient training (the reference's use_quantized_grad,
         # gradient_discretizer.cpp): on TPU the 64-level integer grid feeds
         # an int8 MXU contraction with EXACT int32 histogram sums. The

@@ -85,10 +85,16 @@ def _digits(v):
 
 
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
-                       newleaf_ref, hist_ref, cnt_ref, *, T, G, B, S, L, GW,
+                       newleaf_ref, *outs, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True,
                        int_weights: bool = False, f32_dots: bool = False,
-                       u8_layout: bool = False):
+                       u8_layout: bool = False, with_hist: bool = True):
+    if with_hist:
+        hist_ref, cnt_ref = outs
+    else:
+        # route-only variant: no histogram output ref exists at all, so the
+        # (G*B, 2S) VMEM-resident block is never allocated
+        hist_ref, (cnt_ref,) = None, outs
     b = pl.program_id(0)
     i32, f32 = jnp.int32, jnp.float32
     # interpret mode on CPU: XLA:CPU's Eigen DotThunk rejects bf16 at some
@@ -178,12 +184,26 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # ---------------- histogram ----------------
     @pl.when(b == 0)
     def _():
-        hist_ref[...] = jnp.zeros_like(hist_ref)
+        if with_hist:
+            hist_ref[...] = jnp.zeros_like(hist_ref)
         cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
     slot = slot1 - 1
     s_iota = jax.lax.broadcasted_iota(i32, (S, T), 0)
     slot_oh = (s_iota == slot).astype(bf16)                  # (S, T)
+    # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot) — needed by every
+    # variant including route-only rounds: they become the model's leaf_count
+    # values (reference: DataPartition::leaf_count, serial_tree_learner.cpp:798)
+    cnt_row = w_ref[2:3, :]
+    cnt_ref[0:1, :] += jax.lax.dot_general(
+        cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32)
+    if not with_hist:
+        # route-only round (a tree's LAST split round: the children's
+        # histograms would never be scanned, so the dominant one-hot
+        # contraction — and the whole VMEM-resident histogram block — is
+        # dropped)
+        return
     w2 = w_ref[0:2, :]                                       # (2, T) f32
     w_hi, w_lo = _wsplit(w2)
 
@@ -219,10 +239,6 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         # grow layer passes integer-valued grad/hess rows, the contraction
         # runs on the int8 MXU (~25% faster than bf16 at these shapes), and
         # int32 accumulation makes the histogram sums EXACT.
-        cnt_row = w_ref[2:3, :]
-        cnt_ref[0:1, :] += jax.lax.dot_general(
-            cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
-            preferred_element_type=f32)
         # build A in i32 (Mosaic cannot legalize i8*i8 multiplies), then
         # convert the (2S, T) operand to int8 once
         slot_oh_i = (s_iota == slot).astype(i32)
@@ -260,15 +276,9 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                 hist_ref[...] += jnp.abs(d2) // jnp.int32(2 ** 30)
         return
 
-    # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot; the reference's
-    # analog is DataPartition leaf counts, serial_tree_learner.cpp:798).
-    # Histograms themselves carry only grad/hess — per-bin counts are
-    # estimated from hessians at split-find time like the reference.
-    cnt_row = w_ref[2:3, :]                                  # (1, T) f32
-    cnt_ref[0:1, :] += jax.lax.dot_general(
-        cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
-        preferred_element_type=f32)                          # (1, S)
-
+    # (histograms carry only grad/hess — per-bin counts are estimated from
+    # hessians at split-find time like the reference; exact per-slot counts
+    # came from the hoisted cnt dot above)
     def build_A(w):
         # (1, T) x (S, T) broadcast-multiplies + sublane concat; the 3-D
         # broadcast form lowers to a much slower relayout
@@ -370,12 +380,12 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024,
 @functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
                                              "num_leaves", "block_rows",
                                              "has_cat", "two_pass",
-                                             "int_weights"))
+                                             "int_weights", "with_hist"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
                    has_cat: bool = True, two_pass: bool = True,
-                   int_weights: bool = False):
+                   int_weights: bool = False, with_hist: bool = True):
     """One fused streaming pass: route rows through this round's splits and
     build grad/hess histograms and exact data counts of the rows' NEW slots.
 
@@ -398,11 +408,23 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
     u8_layout = bins_T.dtype == jnp.int8
 
     hist_dtype = jnp.int32 if int_weights else jnp.float32
-    new_leaf, hist, cnt = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, T), lambda b: (0, b)),
+        pl.BlockSpec((G * B, 2 * S), lambda b: (0, 0)),
+        pl.BlockSpec((1, S), lambda b: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        jax.ShapeDtypeStruct((G * B, 2 * S), hist_dtype),
+        jax.ShapeDtypeStruct((1, S), jnp.float32),
+    ]
+    if not with_hist:
+        del out_specs[1], out_shape[1]
+    outs = pl.pallas_call(
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
                           has_cat=has_cat, two_pass=two_pass,
                           int_weights=int_weights, f32_dots=_interp(),
-                          u8_layout=u8_layout),
+                          u8_layout=u8_layout, with_hist=with_hist),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),
@@ -411,21 +433,18 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
             pl.BlockSpec((NUM_TAB, L), lambda b: (0, 0)),
             pl.BlockSpec((B, L), lambda b: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, T), lambda b: (0, b)),
-            pl.BlockSpec((G * B, 2 * S), lambda b: (0, 0)),
-            pl.BlockSpec((1, S), lambda b: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((G * B, 2 * S), hist_dtype),
-            jax.ShapeDtypeStruct((1, S), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interp(),
     )(bins_T, leaf_id, w_T, tabs, bits)
 
+    if not with_hist:
+        new_leaf, cnt = outs
+        hist4 = jnp.zeros((S, G, bmax, 2), hist_dtype)
+        return new_leaf, hist4, cnt.reshape(-1)
+    new_leaf, hist, cnt = outs
     # (B*G, 2S) b-major rows -> (S, G, Bmax, 2); int histograms are
     # unscaled by the caller
     hist4 = hist.reshape(B, G, 2, S).transpose(3, 1, 0, 2)[:, :, :bmax, :]

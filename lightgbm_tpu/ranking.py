@@ -82,6 +82,49 @@ def _bucketize(query_boundaries: np.ndarray, labels: np.ndarray,
     return _QueryBuckets(out_sizes, out_idx, out_inv, out_qids)
 
 
+def _contiguous_span(idx: np.ndarray):
+    """(offset, true_size) when every query in the bucket has the same true
+    size and their rows are consecutive in the flat doc order — then the
+    bucket's (Q, M) padded gather collapses to slice+reshape+pad, and the
+    gradient scatter to one contiguous slice-add.  Real ranking sets are
+    close to uniform (MSLR ~120 docs/query), so this removes two random
+    N-sized gathers per boosting iteration (~105M rows/s on TPU =
+    ~20 ms/iter at MSLR scale)."""
+    q, m = idx.shape
+    valid = idx >= 0
+    z = int(valid[0].sum())
+    if z == 0 or not (valid.sum(axis=1) == z).all() or not valid[:, :z].all():
+        return None
+    off = int(idx[0, 0])
+    expect = off + np.arange(q * z, dtype=np.int64).reshape(q, z)
+    if not np.array_equal(idx[:, :z], expect):
+        return None
+    return off, z
+
+
+def _bucket_scores(score, idx, span):
+    """Per-bucket (Q, M) padded scores: slice+reshape+pad on contiguous
+    uniform buckets, generic gather otherwise."""
+    if span is not None:
+        off, z = span
+        q, m = idx.shape
+        s = jax.lax.dynamic_slice(score, (off,), (q * z,)).reshape(q, z)
+        return jnp.pad(s, ((0, 0), (0, m - z))) if z < m else s
+    return score[idx.reshape(-1)].reshape(idx.shape)
+
+
+def _bucket_scatter_add(vec, vals, idx, valid, span, n):
+    """Accumulate per-bucket (Q, M) grads back into the flat (N,) vector."""
+    if span is not None:
+        off, z = span
+        q = idx.shape[0]
+        return vec.at[off:off + q * z].add(
+            vals[:, :z].reshape(-1).astype(vec.dtype))
+    flat_idx = jnp.where(valid.reshape(-1), idx.reshape(-1), n)
+    return vec.at[flat_idx].add(vals.reshape(-1).astype(vec.dtype),
+                                mode="drop")
+
+
 @functools.partial(jax.jit, static_argnames=("sigma", "norm", "trunc", "chunk"))
 def _lambdarank_bucket(scores, labels_q, valid, inv_max_dcg, gains_q,
                        sigma: float, norm: bool, trunc: int, chunk: int = 256):
@@ -90,42 +133,67 @@ def _lambdarank_bucket(scores, labels_q, valid, inv_max_dcg, gains_q,
     scores/labels_q/valid: (Q, M); inv_max_dcg: (Q,). Returns (grad, hess) (Q, M)."""
     Q, M = scores.shape
     NEG = -1e30
+    K = min(trunc, M)
 
     def one_chunk(args):
+        # Sorted-space top-K pair formulation (reference:
+        # rank_objective.hpp:180 GetGradientsForOneQuery iterates
+        # `for i < min(truncation_level, cnt): for j in (i, cnt)` over docs
+        # sorted by score desc).  Forming only those (K, M) pairs — instead
+        # of all (M, M) pairs masked down — cuts the pairwise tensor work
+        # by M/K (~4x at the MSLR shapes M~128, truncation 30), and the
+        # positional discounts become a static vector.
         s, lab, v, imd, gain = args                       # (q, M) ...
         masked = jnp.where(v, s, NEG)
-        order = jnp.argsort(-masked, axis=-1)             # desc, stable
-        rank = jnp.argsort(order, axis=-1)                # rank of each doc
-        disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)
+        # multi-operand stable sort carries every per-doc array into sorted
+        # space in ONE pass, and a second sort on the carried original
+        # position unsorts the results.  take_along_axis gathers here were
+        # 2x the cost of the whole pairwise computation (TPU random gather
+        # ~105M rows/s vs sort ~230M rows/s).
+        iota = jnp.broadcast_to(
+            jnp.arange(M, dtype=jnp.int32), masked.shape)
+        neg_ss, labs, gains_s, vf, orig_pos = jax.lax.sort(
+            (-masked, lab, gain, v.astype(jnp.float32), iota),
+            dimension=-1, num_keys=1, is_stable=True)
+        ss = -neg_ss
+        vs = vf > 0.5                                     # valid = prefix
+        disc = 1.0 / jnp.log2(jnp.arange(M, dtype=jnp.float32) + 2.0)
         best = jnp.max(masked, axis=-1, keepdims=True)
         worst = jnp.min(jnp.where(v, s, -NEG), axis=-1, keepdims=True)
         has_range = (best != worst)
 
-        sd = s[:, :, None] - s[:, None, :]                # s_i - s_j
-        lab_gt = lab[:, :, None] > lab[:, None, :]        # i strictly higher label
-        pair_valid = (v[:, :, None] & v[:, None, :] &
-                      lab_gt &
-                      (jnp.minimum(rank[:, :, None], rank[:, None, :]) < trunc))
-        dcg_gap = gain[:, :, None] - gain[:, None, :]     # > 0 where lab_gt
-        paired_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
-        delta = dcg_gap * paired_disc * imd[:, None, None]
+        sk, labk, gk, vk = ss[:, :K], labs[:, :K], gains_s[:, :K], vs[:, :K]
+        sd = sk[:, :, None] - ss[:, None, :]              # (q, K, M)
+        sgn = jnp.sign(labk[:, :, None] - labs[:, None, :])
+        upper = (jnp.arange(M)[None, :] > jnp.arange(K)[:, None])  # j > a
+        pair_valid = (vk[:, :, None] & vs[:, None, :] & (sgn != 0)
+                      & upper[None])
+        delta = (jnp.abs(gk[:, :, None] - gains_s[:, None, :])
+                 * jnp.abs(disc[:K][None, :, None] - disc[None, None, :])
+                 * imd[:, None, None])
         if norm:
             delta = jnp.where(has_range[..., None],
                               delta / (0.01 + jnp.abs(sd)), delta)
-        p = jax.nn.sigmoid(-sigma * sd)                   # 1/(1+exp(sigma*(s_i-s_j)))
-        lam = -sigma * p * delta                          # lambda for the high doc i
+        # p = sigmoid(-sigma * (s_high - s_low)); the higher-labelled doc of
+        # the pair is position a when sgn>0 else position j
+        p = jax.nn.sigmoid(-sigma * sgn * sd)
+        lam = -sigma * p * delta                          # lambda for the high doc
         hs = sigma * sigma * p * (1.0 - p) * delta
         lam = jnp.where(pair_valid, lam, 0.0)
         hs = jnp.where(pair_valid, hs, 0.0)
-        g = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)   # high role - low role
-        h = jnp.sum(hs, axis=2) + jnp.sum(hs, axis=1)
+        slam = sgn * lam                                  # signed for pos a
+        # high doc += lam, low doc -= lam (in sorted space), then unsort
+        g_sorted = (-jnp.sum(slam, axis=1)).at[:, :K].add(jnp.sum(slam, axis=2))
+        h_sorted = jnp.sum(hs, axis=1).at[:, :K].add(jnp.sum(hs, axis=2))
         sum_lambdas = -2.0 * jnp.sum(lam, axis=(1, 2))
         if norm:
             factor = jnp.where(sum_lambdas > 0,
                                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-20),
                                1.0)
-            g = g * factor[:, None]
-            h = h * factor[:, None]
+            g_sorted = g_sorted * factor[:, None]
+            h_sorted = h_sorted * factor[:, None]
+        _, g, h = jax.lax.sort((orig_pos, g_sorted, h_sorted),
+                               dimension=-1, num_keys=1, is_stable=True)
         return g, h
 
     pad_q = -(-Q // chunk) * chunk - Q
@@ -167,6 +235,7 @@ class LambdarankNDCG(ObjectiveFunction):
         self.n = n
         self._dev_idx = [jnp.asarray(np.maximum(ix, 0)) for ix in self.buckets.doc_index]
         self._dev_valid = [jnp.asarray(ix >= 0) for ix in self.buckets.doc_index]
+        self._spans = [_contiguous_span(ix) for ix in self.buckets.doc_index]
         self._dev_inv = [jnp.asarray(v, jnp.float32) for v in self.buckets.inv_max_dcg]
         lab = np.asarray(label)
         gains = self.label_gain_np[np.clip(lab.astype(np.int64), 0,
@@ -210,18 +279,17 @@ class LambdarankNDCG(ObjectiveFunction):
         hess = jnp.zeros(n, jnp.float32)
         for bi in range(len(self.buckets.sizes)):
             idx = self._dev_idx[bi]
-            s = score[idx.reshape(-1)].reshape(idx.shape)
+            span = self._spans[bi]
+            s = _bucket_scores(score, idx, span)
             g, h = _lambdarank_bucket(
                 s, self._dev_lab[bi], self._dev_valid[bi], self._dev_inv[bi],
                 self._dev_gain[bi], sigma=float(c.sigmoid),
                 norm=bool(c.lambdarank_norm),
                 trunc=int(c.lambdarank_truncation_level))
-            flat_idx = jnp.where(self._dev_valid[bi].reshape(-1),
-                                 idx.reshape(-1), n)
-            grad = grad.at[flat_idx].add(
-                g.reshape(-1).astype(jnp.float32), mode="drop")
-            hess = hess.at[flat_idx].add(
-                h.reshape(-1).astype(jnp.float32), mode="drop")
+            grad = _bucket_scatter_add(grad, g, idx, self._dev_valid[bi],
+                                       span, n)
+            hess = _bucket_scatter_add(hess, h, idx, self._dev_valid[bi],
+                                       span, n)
         grad, hess = self._apply_weight(grad, hess)
         if self._positions is not None:
             self._update_position_bias(grad, hess)
@@ -280,6 +348,7 @@ class RankXENDCG(ObjectiveFunction):
         self._label_np = np.asarray(label)
         self._dev_idx = [jnp.asarray(np.maximum(ix, 0)) for ix in self.buckets.doc_index]
         self._dev_valid = [jnp.asarray(ix >= 0) for ix in self.buckets.doc_index]
+        self._spans = [_contiguous_span(ix) for ix in self.buckets.doc_index]
         self._iter = 0
         self._rng = np.random.RandomState(c.objective_seed)
 
@@ -293,12 +362,13 @@ class RankXENDCG(ObjectiveFunction):
         self._iter += 1
         for bi in range(len(self.buckets.sizes)):
             idx = self._dev_idx[bi]
-            s = score[idx.reshape(-1)].reshape(idx.shape)
+            span = self._spans[bi]
+            s = _bucket_scores(score, idx, span)
             phi = jnp.asarray(
                 phi_flat[np.maximum(self.buckets.doc_index[bi], 0)], jnp.float32)
             g, h = _xendcg_bucket(s, phi, self._dev_valid[bi])
-            flat_idx = jnp.where(self._dev_valid[bi].reshape(-1),
-                                 idx.reshape(-1), n)
-            grad = grad.at[flat_idx].add(g.reshape(-1), mode="drop")
-            hess = hess.at[flat_idx].add(h.reshape(-1), mode="drop")
+            grad = _bucket_scatter_add(grad, g, idx, self._dev_valid[bi],
+                                       span, n)
+            hess = _bucket_scatter_add(hess, h, idx, self._dev_valid[bi],
+                                       span, n)
         return self._apply_weight(grad, hess)
