@@ -138,7 +138,13 @@ class GBDT:
             if self._resolve_hist_backend() == "stream":
                 from ..pallas.stream_kernel import stream_block_rows
                 self._mesh_stream = True
-                pad_base = stream_block_rows(dd.max_bins, dd.num_groups)
+                # int8 and bf16 paths resolve different block sizes (both
+                # powers of two); padding to the larger keeps the per-device
+                # shard a whole number of kernel blocks for whichever tier
+                # _grow_params later picks
+                pad_base = max(
+                    stream_block_rows(dd.max_bins, dd.num_groups, False),
+                    stream_block_rows(dd.max_bins, dd.num_groups, True))
             n_pad = pad_rows_for_mesh(dd.bins.shape[0], self.mesh,
                                       base=pad_base)
             bins = dd.bins
