@@ -1,0 +1,7 @@
+#!/bin/sh
+# Both test tiers, fast first (fail fast on cheap breakage), then the slow
+# nightly consistency suites. ~17 min total on the 8-device CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -x -q
+python -m pytest tests/ -x -q -m slow
