@@ -194,6 +194,8 @@ class Dataset:
                 group = extras.get("group")
             if position is None:
                 position = extras.get("position")
+            if init_score is None:
+                init_score = extras.get("init_score")
         self.raw_sparse = None
         self.raw_seq = None
         self.raw_arrow = None
@@ -1080,15 +1082,8 @@ class Booster:
             raise LightGBMError(
                 f"The number of features in data ({X.shape[1]}) is not the same "
                 f"as it was in training data ({expected})")
-        trees = self._all_trees()
-        k = self.num_model_per_iteration()
-        n_total_iters = len(trees) // max(k, 1)
-        if num_iteration is None or num_iteration <= 0:
-            num_iteration = (self.best_iteration
-                             if self.best_iteration and self.best_iteration > 0
-                             else n_total_iters)
-        end_iteration = min(start_iteration + num_iteration, n_total_iters)
-        use = trees[start_iteration * k:end_iteration * k]
+        use, k, start_iteration, end_iteration = self._resolve_tree_slice(
+            start_iteration, num_iteration)
 
         if pred_leaf:
             out = np.zeros((X.shape[0], len(use)), np.int32)
@@ -1105,7 +1100,18 @@ class Booster:
         es_margin = float(kwargs.get("pred_early_stop_margin", 10.0))
         # init scores are folded into tree 0 at training time (AddBias), so a plain
         # sum over trees is the complete raw score
-        score = None if early_stop else self._try_device_predict(X, use, k)
+        score = None
+        if not early_stop:
+            if n == 1:
+                # serving path: pre-bound single-row C tree walk, cached per
+                # (model, iteration slice) — no device dispatch, no per-tree
+                # NumPy overhead (reference: c_api.h:1399 SingleRowFast)
+                fp = self._single_row_fast_cached(use, start_iteration,
+                                                 end_iteration, k)
+                raw = fp.raw_predict(X[0])
+                score = raw[:1] if k == 1 else raw.reshape(1, k)
+            if score is None:
+                score = self._try_device_predict(X, use, k)
         if score is None:
             if k == 1:
                 score = np.zeros(n, np.float64)
@@ -1147,6 +1153,53 @@ class Booster:
             return score
         conv = self._convert_output_fn()
         return np.asarray(conv(score))
+
+    def _resolve_tree_slice(self, start_iteration: int,
+                            num_iteration: Optional[int]):
+        """Iteration-window resolution shared by every predict entry point
+        (best_iteration fallback + end clamp); returns (trees, k, start,
+        end)."""
+        trees = self._all_trees()
+        k = self.num_model_per_iteration()
+        n_total = len(trees) // max(k, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration
+                             and self.best_iteration > 0 else n_total)
+        end = min(start_iteration + num_iteration, n_total)
+        return trees[start_iteration * k:end * k], k, start_iteration, end
+
+    def predict_single_row_fast_init(self, start_iteration: int = 0,
+                                     num_iteration: Optional[int] = None,
+                                     raw_score: bool = False):
+        """FastConfig-style pre-bound single-row predictor (reference:
+        include/LightGBM/c_api.h:1399-1428
+        LGBM_BoosterPredictForMatSingleRowFastInit / ...Fast).  Returns a
+        callable: ``fast(row) -> float`` (or (num_class,) array), walking
+        the pre-packed trees in native code with no device dispatch (the
+        output transform is the objective's NumPy twin)."""
+        from .predict_fast import SingleRowFastPredictor
+        use, k, _, _ = self._resolve_tree_slice(start_iteration,
+                                                num_iteration)
+        avg = (1.0 / max(len(use) // max(k, 1), 1)
+               if self._average_output() and len(use) else 1.0)
+        conv = None if raw_score else self._convert_output_np_fn()
+        return SingleRowFastPredictor(use, k, self.num_feature(), avg, conv)
+
+    def _single_row_fast_cached(self, use, start_iteration, end_iteration, k):
+        """Internal predict() fast path: averaging/conversion stay in the
+        generic tail, so the packed predictor is raw with factor 1.  The
+        key carries every tree's leaf_value array identity: in-place model
+        mutation (DART drop-rescale calls tree.shrink, which REBINDS
+        leaf_value) must invalidate the packed arrays."""
+        key = (start_iteration, end_iteration, k,
+               tuple(id(t.leaf_value) for t in use))
+        cached = getattr(self, "_fast1_cache", None)
+        if cached is None or cached[0] != key:
+            from .predict_fast import SingleRowFastPredictor
+            cached = (key, SingleRowFastPredictor(use, k, self.num_feature()))
+            self._fast1_cache = cached
+        return cached[1]
 
     _DEVICE_PREDICT_MIN_ROWS = 20_000
 
@@ -1221,6 +1274,15 @@ class Booster:
             return self.engine.objective.convert_output
         if self._loaded_trees is not None:
             return self._loaded_trees.convert_output
+        return lambda x: x
+
+    def _convert_output_np_fn(self):
+        """NumPy output transform for host serving paths — a per-call jax
+        dispatch would dominate single-row latency."""
+        if self._engine is not None and self.engine.objective is not None:
+            return self.engine.objective.convert_output_np
+        if self._loaded_trees is not None:
+            return self._loaded_trees.convert_output_np
         return lambda x: x
 
     # ------------------------------------------------------------------

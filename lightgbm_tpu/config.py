@@ -434,6 +434,12 @@ class Config:
         resolved = resolve_aliases(params)
         fields = {f.name for f in dataclasses.fields(self)}
         for key, value in resolved.items():
+            if (key in _VECTOR_FIELDS and isinstance(value, str)
+                    and value.strip()):
+                # conf-file vector syntax "1,3,5" (reference:
+                # Config::GetIntVector / GetDoubleVector, config.h)
+                elt = _VECTOR_FIELDS[key]
+                value = [elt(tok) for tok in value.split(",") if tok.strip()]
             if key in fields:
                 setattr(self, key, _coerce(getattr(self, key), value))
             else:
@@ -462,6 +468,20 @@ class Config:
         d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
         d.update(self._unknown)
         return d
+
+
+# vector-valued params that conf files/CLI pass as comma-separated strings
+# (reference: the Config::GetIntVector/GetDoubleVector fields, config.h)
+_VECTOR_FIELDS: Dict[str, Any] = {
+    "eval_at": int,
+    "label_gain": float,
+    "monotone_constraints": int,
+    "feature_contri": float,
+    "cegb_penalty_feature_lazy": float,
+    "cegb_penalty_feature_coupled": float,
+    "max_bin_by_feature": int,
+    "auc_mu_weights": float,
+}
 
 
 def _coerce(current: Any, value: Any) -> Any:

@@ -114,6 +114,9 @@ def load_data_file(path: str, params: Dict[str, Any],
     pos = load_position_file(path)
     if pos is not None:
         extras["position"] = pos
+    init = load_init_score_file(path)
+    if init is not None:
+        extras["init_score"] = init
     if fmt == "libsvm":
         feats, label, qids = _load_libsvm(path)
         if "group" not in extras and qids is not None:
@@ -212,10 +215,12 @@ def _load_data_file_shard(path: str, params: Dict[str, Any], fmt: str,
         extras = {}
     n_local = len(feats)
     for name, loader in (("weight", load_weight_file),
-                         ("position", load_position_file)):
+                         ("position", load_position_file),
+                         ("init_score", load_init_score_file)):
         if name not in extras:
             v = loader(path)
             if v is not None:
+                # row slice (init_score may be (N, num_class) for multiclass)
                 extras[name] = v[start_row:start_row + n_local]
     if group_slice is not None and "group" not in extras:
         extras["group"] = np.asarray(group_slice, np.int64)
@@ -279,6 +284,16 @@ def load_weight_file(path: str) -> Optional[np.ndarray]:
     wpath = path + ".weight"
     if os.path.exists(wpath):
         return np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+    return None
+
+
+def load_init_score_file(path: str) -> Optional[np.ndarray]:
+    """Load .init sidecar (per-row initial scores; one column per class for
+    multiclass; reference: metadata.cpp:759 LoadInitialScore)."""
+    ipath = path + ".init"
+    if os.path.exists(ipath):
+        arr = np.loadtxt(ipath, dtype=np.float64)
+        return arr if arr.ndim > 1 else arr.reshape(-1)
     return None
 
 

@@ -214,6 +214,12 @@ class LoadedModel:
 
     def convert_output(self, raw):
         obj = self.objective_string.split(" ")[0] if self.objective_string else ""
+        return self._convert(obj, raw)
+
+    # already pure NumPy — the serving fast path uses the same transform
+    convert_output_np = convert_output
+
+    def _convert(self, obj, raw):
         if obj == "binary":
             sigmoid = 1.0
             for part in self.objective_string.split(" ")[1:]:

@@ -65,6 +65,12 @@ def _build_lib() -> Optional[ctypes.CDLL]:
                                       ctypes.c_int32, ctypes.c_int32,
                                       ctypes.c_int32, ctypes.c_int32,
                                       ctypes.POINTER(ctypes.c_uint16)]
+    pd = ctypes.POINTER(ctypes.c_double)
+    pi = ctypes.POINTER(ctypes.c_int32)
+    lib.lgbt_predict_row.argtypes = [
+        pd, pi, ctypes.c_int32, pi, pd, pi,
+        ctypes.POINTER(ctypes.c_uint8), pi, pi, pi, pd, pi,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32, pd]
     return lib
 
 

@@ -190,4 +190,63 @@ void lgbt_value_to_bin(const double* values, int64_t n,
   }
 }
 
+
+// Single-row fast prediction: walk every tree of a packed model for one raw
+// feature row (reference: include/LightGBM/c_api.h:1399
+// LGBM_BoosterPredictForMatSingleRowFastInit/Fast + Tree::Predict, tree.h:135).
+// All node arrays are the trees' internal-node arrays concatenated; tree t's
+// nodes live at [tree_off[t], tree_off[t+1]) and its leaves at leaf_off[t].
+// Child encoding follows the text-model convention: >=0 internal, <0 => leaf
+// index ~child. decision_type bits: 1=categorical, 2=default_left,
+// bits 2-3 missing type (0 none, 1 zero, 2 nan).
+void lgbt_predict_row(const double* row,
+                      const int32_t* tree_off, int32_t ntrees,
+                      const int32_t* split_feature, const double* threshold,
+                      const int32_t* threshold_bin,
+                      const uint8_t* decision_type,
+                      const int32_t* left, const int32_t* right,
+                      const int32_t* leaf_off, const double* leaf_value,
+                      const int32_t* cat_boundaries,
+                      const uint32_t* cat_threshold,
+                      int32_t num_class, double* out) {
+  for (int32_t t = 0; t < ntrees; ++t) {
+    const int32_t nb = tree_off[t];
+    const int32_t nnodes = tree_off[t + 1] - nb;
+    double leaf;
+    if (nnodes <= 0) {
+      leaf = leaf_value[leaf_off[t]];
+    } else {
+      int32_t node = 0;
+      for (;;) {
+        const int32_t gi = nb + node;
+        const double v = row[split_feature[gi]];
+        const uint8_t dt = decision_type[gi];
+        bool go_left;
+        if (dt & 1) {  // categorical: bitset membership, NaN goes right
+          go_left = false;
+          if (!std::isnan(v)) {
+            const int64_t iv = static_cast<int64_t>(v);
+            if (iv >= 0) {
+              const int32_t k = threshold_bin[gi];  // cat ordinal
+              const int32_t s = cat_boundaries[k], e = cat_boundaries[k + 1];
+              const int64_t word = iv / 32;
+              if (word < e - s)
+                go_left = (cat_threshold[s + word] >> (iv % 32)) & 1u;
+            }
+          }
+        } else {
+          const int mt = (dt >> 2) & 3;
+          const bool miss =
+              std::isnan(v) || (mt == 1 && std::fabs(v) < 1e-35);
+          go_left = miss ? ((dt & 2) != 0) : (v <= threshold[gi]);
+        }
+        const int32_t nxt = go_left ? left[gi] : right[gi];
+        if (nxt < 0) { leaf = leaf_value[leaf_off[t] + (~nxt)]; break; }
+        node = nxt;
+      }
+    }
+    out[t % num_class] += leaf;
+  }
+}
+
 }  // extern "C"

@@ -54,6 +54,12 @@ class ObjectiveFunction:
     def convert_output(self, raw: jnp.ndarray) -> jnp.ndarray:
         return raw
 
+    def convert_output_np(self, raw: np.ndarray) -> np.ndarray:
+        """NumPy twin of convert_output for host-side serving paths (the
+        single-row fast predictor must not dispatch jax ops per call);
+        subclasses with non-identity transforms override both."""
+        return np.asarray(raw)
+
     def _apply_weight(self, grad, hess):
         if self.weight is not None:
             w = self.weight
@@ -103,6 +109,11 @@ class RegressionL2(ObjectiveFunction):
         if self.config.reg_sqrt:
             return jnp.sign(raw) * raw * raw
         return raw
+
+    def convert_output_np(self, raw):
+        if self.config.reg_sqrt:
+            return np.sign(raw) * raw * raw
+        return np.asarray(raw)
 
 
 class RegressionL1(ObjectiveFunction):
@@ -183,6 +194,9 @@ class Poisson(ObjectiveFunction):
     def convert_output(self, raw):
         return jnp.exp(raw)
 
+    def convert_output_np(self, raw):
+        return np.exp(raw)
+
 
 class Quantile(ObjectiveFunction):
     """reference: regression_objective.hpp:482"""
@@ -256,6 +270,9 @@ class Gamma(ObjectiveFunction):
     def convert_output(self, raw):
         return jnp.exp(raw)
 
+    def convert_output_np(self, raw):
+        return np.exp(raw)
+
 
 class Tweedie(ObjectiveFunction):
     """reference: regression_objective.hpp:718 (log link)"""
@@ -276,6 +293,9 @@ class Tweedie(ObjectiveFunction):
 
     def convert_output(self, raw):
         return jnp.exp(raw)
+
+    def convert_output_np(self, raw):
+        return np.exp(raw)
 
 
 class BinaryLogloss(ObjectiveFunction):
@@ -321,6 +341,9 @@ class BinaryLogloss(ObjectiveFunction):
     def convert_output(self, raw):
         return jax.nn.sigmoid(self.config.sigmoid * raw)
 
+    def convert_output_np(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.config.sigmoid * raw))
+
 
 class MulticlassSoftmax(ObjectiveFunction):
     """reference: multiclass_objective.hpp:25 — one tree per class per iteration."""
@@ -347,6 +370,10 @@ class MulticlassSoftmax(ObjectiveFunction):
 
     def convert_output(self, raw):
         return jax.nn.softmax(raw, axis=-1)
+
+    def convert_output_np(self, raw):
+        e = np.exp(raw - np.max(raw, axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
 
     def data_bound_attrs(self):
         return ("label", "weight", "_onehot")
@@ -377,6 +404,10 @@ class MulticlassOVA(ObjectiveFunction):
         p = jax.nn.sigmoid(self.config.sigmoid * raw)
         return p / jnp.sum(p, axis=-1, keepdims=True)
 
+    def convert_output_np(self, raw):
+        p = 1.0 / (1.0 + np.exp(-self.config.sigmoid * raw))
+        return p / np.sum(p, axis=-1, keepdims=True)
+
     def data_bound_attrs(self):
         return ("label", "weight", "_onehot")
 
@@ -406,6 +437,9 @@ class CrossEntropy(ObjectiveFunction):
 
     def convert_output(self, raw):
         return jax.nn.sigmoid(raw)
+
+    def convert_output_np(self, raw):
+        return 1.0 / (1.0 + np.exp(-np.asarray(raw)))
 
 
 class CrossEntropyLambda(ObjectiveFunction):
@@ -439,6 +473,9 @@ class CrossEntropyLambda(ObjectiveFunction):
 
     def convert_output(self, raw):
         return jnp.log1p(jnp.exp(raw))
+
+    def convert_output_np(self, raw):
+        return np.log1p(np.exp(raw))
 
 
 # ---------------------------------------------------------------------------

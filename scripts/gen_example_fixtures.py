@@ -1,0 +1,73 @@
+"""Generate stock-LightGBM oracle fixtures from the reference's bundled
+example datasets (reference: examples/*/train.conf, consumed the same way
+by tests/python_package_test/test_consistency.py and cpp testutils.cpp).
+
+Runs the stock CLI on each example's own train.conf and records the final
+validation metrics into tests/fixtures/examples_stock.json. The real-data
+consistency tier (tests/test_consistency_examples.py) trains our CLI on
+the same confs and asserts metric parity within tolerance.
+
+Usage: LGBM_CLI=/tmp/refsrc2/lightgbm python scripts/gen_example_fixtures.py
+(see the stock-CLI build recipe in that test's docstring if /tmp was wiped)
+"""
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLES = Path("/root/reference/examples")
+
+# example dir -> files to copy; train.conf is implied
+CASES = {
+    "binary_classification": ["binary.train", "binary.test",
+                              "binary.train.weight", "binary.test.weight",
+                              "forced_splits.json"],
+    "lambdarank": ["rank.train", "rank.test", "rank.train.query",
+                   "rank.test.query"],
+    "multiclass_classification": ["multiclass.train", "multiclass.test"],
+    "regression": ["regression.train", "regression.test",
+                   "regression.train.init", "regression.test.init"],
+}
+
+METRIC_RE = re.compile(
+    r"Iteration:(\d+), (valid_1|training) ([a-zA-Z_@0-9.]+) : ([-0-9.eE+]+)")
+
+
+def run_case(cli, name, files):
+    src = EXAMPLES / name
+    with tempfile.TemporaryDirectory() as td:
+        for f in files + ["train.conf"]:
+            if (src / f).exists():
+                shutil.copy(src / f, td)
+        out = subprocess.run([cli, "config=train.conf"], cwd=td,
+                             capture_output=True, text=True, timeout=600)
+        text = out.stdout + out.stderr
+        if "Finished training" not in text:
+            raise RuntimeError(f"{name}: stock CLI failed:\n{text[-2000:]}")
+    finals = {}
+    for it, split, metric, val in METRIC_RE.findall(text):
+        finals[f"{split}:{metric}"] = float(val)   # last occurrence wins
+    return finals
+
+
+def main():
+    cli = os.environ.get("LGBM_CLI", "/tmp/refsrc2/lightgbm")
+    if not Path(cli).exists():
+        sys.exit(f"stock CLI not found at {cli}; set LGBM_CLI")
+    fixtures = {}
+    for name, files in CASES.items():
+        fixtures[name] = run_case(cli, name, files)
+        print(name, {k: v for k, v in fixtures[name].items()
+                     if k.startswith("valid_1")})
+    dest = REPO / "tests" / "fixtures" / "examples_stock.json"
+    dest.write_text(json.dumps(fixtures, indent=1, sort_keys=True) + "\n")
+    print("wrote", dest)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Real-data oracle tier: the reference's bundled example datasets.
+
+Trains via OUR CLI on the reference's own example configs
+(reference: examples/*/train.conf, the same data+confs its
+tests/python_package_test/test_consistency.py and cpp_tests/testutils.cpp
+consume) and asserts final validation metrics match stock LightGBM's
+within tolerance.  The stock numbers are committed fixtures produced by
+`LGBM_CLI=... python scripts/gen_example_fixtures.py` (a CLI built from
+/root/reference; see the memory notes in that script).
+
+Exact per-tree parity is impossible here by design — these confs use
+feature_fraction/bagging, whose RNG differs between implementations —
+so the gate is metric parity on real data, like the reference's own
+consistency suite.
+"""
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+EXAMPLES = Path("/root/reference/examples")
+FIXTURES = json.loads(
+    (Path(__file__).parent / "fixtures" / "examples_stock.json").read_text())
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not EXAMPLES.exists(),
+                       reason="reference examples not mounted"),
+]
+
+
+def _run_cli(tmp_path, example, files, overrides=()):
+    src = EXAMPLES / example
+    for f in list(files) + ["train.conf"]:
+        if (src / f).exists():
+            shutil.copy(src / f, tmp_path / f)
+    from lightgbm_tpu import cli
+    import os
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = cli.main(["config=train.conf", "verbosity=-1", *overrides])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    return lgb.Booster(model_file=str(tmp_path / "LightGBM_model.txt"))
+
+
+def _load_tsv(path):
+    mat = np.loadtxt(path)
+    return mat[:, 1:], mat[:, 0]
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    r = np.empty(len(p))
+    r[order] = np.arange(len(p))
+    npos = (y > 0.5).sum()
+    nneg = len(y) - npos
+    return (r[y > 0.5].sum() - npos * (npos - 1) / 2) / (npos * nneg)
+
+
+def test_binary_example(tmp_path):
+    bst = _run_cli(tmp_path, "binary_classification",
+                   ["binary.train", "binary.test", "binary.train.weight",
+                    "binary.test.weight", "forced_splits.json"])
+    X, y = _load_tsv(tmp_path / "binary.test")
+    auc = _auc(y, bst.predict(X, raw_score=True))
+    stock = FIXTURES["binary_classification"]["valid_1:auc"]
+    assert abs(auc - stock) < 0.02, (auc, stock)
+
+
+def test_regression_example(tmp_path):
+    bst = _run_cli(tmp_path, "regression",
+                   ["regression.train", "regression.test",
+                    "regression.train.init", "regression.test.init"])
+    X, y = _load_tsv(tmp_path / "regression.test")
+    # stock evaluates l2 on the valid set INCLUDING its .init offsets
+    init = np.loadtxt(tmp_path / "regression.test.init")
+    l2 = float(np.mean((y - (bst.predict(X) + init)) ** 2))
+    stock = FIXTURES["regression"]["valid_1:l2"]
+    assert abs(l2 - stock) < 0.02, (l2, stock)
+
+
+def test_lambdarank_example(tmp_path):
+    bst = _run_cli(tmp_path, "lambdarank",
+                   ["rank.train", "rank.test", "rank.train.query",
+                    "rank.test.query"])
+    from sklearn.datasets import load_svmlight_file
+    X, y = load_svmlight_file(str(tmp_path / "rank.test"), zero_based=True)
+    q = np.loadtxt(tmp_path / "rank.test.query").astype(int)
+    score = bst.predict(X.toarray())
+    # NDCG@5 with LightGBM's 2^label-1 gains and position discounts
+    vals = []
+    start = 0
+    for s in q:
+        lb, sc = y[start:start + s], score[start:start + s]
+        start += s
+        gains = 2.0 ** lb - 1
+        if gains.max() <= 0:
+            continue
+        order = np.argsort(-sc)[:5]
+        disc = 1.0 / np.log2(np.arange(2, 2 + len(order)))
+        dcg = float(np.sum(gains[order] * disc))
+        ideal = np.sort(gains)[::-1][:5]
+        vals.append(dcg / float(np.sum(ideal * disc[:len(ideal)])))
+    ndcg = float(np.mean(vals))
+    stock = FIXTURES["lambdarank"]["valid_1:ndcg@5"]
+    # stock's own ndcg@5 across seeds 1..4 on this conf spans
+    # 0.6416..0.6851 (bagging_fraction=0.9 RNG) — tolerance covers that
+    # seed variance, not implementation slack
+    assert abs(ndcg - stock) < 0.05, (ndcg, stock)
+
+
+def test_multiclass_example(tmp_path):
+    bst = _run_cli(tmp_path, "multiclass_classification",
+                   ["multiclass.train", "multiclass.test"])
+    X, y = _load_tsv(tmp_path / "multiclass.test")
+    p = bst.predict(X)
+    eps = 1e-15
+    logloss = float(np.mean(-np.log(
+        np.clip(p[np.arange(len(y)), y.astype(int)], eps, 1.0))))
+    stock = FIXTURES["multiclass_classification"]["valid_1:multi_logloss"]
+    assert abs(logloss - stock) < 0.08, (logloss, stock)
+
+
+def test_parallel_learning_example(tmp_path):
+    """The parallel_learning example conf (tree_learner=feature) on the
+    in-process device mesh; same binary data, same metric gate."""
+    src = EXAMPLES / "parallel_learning"
+    for f in ["binary.train", "binary.test", "train.conf"]:
+        shutil.copy(src / f, tmp_path / f)
+    bst = _run_cli(tmp_path, "parallel_learning",
+                   ["binary.train", "binary.test"],
+                   overrides=["num_machines=1"])
+    X, y = _load_tsv(tmp_path / "binary.test")
+    auc = _auc(y, bst.predict(X, raw_score=True))
+    stock = FIXTURES["binary_classification"]["valid_1:auc"]
+    assert abs(auc - stock) < 0.02, (auc, stock)

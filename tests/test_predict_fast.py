@@ -1,0 +1,124 @@
+"""Single-row fast predict path (reference: c_api.h:1399-1428
+PredictForMatSingleRowFastInit/Fast).  Correctness vs the batch predictor
+and a latency pin proving no device dispatch happens per call."""
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _fit_model(objective="binary", n=800, num_class=1, cat=True):
+    rs = np.random.RandomState(7)
+    X = rs.randn(n, 6)
+    if cat:
+        X[:, 4] = rs.randint(0, 9, n)
+    X[rs.rand(n) < 0.15, 0] = np.nan
+    if objective == "multiclass":
+        y = rs.randint(0, num_class, n).astype(np.float64)
+        y[X[:, 1] > 0.5] = 0
+    else:
+        y = ((X[:, 1] > 0) ^ (X[:, 4] == 3)).astype(np.float64)
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "seed": 3}
+    if objective == "multiclass":
+        params["num_class"] = num_class
+    ds = lgb.Dataset(X, label=y,
+                     categorical_feature=[4] if cat else "auto")
+    return lgb.train(params, ds, num_boost_round=5), X
+
+
+@pytest.mark.parametrize("raw", [True, False])
+def test_single_row_matches_batch(raw):
+    bst, X = _fit_model()
+    batch = bst.predict(X[:50], raw_score=raw)
+    fast = bst.predict_single_row_fast_init(raw_score=raw)
+    got = np.array([fast(X[i]) for i in range(50)])
+    # raw scores are bit-exact; probabilities differ ~1e-7 (the engine
+    # sigmoid is float32-jax, the serving transform float64-numpy)
+    tol = 1e-12 if raw else 1e-6
+    np.testing.assert_allclose(got, batch, rtol=tol, atol=tol)
+
+
+def test_predict_one_row_uses_fast_path_and_matches():
+    bst, X = _fit_model()
+    batch = bst.predict(X[:20], raw_score=True)
+    one_by_one = np.concatenate(
+        [bst.predict(X[i:i + 1], raw_score=True) for i in range(20)])
+    np.testing.assert_allclose(one_by_one, batch, rtol=1e-12, atol=1e-12)
+    assert getattr(bst, "_fast1_cache", None) is not None
+
+
+def test_single_row_multiclass():
+    bst, X = _fit_model(objective="multiclass", num_class=3, cat=False)
+    batch = bst.predict(X[:25])
+    fast = bst.predict_single_row_fast_init()
+    got = np.stack([fast(X[i]) for i in range(25)])
+    np.testing.assert_allclose(got, batch, rtol=1e-6, atol=1e-7)
+
+
+def test_single_row_model_roundtrip_and_nan():
+    bst, X = _fit_model()
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    fast = bst2.predict_single_row_fast_init(raw_score=True)
+    row = X[3].copy()
+    row[0] = np.nan
+    np.testing.assert_allclose(
+        fast(row), bst.predict(row.reshape(1, -1), raw_score=True)[0],
+        rtol=1e-12)
+
+
+def test_single_row_wrong_feature_count():
+    bst, X = _fit_model()
+    fast = bst.predict_single_row_fast_init()
+    with pytest.raises(lgb.LightGBMError, match="6"):
+        fast(X[0, :4])
+
+
+def test_single_row_latency_sub_ms():
+    """The serving pin from the reference's FastPredict design: on a 5-tree
+    model a pre-bound call must stay WELL under a millisecond (no device
+    dispatch, no jit, no per-tree NumPy overhead)."""
+    bst, X = _fit_model()
+    fast = bst.predict_single_row_fast_init(raw_score=True)
+    row = X[0]
+    fast(row)                      # warm (builds nothing, but page in)
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fast(row)
+    per_call = (time.perf_counter() - t0) / reps
+    assert per_call < 1e-3, f"{per_call*1e6:.0f} us/call"
+
+
+def test_convert_output_np_matches_jax():
+    """Every objective's NumPy serving transform must equal its jax
+    convert_output (the single-row path must not dispatch jax per call)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objectives import create_objective
+
+    rs = np.random.RandomState(0)
+    for name, kc in [("regression", 1), ("poisson", 1), ("gamma", 1),
+                     ("tweedie", 1), ("binary", 1), ("multiclass", 3),
+                     ("multiclassova", 3), ("cross_entropy", 1),
+                     ("cross_entropy_lambda", 1),
+                     ("quantile", 1), ("huber", 1), ("fair", 1), ("mape", 1)]:
+        params = {"objective": name, "sigmoid": 1.3}
+        if kc > 1:
+            params["num_class"] = kc
+        obj = create_objective(Config.from_params(params))
+        raw = rs.randn(40, kc).astype(np.float32) if kc > 1 \
+            else rs.randn(40).astype(np.float32)
+        a = np.asarray(obj.convert_output(raw))
+        b = obj.convert_output_np(raw)
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7), name
+
+
+def test_single_row_probability_no_jax(monkeypatch):
+    """The non-raw fast path uses the NumPy transform end to end."""
+    bst, X = _fit_model()
+    fast = bst.predict_single_row_fast_init()
+    p = fast(X[0])
+    assert 0.0 < p < 1.0
+    np.testing.assert_allclose(p, bst.predict(X[:1])[0], rtol=1e-6)
