@@ -1096,22 +1096,28 @@ class Booster:
 
         n = X.shape[0]
         early_stop = bool(kwargs.get("pred_early_stop", False))
-        es_freq = int(kwargs.get("pred_early_stop_freq", 10))
+        # freq < 1 would never fire (and 0 would crash the modulo); clamp
+        es_freq = max(int(kwargs.get("pred_early_stop_freq", 10)), 1)
         es_margin = float(kwargs.get("pred_early_stop_margin", 10.0))
         # init scores are folded into tree 0 at training time (AddBias), so a plain
         # sum over trees is the complete raw score
         score = None
-        if not early_stop:
-            if n == 1:
-                # serving path: pre-bound single-row C tree walk, cached per
-                # (model, iteration slice) — no device dispatch, no per-tree
-                # NumPy overhead (reference: c_api.h:1399 SingleRowFast)
-                fp = self._single_row_fast_cached(use, start_iteration,
-                                                 end_iteration, k)
-                raw = fp.raw_predict(X[0])
-                score = raw[:1] if k == 1 else raw.reshape(1, k)
-            if score is None:
-                score = self._try_device_predict(X, use, k)
+        if n == 1 and not early_stop:
+            # serving path: pre-bound single-row C tree walk, cached per
+            # (model, iteration slice) — no device dispatch, no per-tree
+            # NumPy overhead (reference: c_api.h:1399 SingleRowFast)
+            fp = self._single_row_fast_cached(use, start_iteration,
+                                              end_iteration, k)
+            raw = fp.raw_predict(X[0])
+            score = raw[:1] if k == 1 else raw.reshape(1, k)
+        if score is None:
+            # pred_early_stop composes with the device batch walk (k == 1):
+            # the kernel freezes cleared rows every es_freq trees, exactly
+            # the host loop's bookkeeping (the reference's early stop is a
+            # latency optimization; forcing the host loop would pessimize
+            # wide batches)
+            es = (es_freq, es_margin) if early_stop else None
+            score = self._try_device_predict(X, use, k, es=es)
         if score is None:
             if k == 1:
                 score = np.zeros(n, np.float64)
@@ -1203,15 +1209,19 @@ class Booster:
 
     _DEVICE_PREDICT_MIN_ROWS = 20_000
 
-    def _try_device_predict(self, X, use, k):
+    def _try_device_predict(self, X, use, k, es=None):
         """Batched on-device prediction (pallas/predict_kernel.py): bin the
         raw matrix with the training mappers and walk all trees on-chip.
         Returns None when the fast path does not apply (small batch, no
         engine, categorical splits, CPU backend) — reference analog:
-        predictor.hpp picks per-row vs batch paths."""
+        predictor.hpp picks per-row vs batch paths.  es=(freq, margin)
+        composes prediction early stopping with the device walk (k == 1
+        only; multiclass margins couple classes, so they stay host-side)."""
         import jax
         if (self._engine is None or not use
                 or X.shape[0] < self._DEVICE_PREDICT_MIN_ROWS):
+            return None
+        if es is not None and k != 1:
             return None
         if jax.default_backend() not in ("tpu", "axon"):
             from .pallas import predict_kernel
@@ -1249,13 +1259,15 @@ class Booster:
                                    "bundled", "nan_bin", "num_bins")}
         maxd = max(max(tree_max_depth(t) for t in use), 1)
         n = X.shape[0]
+        es_freq, es_margin = (int(es[0]), float(es[1])) if es else (0, 0.0)
         outs = []
         for c in range(k):
             trees_c = [t for i, t in enumerate(use) if i % k == c]
             tabs = build_predict_tables(trees_c, routing_np, L,
                                         bin_mappers=tb.bin_mappers)
             s = predict_stream(slay.bins_T, jnp.asarray(tabs), L,
-                               len(trees_c), maxd)
+                               len(trees_c), maxd, es_freq=es_freq,
+                               es_margin=es_margin)
             outs.append(s)
         host = jax.device_get(outs)
         if k == 1:

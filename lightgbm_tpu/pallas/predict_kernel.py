@@ -35,13 +35,16 @@ _INTERPRET = False
 
 
 def _predict_kernel(bins_ref, tabs_ref, out_ref, *, T, L, GW, n_trees,
-                    max_depth):
+                    max_depth, es_freq: int = 0, es_margin: float = 0.0):
     i32, bf16, f32 = jnp.int32, jnp.bfloat16, jnp.float32
     words = bins_ref[...]                                    # (GW, T)
     l_iota = jax.lax.broadcasted_iota(i32, (L, T), 0)
     gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
 
-    def tree_body(t, score):
+    def tree_body(t, carry):
+        # score-only carry when early stop is off: the active mask and its
+        # per-tree select exist only under es_freq > 0
+        score, active = carry if es_freq else (carry, None)
         tab = tabs_ref[pl.ds(t * ROWS_PER_TREE, ROWS_PER_TREE), :]  # (24, L)
         tab_bf = tab.astype(bf16)
         enc = jnp.zeros((1, T), i32)       # node 0; >= L means "at leaf ~"
@@ -82,18 +85,37 @@ def _predict_kernel(bins_ref, tabs_ref, out_ref, *, T, L, GW, n_trees,
         lv = jax.lax.dot_general(
             tab_bf[P_LEAF_HI:P_LEAF_LO + 1], leaf_oh, (((1,), (0,)), ((), ())),
             preferred_element_type=f32)                      # (2, T)
-        return score + lv[0:1] + lv[1:2]
+        if not es_freq:
+            return score + lv[0:1] + lv[1:2]
+        # prediction early stopping (reference: prediction_early_stop.cpp
+        # CreateBinary): every es_freq trees, rows whose margin 2|score|
+        # clears the threshold freeze — the host loop's `active`
+        # bookkeeping vectorized per block, applied to the device walk's
+        # own (bf16-summed) scores, so rows landing within bf16 error of
+        # the margin may freeze one checkpoint apart from the f64 host loop
+        score = score + jnp.where(active > 0, lv[0:1] + lv[1:2], 0.0)
+        at_check = ((t + 1) % es_freq) == 0
+        stopped = (2.0 * jnp.abs(score)) > es_margin
+        return score, jnp.where(at_check & stopped, 0, active)
 
-    out_ref[...] = jax.lax.fori_loop(0, n_trees, tree_body,
-                                     jnp.zeros((1, T), f32))
+    init = jnp.zeros((1, T), f32)
+    if es_freq:
+        score, _ = jax.lax.fori_loop(0, n_trees, tree_body,
+                                     (init, jnp.ones((1, T), i32)))
+    else:
+        score = jax.lax.fori_loop(0, n_trees, tree_body, init)
+    out_ref[...] = score
 
 
 @functools.partial(jax.jit, static_argnames=("num_leaves", "n_trees",
-                                             "max_depth", "block_rows"))
+                                             "max_depth", "block_rows",
+                                             "es_freq", "es_margin"))
 def predict_stream(bins_T: jax.Array, tabs: jax.Array, num_leaves: int,
-                   n_trees: int, max_depth: int, block_rows: int = 1024):
+                   n_trees: int, max_depth: int, block_rows: int = 1024,
+                   es_freq: int = 0, es_margin: float = 0.0):
     """Raw-score prediction: (GW, N_pad) packed bins + (n_trees*24, L) tables
-    -> (N_pad,) f32 summed leaf values."""
+    -> (N_pad,) f32 summed leaf values.  es_freq > 0 enables the binary
+    prediction-early-stop margin check every es_freq trees."""
     GW, n_pad = bins_T.shape
     T = block_rows
     NB = n_pad // T
@@ -101,7 +123,8 @@ def predict_stream(bins_T: jax.Array, tabs: jax.Array, num_leaves: int,
 
     out = pl.pallas_call(
         functools.partial(_predict_kernel, T=T, L=L, GW=GW, n_trees=n_trees,
-                          max_depth=max_depth),
+                          max_depth=max_depth, es_freq=es_freq,
+                          es_margin=es_margin),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),

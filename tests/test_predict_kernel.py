@@ -81,3 +81,50 @@ def test_categorical_model_falls_back():
     assert bst._try_device_predict(X, use, 1) is None  # cat -> host fallback
     p = bst.predict(X)
     assert np.corrcoef(p, y)[0, 1] > 0.9
+
+
+def test_device_predict_early_stop_matches_host():
+    """pred_early_stop composes with the device batch walk (the kernel
+    freezes cleared rows every es_freq trees — reference:
+    prediction_early_stop.cpp CreateBinary) instead of forcing the host
+    per-tree loop; outputs must match the host early-stop path."""
+    rs = np.random.RandomState(11)
+    n = 1200
+    X = rs.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0)).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    kw = dict(raw_score=True, pred_early_stop=True,
+              pred_early_stop_freq=4, pred_early_stop_margin=2.0)
+    # device path taken: _try_device_predict returns non-None
+    assert bst._try_device_predict(X, bst._all_trees(), 1,
+                                   es=(4, 2.0)) is not None
+    p_dev = bst.predict(X, **kw)
+    big = Booster._DEVICE_PREDICT_MIN_ROWS
+    Booster._DEVICE_PREDICT_MIN_ROWS = 10 ** 9
+    try:
+        p_host = bst.predict(X, **kw)
+    finally:
+        Booster._DEVICE_PREDICT_MIN_ROWS = big
+    # early stopping must actually bite (outputs differ from full walk)
+    p_full = bst.predict(X, raw_score=True)
+    assert np.abs(p_host - p_full).max() > 1e-6
+    np.testing.assert_allclose(p_dev, p_host, rtol=1e-4, atol=1e-5)
+
+
+def test_device_predict_early_stop_multiclass_stays_host():
+    """Multiclass margins couple classes; the device walk declines and the
+    host loop keeps the reference's top1-top2 margin semantics."""
+    rs = np.random.RandomState(5)
+    X = rs.randn(600, 6)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y.astype(float)), num_boost_round=6)
+    assert bst._try_device_predict(X, bst._all_trees(), 3,
+                                   es=(2, 0.5)) is None
+    p = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=2,
+                    pred_early_stop_margin=0.5)
+    assert p.shape == (600, 3)
