@@ -454,13 +454,20 @@ class Dataset:
             self.raw_sparse = None
         return self
 
-    def _arrow_col(self, f: int) -> np.ndarray:
-        col = self.raw_arrow.column(f)
-        try:
-            return col.to_numpy(zero_copy_only=True)
-        except Exception:
-            # chunked / nullable columns fall back to one column-sized copy
-            return np.asarray(col.to_numpy(zero_copy_only=False), np.float64)
+    def _arrow_col_chunks(self, f: int):
+        """(start_row, values) per PRODUCER chunk — zero-copy numpy views
+        where the chunk's layout allows, one chunk-sized copy otherwise;
+        the full column is never coalesced (reference: arrow.h
+        ArrowChunkedArray)."""
+        start = 0
+        for ch in self.raw_arrow.column(f).chunks:
+            try:
+                vals = ch.to_numpy(zero_copy_only=True)
+            except Exception:
+                vals = np.asarray(ch.to_numpy(zero_copy_only=False),
+                                  np.float64)
+            yield start, vals
+            start += len(ch)
 
     def _construct_arrow(self, cfg) -> "Dataset":
         """Columnar construction from a pyarrow Table: sampling, bin-mapper
@@ -481,8 +488,14 @@ class Dataset:
         mappers = []
         samples = []
         for f in range(F):
-            col = np.asarray(self._arrow_col(f), np.float64)
-            sc = col[idx]
+            # sample gather per producer chunk: transient is O(chunk), the
+            # full column is never materialized
+            parts = []
+            for start, vals in self._arrow_col_chunks(f):
+                lo = np.searchsorted(idx, start)
+                hi = np.searchsorted(idx, start + len(vals))
+                parts.append(np.asarray(vals, np.float64)[idx[lo:hi] - start])
+            sc = np.concatenate(parts) if parts else np.zeros(0, np.float64)
             samples.append(sc)
             mb = cfg.max_bin if mbf is None else int(mbf[f])
             if f in cats:
@@ -499,8 +512,10 @@ class Dataset:
                                          enable_bundle=True)
         del samples
         self.binned = construct_binned_columns(
-            lambda f: np.asarray(self._arrow_col(f), np.float64), n, F,
-            mappers, groups)
+            None, n, F, mappers, groups,
+            get_col_chunks=lambda f: (
+                (s, np.asarray(v, np.float64))
+                for s, v in self._arrow_col_chunks(f)))
         if self.free_raw_data:
             self.raw_arrow = None
         return self

@@ -619,12 +619,18 @@ def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],
 
 def construct_binned_columns(get_col, n: int, num_features: int,
                              bin_mappers: List[BinMapper],
-                             groups: Optional[List[List[int]]] = None
-                             ) -> BinnedData:
+                             groups: Optional[List[List[int]]] = None,
+                             get_col_chunks=None) -> BinnedData:
     """Column-accessor variant of construct_binned: `get_col(f)` yields one
     feature column at a time, so columnar sources (Arrow tables) bin without
     ever materializing the (N, F) float64 matrix (reference: the zero-copy
-    Arrow chunked-array ingestion, include/LightGBM/arrow.h)."""
+    Arrow chunked-array ingestion, include/LightGBM/arrow.h).
+
+    get_col_chunks(f), when given, yields (start_row, chunk_values) pieces
+    instead — each chunk transforms straight into its row slice of the
+    binned output, so peak transient memory is O(chunk) rather than O(N)
+    (the arrow.h ArrowChunkedArray contract: chunk boundaries are the
+    producer's, never coalesced)."""
     assert len(bin_mappers) == num_features
     if groups is None:
         groups = [[f] for f in range(num_features)]
@@ -633,26 +639,36 @@ def construct_binned_columns(get_col, n: int, num_features: int,
      dtype) = _group_layout(groups, bin_mappers, num_features)
     bins = np.zeros((n, len(groups)), dtype=dtype)
 
+    def pieces(f):
+        if get_col_chunks is not None:
+            yield from get_col_chunks(f)
+        else:
+            yield 0, get_col(f)
+
     for gi, g in enumerate(groups):
         if len(g) == 1:
             f = g[0]
-            b = bin_mappers[f].transform(get_col(f))
-            bins[:, gi] = b.astype(dtype)
+            for start, vals in pieces(f):
+                b = bin_mappers[f].transform(vals)
+                bins[start:start + len(b), gi] = b.astype(dtype)
             feature_offsets[f] = group_offsets[gi]
         else:
             in_group = 1
-            col = np.zeros(n, dtype=np.int64)
             for f in g:
                 m = bin_mappers[f]
-                b = m.transform(get_col(f)).astype(np.int64)
-                nondef = b != m.default_bin
-                # shift: feature-local non-default bins map to
-                # [in_group, in_group + num_bins - 1); default stays 0 in the bundle
-                local = np.where(b > m.default_bin, b - 1, b)
-                col = np.where(nondef, in_group + local, col)
+                for start, vals in pieces(f):
+                    b = m.transform(vals).astype(np.int64)
+                    nondef = b != m.default_bin
+                    # shift: feature-local non-default bins map to
+                    # [in_group, in_group + num_bins - 1); default stays 0
+                    # in the bundle
+                    local = np.where(b > m.default_bin, b - 1, b)
+                    sl = slice(start, start + len(b))
+                    cur = bins[sl, gi].astype(np.int64)
+                    bins[sl, gi] = np.where(nondef, in_group + local,
+                                            cur).astype(dtype)
                 feature_offsets[f] = group_offsets[gi] + in_group - 1  # see split remap
                 in_group += m.num_bins - 1
-            bins[:, gi] = col.astype(dtype)
 
     return BinnedData(
         bins=bins,

@@ -11,7 +11,9 @@ Usage:
 """
 from __future__ import annotations
 
+import os
 import sys
+from pathlib import Path
 from typing import Any, Dict, List
 
 import numpy as np
@@ -71,10 +73,90 @@ def _coerce(params: Dict[str, str]) -> Dict[str, Any]:
     return out
 
 
+def _maybe_init_network(params: Dict[str, Any]) -> int:
+    """machines/num_machines wiring (reference: the Dask module's machine
+    list assembly, python-package/lightgbm/dask.py:196-215, and the socket
+    linker's find-own-rank, src/network/linkers_socket.cpp:83): each
+    machine locates itself in the `machines` list (or machine_list file)
+    by local address + local_listen_port, then the whole job connects via
+    jax.distributed with entry 0 as the coordinator.  Returns this
+    process's rank (0 when single-machine)."""
+    import socket
+
+    nm = int(params.get("num_machines", 1) or 1)
+    if nm <= 1:
+        return 0
+    machines = str(params.get("machines", "") or "")
+    if not machines:
+        mlf = params.get("machine_list_filename", "")
+        if mlf:
+            if not Path(str(mlf)).exists():
+                raise LightGBMError(f"machine list file {mlf!r} not found")
+            rows = [ln.split() for ln in
+                    Path(str(mlf)).read_text().splitlines() if ln.strip()]
+            machines = ",".join(f"{r[0]}:{r[1]}" for r in rows if len(r) >= 2)
+    if not machines:
+        raise LightGBMError(
+            "num_machines > 1 requires machines= or machine_list_filename= "
+            "(reference: Network::Init needs the machine list)")
+    entries = [m.strip() for m in machines.split(",") if m.strip()]
+    if len(entries) < nm:
+        raise LightGBMError(
+            f"machines lists {len(entries)} entries < num_machines={nm}")
+    entries = entries[:nm]
+    env_rank = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+    if env_rank is not None:
+        try:
+            rank = int(env_rank)
+        except ValueError:
+            raise LightGBMError(
+                f"LIGHTGBM_TPU_MACHINE_RANK={env_rank!r} is not an integer")
+        if not 0 <= rank < nm:
+            raise LightGBMError(
+                f"LIGHTGBM_TPU_MACHINE_RANK={rank} out of range for "
+                f"num_machines={nm} (ranks are 0-based)")
+    else:
+        port = str(params.get("local_listen_port", 12400))
+        local = {"127.0.0.1", "localhost", socket.gethostname()}
+        try:
+            local.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        # exact ip:port match first (localhost simulations need the port to
+        # disambiguate), then address-only (distinct real hosts)
+        rank = next((i for i, e in enumerate(entries)
+                     if e.rsplit(":", 1)[0] in local
+                     and e.rsplit(":", 1)[-1] == port), None)
+        if rank is None:
+            addr_matches = [i for i, e in enumerate(entries)
+                            if e.rsplit(":", 1)[0] in local]
+            if len(addr_matches) > 1:
+                # several local entries but none with our listen port:
+                # guessing one would give two processes the same rank and
+                # hang the coordinator — fail loud instead
+                raise LightGBMError(
+                    f"local_listen_port={port} matches none of the local "
+                    f"machine entries {[entries[i] for i in addr_matches]}; "
+                    "set local_listen_port to this process's entry or set "
+                    "LIGHTGBM_TPU_MACHINE_RANK")
+            rank = addr_matches[0] if addr_matches else None
+        if rank is None:
+            raise LightGBMError(
+                "this machine is not in the machines list; set "
+                "LIGHTGBM_TPU_MACHINE_RANK to pick a rank explicitly")
+    from .parallel.launcher import init_distributed
+    init_distributed(coordinator_address=entries[0], num_processes=nm,
+                     process_id=rank)
+    log_info(f"machine rank {rank}/{nm} connected (coordinator "
+             f"{entries[0]})")
+    return rank
+
+
 def run_train(params: Dict[str, Any]) -> None:
     data_path = params.get("data")
     if not data_path:
         raise LightGBMError("task=train requires data=<file>")
+    _maybe_init_network(params)
     ds = Dataset(str(data_path), params=dict(params))
     valid_sets, valid_names = [], []
     vspec = params.get("valid", params.get("valid_data", ""))

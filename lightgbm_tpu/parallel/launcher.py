@@ -40,7 +40,16 @@ def init_distributed(coordinator_address: Optional[str] = None,
     On TPU pods all arguments are auto-detected from the environment; on
     other platforms pass them explicitly."""
     import jax
-    if jax.process_count() > 1:
+
+    # NOTE: jax.process_count() would itself initialize the XLA backend,
+    # after which distributed.initialize is rejected — probe the
+    # distributed client state directly instead
+    try:
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - jax internals moved
+        already = False
+    if already:
         log_info("jax.distributed already initialized "
                  f"({jax.process_count()} processes)")
         return
@@ -56,6 +65,11 @@ def init_distributed(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
     except Exception as e:  # pragma: no cover - depends on cluster env
+        if "already initialized" in str(e).lower():
+            # belt-and-braces for the private-state probe above: an
+            # earlier explicit initialize is fine, keep the old no-op
+            log_info("jax.distributed already initialized")
+            return
         raise LightGBMError(
             f"jax.distributed.initialize failed: {e}; on non-TPU clusters "
             "pass coordinator_address/num_processes/process_id explicitly")

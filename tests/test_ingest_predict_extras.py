@@ -107,3 +107,52 @@ def test_pyarrow_columnar_binning_matches_dense():
     np.testing.assert_array_equal(np.asarray(ds_a.binned.bins),
                                   np.asarray(ds_d.binned.bins))
     assert ds_a.binned.group_features == ds_d.binned.group_features
+
+
+def test_pyarrow_multichunk_never_materializes_column():
+    """Chunk-bounded Arrow ingest (reference: include/LightGBM/arrow.h
+    ArrowChunkedArray): a multi-chunk table bins chunk-by-chunk — sampling,
+    mapper search and binning all read per-producer-chunk slices, and the
+    full float64 column/matrix is never coalesced. Bins must still be
+    bit-identical to dense ingestion."""
+    pa = pytest.importorskip("pyarrow")
+    rs = np.random.RandomState(3)
+    n = 1500
+    X = rs.randn(n, 4)
+    X[::11, 2] = np.nan
+    y = X[:, 0] + 0.1 * rs.randn(n)
+    # 5 uneven producer chunks per column
+    bounds = [0, 100, 471, 900, 1337, n]
+    cols = {}
+    for i in range(4):
+        cols[f"c{i}"] = pa.chunked_array(
+            [X[bounds[j]:bounds[j + 1], i] for j in range(5)])
+    table = pa.table(cols)
+    assert table.column(0).num_chunks == 5
+
+    ds_a = lgb.Dataset(table, label=y)
+    # spy on the chunk accessor: every piece handed to binning must be a
+    # producer chunk, never a coalesced full column
+    sizes = []
+    orig = lgb.Dataset._arrow_col_chunks
+
+    def spy(self, f):
+        for start, vals in orig(self, f):
+            sizes.append(len(vals))
+            yield start, vals
+    lgb.Dataset._arrow_col_chunks = spy
+    try:
+        ds_a.construct()
+    finally:
+        lgb.Dataset._arrow_col_chunks = orig
+    max_chunk = max(b - a for a, b in zip(bounds, bounds[1:]))
+    assert sizes and max(sizes) == max_chunk < n
+    ds_d = lgb.Dataset(X, label=y)
+    ds_d.construct()
+    np.testing.assert_array_equal(np.asarray(ds_a.binned.bins),
+                                  np.asarray(ds_d.binned.bins))
+    # and the model trains from the chunked dataset
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(table, label=y), num_boost_round=3)
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.8
