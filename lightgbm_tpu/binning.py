@@ -582,6 +582,39 @@ class BinnedData:
         return len(self.group_features)
 
 
+def _group_nbins(g: List[int], bin_mappers: List[BinMapper]) -> int:
+    if len(g) == 1:
+        return int(bin_mappers[g[0]].num_bins)
+    return 1 + sum(int(bin_mappers[f].num_bins) - 1 for f in g)
+
+
+def bin_bucket_size(nbins: int, bpad: Optional[int] = None) -> int:
+    """Power-of-two bin bucket (min 8) for the bucketed one-hot M-axis —
+    the ONE definition shared by the group sort (device_group_order) and
+    the kernel run computation (gbdt._resolved_bin_buckets): the two must
+    agree or same-bucket groups fragment into extra runs."""
+    b = 8
+    while b < nbins:
+        b *= 2
+    return min(b, bpad) if bpad is not None else b
+
+
+def device_group_order(groups: List[List[int]],
+                       bin_mappers: List[BinMapper]) -> List[List[int]]:
+    """Stable-sort groups by DESCENDING power-of-two bin bucket (min 8).
+
+    The streaming histogram kernel's one-hot rows are allocated per bucket
+    run (M = sum of each group's rounded bin count instead of
+    G x max_bins), so same-bucket groups must be contiguous. Datasets whose
+    groups all share one bucket — e.g. every feature at max_bin — keep
+    their original order (stable sort), and reordering never changes
+    results: split scans are per-feature through the layout's
+    gather/permutation."""
+    return sorted(groups,
+                  key=lambda g: bin_bucket_size(_group_nbins(g, bin_mappers)),
+                  reverse=True)
+
+
 def _group_layout(groups: List[List[int]], bin_mappers: List[BinMapper],
                   num_features: int):
     """Shared bin-layout bookkeeping for dense and sparse construction.
@@ -634,6 +667,7 @@ def construct_binned_columns(get_col, n: int, num_features: int,
     assert len(bin_mappers) == num_features
     if groups is None:
         groups = [[f] for f in range(num_features)]
+    groups = device_group_order(groups, bin_mappers)
 
     (group_bin_counts, group_offsets, feature_offsets, feature_num_bins,
      dtype) = _group_layout(groups, bin_mappers, num_features)
@@ -652,16 +686,30 @@ def construct_binned_columns(get_col, n: int, num_features: int,
                 b = bin_mappers[f].transform(vals)
                 bins[start:start + len(b), gi] = b.astype(dtype)
             feature_offsets[f] = group_offsets[gi]
+        elif get_col_chunks is None:
+            # dense single-piece path: one int64 accumulator per group,
+            # cast to the storage dtype once
+            in_group = 1
+            col = np.zeros(n, dtype=np.int64)
+            for f in g:
+                m = bin_mappers[f]
+                b = m.transform(get_col(f)).astype(np.int64)
+                nondef = b != m.default_bin
+                # shift: feature-local non-default bins map to
+                # [in_group, in_group + num_bins - 1); default stays 0 in
+                # the bundle
+                local = np.where(b > m.default_bin, b - 1, b)
+                col = np.where(nondef, in_group + local, col)
+                feature_offsets[f] = group_offsets[gi] + in_group - 1  # see split remap
+                in_group += m.num_bins - 1
+            bins[:, gi] = col.astype(dtype)
         else:
             in_group = 1
             for f in g:
                 m = bin_mappers[f]
-                for start, vals in pieces(f):
+                for start, vals in get_col_chunks(f):
                     b = m.transform(vals).astype(np.int64)
                     nondef = b != m.default_bin
-                    # shift: feature-local non-default bins map to
-                    # [in_group, in_group + num_bins - 1); default stays 0
-                    # in the bundle
                     local = np.where(b > m.default_bin, b - 1, b)
                     sl = slice(start, start + len(b))
                     cur = bins[sl, gi].astype(np.int64)
@@ -787,6 +835,7 @@ def construct_binned_sparse(X, bin_mappers: List[BinMapper],
     assert len(bin_mappers) == num_features
     if groups is None:
         groups = [[f] for f in range(num_features)]
+    groups = device_group_order(groups, bin_mappers)
     Xc = X.tocsc()
 
     (group_bin_counts, group_offsets, feature_offsets, feature_num_bins,

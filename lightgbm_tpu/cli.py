@@ -122,10 +122,25 @@ def _maybe_init_network(params: Dict[str, Any]) -> int:
             local.add(socket.gethostbyname(socket.gethostname()))
         except OSError:
             pass
+
+        def _is_local(addr: str) -> bool:
+            if addr in local:
+                return True
+            # binding succeeds only on a local interface address — covers
+            # hosts whose hostname maps to 127.0.1.1-style entries while
+            # the machines list carries the interface IP (the reference's
+            # linkers_socket.cpp enumerates interfaces for the same reason)
+            try:
+                with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                    s.bind((addr, 0))
+                local.add(addr)
+                return True
+            except OSError:
+                return False
         # exact ip:port match first (localhost simulations need the port to
         # disambiguate), then address-only (distinct real hosts)
         rank = next((i for i, e in enumerate(entries)
-                     if e.rsplit(":", 1)[0] in local
+                     if _is_local(e.rsplit(":", 1)[0])
                      and e.rsplit(":", 1)[-1] == port), None)
         if rank is None:
             addr_matches = [i for i, e in enumerate(entries)

@@ -139,12 +139,18 @@ class GBDT:
                 from ..pallas.stream_kernel import stream_block_rows
                 self._mesh_stream = True
                 # int8 and bf16 paths resolve different block sizes (both
-                # powers of two); padding to the larger keeps the per-device
-                # shard a whole number of kernel blocks for whichever tier
-                # _grow_params later picks
+                # powers of two), and the bucketed M-axis can raise the
+                # tier further; padding to the largest possible block keeps
+                # the per-device shard a whole number of kernel blocks for
+                # whatever _grow_params later picks
+                bb = self._resolved_bin_buckets()
                 pad_base = max(
                     stream_block_rows(dd.max_bins, dd.num_groups, False),
-                    stream_block_rows(dd.max_bins, dd.num_groups, True))
+                    stream_block_rows(dd.max_bins, dd.num_groups, True),
+                    stream_block_rows(dd.max_bins, dd.num_groups, True,
+                                      bin_buckets=bb),
+                    stream_block_rows(dd.max_bins, dd.num_groups, False,
+                                      bin_buckets=bb))
             n_pad = pad_rows_for_mesh(dd.bins.shape[0], self.mesh,
                                       base=pad_base)
             bins = dd.bins
@@ -204,7 +210,8 @@ class GBDT:
             packed = pack_bins_T(dd.bins,
                                  stream_block_rows(
                                      dd.max_bins, dd.num_groups,
-                                     self._grow_params.int_hist),
+                                     self._grow_params.int_hist,
+                                     bin_buckets=self._grow_params.bin_buckets),
                                  max_bins=dd.max_bins).bins_T
             if self._mesh_stream:
                 # rows were pre-padded to a whole kernel block per device, so
@@ -439,6 +446,34 @@ class GBDT:
             return 64   # PV-Tree is round-batched by design (top-2k election)
         return 1
 
+    def _resolved_bin_buckets(self):
+        """Static (bucket_bins, group_count) runs over the device group
+        layout for the stream kernel's bucketed one-hot M-axis.  Groups are
+        bucket-sorted at construction (binning.device_group_order); when
+        the dataset's groups genuinely vary in bin count (real-world
+        low-cardinality/sparse features), M = sum of rounded per-group bin
+        counts beats G * Bmax — otherwise (or for legacy unsorted binary
+        datasets that fragment into many runs) fall back to uniform."""
+        binned = getattr(self.train_data, "binned", None)
+        if binned is None or self._resolve_hist_backend() != "stream":
+            return None
+        from ..binning import bin_bucket_size
+        counts = np.asarray(binned.group_bin_counts, np.int64)
+        if len(counts) == 0:
+            return None
+        bpad = -(-int(counts.max()) // 8) * 8
+        buckets = []
+        for cnt in counts:
+            b = bin_bucket_size(int(cnt), bpad)
+            if buckets and buckets[-1][0] == b:
+                buckets[-1][1] += 1
+            else:
+                buckets.append([b, 1])
+        m_tot = sum(b * g for b, g in buckets)
+        if len(buckets) > 6 or m_tot >= 0.9 * len(counts) * bpad:
+            return None
+        return tuple((int(b), int(g)) for b, g in buckets)
+
     def _make_grow_params(self) -> GrowParams:
         c = self.config
         return GrowParams(
@@ -478,6 +513,7 @@ class GBDT:
                       and c.num_grad_quant_bins % 2 == 0
                       and (c.num_grad_quant_bins / 2)
                       * self.dd.bins.shape[0] < 2 ** 31),
+            bin_buckets=self._resolved_bin_buckets(),
             has_cegb=(c.cegb_penalty_split > 0.0
                       or (c.cegb_penalty_feature_coupled is not None
                           and len(np.atleast_1d(

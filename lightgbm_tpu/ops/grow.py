@@ -79,6 +79,10 @@ class GrowParams(NamedTuple):
     # gains resolve exactly as stock LightGBM resolves them
     hist_double: bool = False
     int_hist: bool = False       # int8 quantized-gradient histograms (stream)
+    # bucketed one-hot M-axis for the stream kernel: static runs of
+    # (bucket_bins, group_count) over the bucket-sorted group layout
+    # (binning.device_group_order); None = uniform G * Bmax rows
+    bin_buckets: tuple = None
     # cost-effective gradient boosting (cost_effective_gradient_boosting.hpp)
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
@@ -416,7 +420,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
                                             stream_block_rows)
-        T_rows = stream_block_rows(Bmax, G, params.int_hist)
+        T_rows = stream_block_rows(Bmax, G, params.int_hist,
+                                   bin_buckets=params.bin_buckets)
         if packed is None:
             with jax.named_scope("pack_bins"):
                 bins_T = pack_bins_T(bins, T_rows, max_bins=Bmax).bins_T
@@ -451,7 +456,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         bT, lid_row, wT, tb, bi, num_slots, Bmax, G, L,
                         block_rows=T_rows, has_cat=params.has_categorical,
                         two_pass=params.hist_two_pass, int_weights=use_int,
-                        with_hist=with_hist)
+                        with_hist=with_hist,
+                        bin_buckets=params.bin_buckets)
                     if with_hist:
                         h = jax.lax.psum(h, row_axis)
                     # route-only rounds return all-zero hists on every
@@ -475,7 +481,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     bT, lid_row, wT, tb, bi, num_slots, Bmax, G, L,
                     block_rows=T_rows, has_cat=params.has_categorical,
                     two_pass=params.hist_two_pass, int_weights=use_int,
-                    with_hist=with_hist)
+                    with_hist=with_hist, bin_buckets=params.bin_buckets)
 
         zL = jnp.zeros(L, i32)
         tabs0 = build_route_tables(zL, zL, zL, zL, zL, zL, zL,

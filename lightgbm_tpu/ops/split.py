@@ -149,6 +149,37 @@ def _layout_is_identity(layout: FeatureLayout, num_groups: int,
     return bool(np.array_equal(idx, expect))
 
 
+def _layout_group_perm(layout: FeatureLayout, num_groups: int,
+                       bmax: int):
+    """(F,) group index per feature when every feature owns a whole group
+    (single-feature groups in ANY order — the bucket-sorted device layout),
+    else None.  The per-feature "gather" is then a cheap whole-slice take
+    along the group axis instead of the latency-bound (S*F*Bmax)-row
+    generic gather."""
+    try:
+        idx = np.asarray(layout.gather_idx)
+        valid = np.asarray(layout.valid_mask)
+    except Exception:
+        return None
+    F = idx.shape[0]
+    if F != num_groups or idx.shape[1] != bmax:
+        return None
+    if not valid[:, 0].all():
+        return None
+    base = idx[:, 0]
+    if (base % bmax).any():
+        return None
+    perm = base // bmax
+    expect = base[:, None] + np.arange(bmax)[None, :]
+    # only VALID positions must line up (features with fewer bins than bmax
+    # leave zeros in the gather table; the take path masks them anyway)
+    if not np.array_equal(np.where(valid, idx, expect), expect):
+        return None
+    if not np.array_equal(np.sort(perm), np.arange(F)):
+        return None
+    return perm.astype(np.int32)
+
+
 def round_int(x):
     """Common::RoundInt (common.h:911) — the reference derives per-bin data
     counts from hessian sums as RoundInt(hess * cnt_factor) rather than
@@ -170,9 +201,16 @@ def gather_feature_histograms(hist: jax.Array, layout: FeatureLayout,
     if _layout_is_identity(layout, num_groups, bmax):
         hf = hist * layout.valid_mask[None, :, :, None]
     else:
-        flat = hist.reshape(s_dim, -1, num_ch)            # (S, G*Bmax, C)
-        hf = flat[:, layout.gather_idx, :]                # (S, F, Bmax, C)
-        hf = hf * layout.valid_mask[None, :, :, None]
+        perm = _layout_group_perm(layout, num_groups, bmax)
+        if perm is not None:
+            # bucket-sorted single-feature groups: whole-slice take on the
+            # group axis instead of the (S*F*Bmax)-row generic gather
+            hf = hist[:, jnp.asarray(perm)] \
+                * layout.valid_mask[None, :, :, None]
+        else:
+            flat = hist.reshape(s_dim, -1, num_ch)        # (S, G*Bmax, C)
+            hf = flat[:, layout.gather_idx, :]            # (S, F, Bmax, C)
+            hf = hf * layout.valid_mask[None, :, :, None]
     try:
         any_resid = bool((np.asarray(layout.residual_pos) >= 0).any())
     except Exception:

@@ -88,7 +88,8 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, *outs, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True,
                        int_weights: bool = False, f32_dots: bool = False,
-                       u8_layout: bool = False, with_hist: bool = True):
+                       u8_layout: bool = False, with_hist: bool = True,
+                       bin_buckets=None, m_rows: int = 0):
     if with_hist:
         hist_ref, cnt_ref = outs
     else:
@@ -224,14 +225,37 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # (a per-bin compare-block construct — B int8 compares of (G, T)
     # concatenated — measured 14% SLOWER than this key form: the 64-block
     # concat relayout costs more than the (B*G, T) key/iota compare)
-    g_iota = jax.lax.broadcasted_iota(i32, (G, T), 0)
-    key = bins_G * G + g_iota                                # (G, T)
-    key_t = jnp.concatenate([key] * B, axis=0)               # (B*G, T) tiled
-    r_iota = jax.lax.broadcasted_iota(i32, (B * G, T), 0)
-    oh_match = key_t == r_iota            # (B*G, T) bool, row r = b * G + g
-    if _ABLATE == "dblcon":      # additive probe: one extra (never-hit) construct
-        key_t2 = jnp.concatenate([key + B * G] * B, axis=0)
-        oh_match = oh_match | (key_t2 == r_iota)
+    if bin_buckets is None:
+        g_iota = jax.lax.broadcasted_iota(i32, (G, T), 0)
+        key = bins_G * G + g_iota                            # (G, T)
+        key_t = jnp.concatenate([key] * B, axis=0)           # (B*G, T) tiled
+        r_iota = jax.lax.broadcasted_iota(i32, (B * G, T), 0)
+        oh_match = key_t == r_iota        # (B*G, T) bool, row r = b * G + g
+        if _ABLATE == "dblcon":  # additive probe: one extra (never-hit) construct
+            key_t2 = jnp.concatenate([key + B * G] * B, axis=0)
+            oh_match = oh_match | (key_t2 == r_iota)
+    else:
+        # BUCKETED M-axis: groups are laid out in runs of equal bin-bucket
+        # size (binning.device_group_order), and each run contributes
+        # Bk * Gk one-hot rows — M = sum of rounded per-group bin counts
+        # instead of G * Bmax, which is where low-cardinality features'
+        # histogram cost actually goes (the reference's scatter never paid
+        # per-bin; this is the matmul formulation's equivalent).  Row
+        # r = roff_k + b * Gk + g_local; the key trick is per run.
+        parts = []
+        goff = roff = 0
+        for Bk, Gk in bin_buckets:
+            sub = bins_G[goff:goff + Gk, :]                  # (Gk, T)
+            gi_k = jax.lax.broadcasted_iota(i32, (Gk, T), 0)
+            key_k = sub * Gk + gi_k + roff
+            parts.extend([key_k] * Bk)
+            goff += Gk
+            roff += Bk * Gk
+        if m_rows > roff:
+            parts.append(jnp.full((m_rows - roff, T), -1, i32))
+        key_t = jnp.concatenate(parts, axis=0)               # (m_rows, T)
+        r_iota = jax.lax.broadcasted_iota(i32, (m_rows, T), 0)
+        oh_match = key_t == r_iota
 
     if int_weights:
         # Quantized-gradient histograms (reference: gradient_discretizer.cpp
@@ -320,7 +344,8 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
 
 
 def stream_block_rows(bmax: int, num_groups: int = 28,
-                      int_hist: bool = False) -> int:
+                      int_hist: bool = False,
+                      bin_buckets=None) -> int:
     """Rows per kernel block, sized so the (G*B, T) one-hot operand stays
     within ~8 MB of VMEM: int8 one-hots (quantized-gradient path) take
     4096-row blocks (measured ~3% faster than 2048 end to end), bf16
@@ -336,12 +361,21 @@ def stream_block_rows(bmax: int, num_groups: int = 28,
         return 1024
     B = -(-bmax // 8) * 8
     oh_bytes = 1 if int_hist else 2
+    if bin_buckets is not None:
+        m_rows = -(-sum(bk * gk for bk, gk in bin_buckets) // 128) * 128
+    else:
+        m_rows = num_groups * B
     # int8 one-hots get a 9 MB budget: at MSLR shapes (G=136, B=64) that
     # admits T=1024 (8.9 MB one-hot + 4.45 MB hist block still compiles),
-    # measured 3% faster end-to-end than the T=512 the 8 MB budget forces
+    # measured 3% faster end-to-end than the T=512 the 8 MB budget forces.
+    # bf16 is hard-capped at 2048: T=4096 at bf16 REGRESSED 5x even when
+    # the one-hot fit the budget (VMEM pressure kills the pipeline), and
+    # small bucketed m_rows would otherwise re-admit it
     budget = (9 if int_hist else 8) * 2 ** 20
-    for T in (4096, 2048, 1024, 512, 256):
-        if num_groups * B * T * oh_bytes <= budget:
+    tiers = (4096, 2048, 1024, 512, 256) if int_hist \
+        else (2048, 1024, 512, 256)
+    for T in tiers:
+        if m_rows * T * oh_bytes <= budget:
             return T
     return 256
 
@@ -384,12 +418,14 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024,
 @functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
                                              "num_leaves", "block_rows",
                                              "has_cat", "two_pass",
-                                             "int_weights", "with_hist"))
+                                             "int_weights", "with_hist",
+                                             "bin_buckets"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
                    has_cat: bool = True, two_pass: bool = True,
-                   int_weights: bool = False, with_hist: bool = True):
+                   int_weights: bool = False, with_hist: bool = True,
+                   bin_buckets=None):
     """One fused streaming pass: route rows through this round's splits and
     build grad/hess histograms and exact data counts of the rows' NEW slots.
 
@@ -410,16 +446,27 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                          f"histogram slots per round, got {S}")
     B = -(-bmax // 8) * 8
     u8_layout = bins_T.dtype == jnp.int8
+    if bin_buckets is not None:
+        if _ABLATE:
+            raise ValueError("LGBTPU_KABLATE probes require the uniform "
+                             "(non-bucketed) one-hot layout")
+        if sum(gk for _, gk in bin_buckets) != G:
+            raise ValueError(f"bin_buckets {bin_buckets} do not cover "
+                             f"{G} groups")
+        m_tot = sum(bk * gk for bk, gk in bin_buckets)
+        m_rows = -(-m_tot // 128) * 128
+    else:
+        m_rows = G * B
 
     hist_dtype = jnp.int32 if int_weights else jnp.float32
     out_specs = [
         pl.BlockSpec((1, T), lambda b: (0, b)),
-        pl.BlockSpec((G * B, 2 * S), lambda b: (0, 0)),
+        pl.BlockSpec((m_rows, 2 * S), lambda b: (0, 0)),
         pl.BlockSpec((1, S), lambda b: (0, 0)),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-        jax.ShapeDtypeStruct((G * B, 2 * S), hist_dtype),
+        jax.ShapeDtypeStruct((m_rows, 2 * S), hist_dtype),
         jax.ShapeDtypeStruct((1, S), jnp.float32),
     ]
     if not with_hist:
@@ -428,7 +475,8 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         functools.partial(_route_hist_kernel, T=T, G=G, B=B, S=S, L=L, GW=GW,
                           has_cat=has_cat, two_pass=two_pass,
                           int_weights=int_weights, f32_dots=_interp(),
-                          u8_layout=u8_layout, with_hist=with_hist),
+                          u8_layout=u8_layout, with_hist=with_hist,
+                          bin_buckets=bin_buckets, m_rows=m_rows),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),
@@ -449,6 +497,20 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         hist4 = jnp.zeros((S, G, bmax, 2), hist_dtype)
         return new_leaf, hist4, cnt.reshape(-1)
     new_leaf, hist, cnt = outs
+    if bin_buckets is not None:
+        # per-run unpack: rows [roff, roff + Bk*Gk) -> (S, Gk, Bk, 2),
+        # bins padded up to Bmax, runs concatenated in layout group order
+        parts4 = []
+        roff = 0
+        for Bk, Gk in bin_buckets:
+            blk = hist[roff:roff + Bk * Gk]
+            h4 = blk.reshape(Bk, Gk, 2, S).transpose(3, 1, 0, 2)
+            if Bk < bmax:
+                h4 = jnp.pad(h4, ((0, 0), (0, 0), (0, bmax - Bk), (0, 0)))
+            parts4.append(h4[:, :, :bmax, :])
+            roff += Bk * Gk
+        hist4 = jnp.concatenate(parts4, axis=1)
+        return new_leaf, hist4, cnt.reshape(-1)
     # (B*G, 2S) b-major rows -> (S, G, Bmax, 2); int histograms are
     # unscaled by the caller
     hist4 = hist.reshape(B, G, 2, S).transpose(3, 1, 0, 2)[:, :, :bmax, :]

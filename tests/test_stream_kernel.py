@@ -258,3 +258,65 @@ def test_stream_final_sprint_completes_tree():
     pos = auc_ranks[y > 0.5].mean()
     neg = auc_ranks[y < 0.5].mean()
     assert pos > neg + n / 10
+
+
+def test_bucketed_m_axis_exact():
+    """The bucketed one-hot M-axis (bin_buckets runs over bucket-sorted
+    groups) must produce BIT-IDENTICAL int32 histograms, routes and counts
+    to the uniform G*Bmax layout on mixed-cardinality data."""
+    rs = np.random.RandomState(7)
+    n = 1800
+    X = np.column_stack([
+        rs.randint(0, 2, (n, 2)).astype(float),      # 8-bucket
+        rs.randint(0, 10, (n, 3)).astype(float),     # 16-bucket
+        rs.randint(0, 25, (n, 2)).astype(float),     # 32-bucket
+        rs.randn(n, 3)])                             # 64-bucket
+    y = (X[:, 0] + X[:, 9] > 0.5).astype(float)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbosity": -1})
+    ds.construct()
+    dd = ds.device_data()
+    bins = dd.bins
+    N, G = bins.shape
+    Bmax = dd.max_bins
+    L = 8
+    counts = np.asarray(ds.binned.group_bin_counts)
+    # groups must be bucket-sorted descending by construction
+    buckets = []
+    for cnt in counts:
+        b = 8
+        while b < int(cnt):
+            b *= 2
+        if buckets and buckets[-1][0] == b:
+            buckets[-1][1] += 1
+        else:
+            buckets.append([b, 1])
+    bb = tuple((int(b), int(g)) for b, g in buckets)
+    assert len(bb) >= 3 and sum(g for _, g in bb) == G
+    assert [b for b, _ in bb] == sorted([b for b, _ in bb], reverse=True)
+
+    gi = rs.randint(-32, 33, N).astype(np.float32)
+    hi = rs.randint(0, 33, N).astype(np.float32)
+    slay = pack_bins_T(bins)
+    n_pad = slay.n_pad
+    w_T = jnp.zeros((8, n_pad), jnp.float32)
+    w_T = (w_T.at[0, :N].set(jnp.asarray(gi)).at[1, :N].set(jnp.asarray(hi))
+              .at[2, :N].set(1.0))
+    zL = jnp.zeros(L, jnp.int32)
+    # a real split on feature 0 so routing is exercised too
+    chosen = zL.at[0].set(1)
+    feats = zL
+    thrs = zL.at[0].set(0)
+    newid = zL.at[0].set(1)
+    tabs = build_route_tables(chosen, feats, thrs, zL, newid,
+                              zL.at[0].set(1), zL, zL, dd.routing, L)
+    Bpad = -(-Bmax // 8) * 8
+    bits = jnp.zeros((Bpad, L), jnp.bfloat16)
+    leaf_row = jnp.zeros((1, n_pad), jnp.int32)
+    args = (slay.bins_T, leaf_row, w_T, tabs, bits, 2, Bmax, G, L)
+    kw = dict(has_cat=False, int_weights=True)
+    nl_u, hist_u, cnt_u = route_and_hist(*args, **kw)
+    nl_b, hist_b, cnt_b = route_and_hist(*args, bin_buckets=bb, **kw)
+    np.testing.assert_array_equal(np.asarray(nl_u), np.asarray(nl_b))
+    np.testing.assert_array_equal(np.asarray(hist_u), np.asarray(hist_b))
+    np.testing.assert_allclose(np.asarray(cnt_u), np.asarray(cnt_b),
+                               atol=1e-6)
