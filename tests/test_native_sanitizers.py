@@ -1,0 +1,113 @@
+"""Sanitizer tier for the native host kernels (SURVEY aux subsystems:
+race/memory-error detection; reference analog: the ASAN/UBSAN CI lanes the
+C++ reference runs on its OpenMP code).
+
+Builds native/binner.cpp with -fsanitize=address,undefined into a
+standalone harness that exercises every extern-C entry point (CSV shape
+scan + parse, value_to_bin with NaN/missing variants, the multi-tree
+single-row walker incl. a categorical bitset split) and asserts a clean
+exit — any out-of-bounds read/write, leak, or UB aborts the binary."""
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SRC = REPO / "lightgbm_tpu" / "native" / "binner.cpp"
+
+_MAIN = r"""
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+void lgbt_rows_cols(const char*, int64_t, char, int, int64_t*, int64_t*);
+void lgbt_parse_csv(const char*, int64_t, char, int, int64_t, int64_t,
+                    double*);
+void lgbt_value_to_bin(const double*, int64_t, const double*, int32_t,
+                       int32_t, int32_t, int32_t, uint16_t*);
+void lgbt_predict_row(const double*, const int32_t*, int32_t,
+                      const int32_t*, const double*, const int32_t*,
+                      const uint8_t*, const int32_t*, const int32_t*,
+                      const int32_t*, const double*, const int32_t*,
+                      const uint32_t*, int32_t, double*);
+}
+
+int main() {
+  // CSV parse incl. header skip + ragged tail handling
+  const char* csv = "a,b,c\n1,2.5,nan\n4,-5e-1,6\n7,8,9\n";
+  int64_t rows = 0, cols = 0;
+  lgbt_rows_cols(csv, (int64_t)strlen(csv), ',', 1, &rows, &cols);
+  if (rows != 3 || cols != 3) return 1;
+  std::vector<double> out((size_t)rows * cols);
+  lgbt_parse_csv(csv, (int64_t)strlen(csv), ',', 1, rows, cols, out.data());
+  if (out[0] != 1.0 || out[4] != -0.5) return 2;
+
+  // value_to_bin across missing types, incl. NaN and boundary values
+  std::vector<double> vals = {-1e30, -1.0, 0.0, 0.5, 1.0, 1e30,
+                              std::nan("")};
+  std::vector<double> ub = {-0.5, 0.25, 0.75, 1e300};
+  std::vector<uint16_t> bins(vals.size());
+  for (int mt = 0; mt <= 2; ++mt)
+    lgbt_value_to_bin(vals.data(), (int64_t)vals.size(), ub.data(),
+                      (int32_t)ub.size(), mt, 5, 1, bins.data());
+
+  // two-tree walk: numeric split w/ NaN default-left + categorical bitset
+  // tree 0: 1 internal node (feature 0 <= 0.5), leaves -0.5 / 0.5
+  // tree 1: categorical node on feature 1, bitset holds category 3
+  std::vector<int32_t> tree_off = {0, 1, 2};
+  std::vector<int32_t> split_feature = {0, 1};
+  std::vector<double> threshold = {0.5, 0.0};
+  std::vector<int32_t> threshold_bin = {0, 0};   // cat ordinal for tree 1
+  std::vector<uint8_t> decision_type = {(uint8_t)(2 | (2 << 2)),
+                                        (uint8_t)1};
+  std::vector<int32_t> left = {~0, ~0}, right = {~1, ~1};
+  std::vector<int32_t> leaf_off = {0, 2};
+  std::vector<double> leaf_value = {-0.5, 0.5, -2.0, 2.0};
+  std::vector<int32_t> cat_boundaries = {0, 1};
+  std::vector<uint32_t> cat_threshold = {1u << 3};
+  double rowvals[4][2] = {{0.0, 3.0}, {1.0, 3.0},
+                          {std::nan(""), 7.0}, {0.2, -1.0}};
+  double expect[4] = {
+      -0.5 + -2.0,   // 0.0 <= 0.5 left; cat 3 in bitset -> left (-2.0)
+      0.5 + -2.0,    // 1.0 > 0.5 right; cat 3 -> left
+      -0.5 + 2.0,    // NaN numeric -> default_left; cat 7 not set -> right
+      -0.5 + 2.0};   // 0.2 left; cat -1 (negative) -> right
+  for (int r = 0; r < 4; ++r) {
+    double acc[1] = {0.0};
+    lgbt_predict_row(rowvals[r], tree_off.data(), 2, split_feature.data(),
+                     threshold.data(), threshold_bin.data(),
+                     decision_type.data(), left.data(), right.data(),
+                     leaf_off.data(), leaf_value.data(),
+                     cat_boundaries.data(), cat_threshold.data(), 1, acc);
+    if (std::fabs(acc[0] - expect[r]) > 1e-12) return 10 + r;
+  }
+  puts("sanitizer harness OK");
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_native_asan_ubsan(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    main_cpp = tmp_path / "main.cpp"
+    main_cpp.write_text(_MAIN)
+    exe = tmp_path / "san_harness"
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-fopenmp",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         str(SRC), str(main_cpp), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=120,
+                         env={"ASAN_OPTIONS": "detect_leaks=1",
+                              "UBSAN_OPTIONS": "print_stacktrace=1"})
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "sanitizer harness OK" in run.stdout
