@@ -7,9 +7,9 @@ standalone harness that exercises every extern-C entry point (CSV shape
 scan + parse, value_to_bin with NaN/missing variants, the multi-tree
 single-row walker incl. a categorical bitset split) and asserts a clean
 exit — any out-of-bounds read/write, leak, or UB aborts the binary."""
+import os
 import shutil
 import subprocess
-import sys
 from pathlib import Path
 
 import pytest
@@ -38,14 +38,16 @@ void lgbt_predict_row(const double*, const int32_t*, int32_t,
 }
 
 int main() {
-  // CSV parse incl. header skip + ragged tail handling
-  const char* csv = "a,b,c\n1,2.5,nan\n4,-5e-1,6\n7,8,9\n";
+  // CSV parse incl. header skip, a RAGGED short row (NaN-fill path) and
+  // a final line WITHOUT a trailing newline (EOF boundary scan)
+  const char* csv = "a,b,c\n1,2.5,nan\n4,-5e-1\n7,8,9";
   int64_t rows = 0, cols = 0;
   lgbt_rows_cols(csv, (int64_t)strlen(csv), ',', 1, &rows, &cols);
   if (rows != 3 || cols != 3) return 1;
   std::vector<double> out((size_t)rows * cols);
   lgbt_parse_csv(csv, (int64_t)strlen(csv), ',', 1, rows, cols, out.data());
   if (out[0] != 1.0 || out[4] != -0.5) return 2;
+  if (!std::isnan(out[5]) || out[8] != 9.0) return 3;   // ragged fill + EOF row
 
   // value_to_bin across missing types, incl. NaN and boundary values
   std::vector<double> vals = {-1e30, -1.0, 0.0, 0.5, 1.0, 1e30,
@@ -96,6 +98,12 @@ int main() {
 def test_native_asan_ubsan(tmp_path):
     if shutil.which("g++") is None:
         pytest.skip("no g++ toolchain")
+    probe = subprocess.run(
+        ["g++", "-fsanitize=address,undefined", "-x", "c++", "-", "-o",
+         str(tmp_path / "probe")], input="int main(){return 0;}",
+        capture_output=True, text=True, timeout=120)
+    if probe.returncode != 0:
+        pytest.skip("no ASan/UBSan runtime libraries")
     main_cpp = tmp_path / "main.cpp"
     main_cpp.write_text(_MAIN)
     exe = tmp_path / "san_harness"
@@ -107,7 +115,8 @@ def test_native_asan_ubsan(tmp_path):
     assert build.returncode == 0, build.stderr
     run = subprocess.run([str(exe)], capture_output=True, text=True,
                          timeout=120,
-                         env={"ASAN_OPTIONS": "detect_leaks=1",
+                         env={**os.environ,
+                              "ASAN_OPTIONS": "detect_leaks=1",
                               "UBSAN_OPTIONS": "print_stacktrace=1"})
     assert run.returncode == 0, run.stdout + run.stderr
     assert "sanitizer harness OK" in run.stdout
