@@ -599,6 +599,19 @@ def bin_bucket_size(nbins: int, bpad: Optional[int] = None) -> int:
     return min(b, bpad) if bpad is not None else b
 
 
+def bucket_group_pad(gk: int) -> int:
+    """Groups per bucket run pad to the 8-row sublane multiple in the
+    stream kernel's one-hot (never-matching pad keys keep the tiled concat
+    pieces aligned).  The ONE definition for the kernel's key layout, the
+    unpack, the VMEM budget and the bucket-vs-uniform cost model."""
+    return -(-gk // 8) * 8
+
+
+def bucket_run_rows(bk: int, gk: int) -> int:
+    """One-hot rows a (bucket_bins, group_count) run occupies."""
+    return bk * bucket_group_pad(gk)
+
+
 def device_group_order(groups: List[List[int]],
                        bin_mappers: List[BinMapper]) -> List[List[int]]:
     """Stable-sort groups by DESCENDING power-of-two bin bucket (min 8).

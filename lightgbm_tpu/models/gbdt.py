@@ -457,7 +457,7 @@ class GBDT:
         binned = getattr(self.train_data, "binned", None)
         if binned is None or self._resolve_hist_backend() != "stream":
             return None
-        from ..binning import bin_bucket_size
+        from ..binning import bin_bucket_size, bucket_run_rows
         counts = np.asarray(binned.group_bin_counts, np.int64)
         if len(counts) == 0:
             return None
@@ -469,7 +469,9 @@ class GBDT:
                 buckets[-1][1] += 1
             else:
                 buckets.append([b, 1])
-        m_tot = sum(b * g for b, g in buckets)
+        # cost with the kernel's actual sublane padding — fragmented
+        # layouts (one group per bucket) can pad PAST the uniform cost
+        m_tot = sum(bucket_run_rows(b, g) for b, g in buckets)
         if len(buckets) > 6 or m_tot >= 0.9 * len(counts) * bpad:
             return None
         return tuple((int(b), int(g)) for b, g in buckets)

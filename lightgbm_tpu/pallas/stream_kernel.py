@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .hist_kernel import _wsplit  # shared f32 -> (hi, lo) bf16 split
+from ..binning import bucket_group_pad, bucket_run_rows
 
 NUM_TAB = 24          # per-leaf table rows (padded to a sublane multiple)
 MAX_SLOTS = 255       # slot table rows are single bf16 digits (exact <= 256)
@@ -237,20 +238,26 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     else:
         # BUCKETED M-axis: groups are laid out in runs of equal bin-bucket
         # size (binning.device_group_order), and each run contributes
-        # Bk * Gk one-hot rows — M = sum of rounded per-group bin counts
+        # Bk * Gk8 one-hot rows — M = sum of rounded per-group bin counts
         # instead of G * Bmax, which is where low-cardinality features'
         # histogram cost actually goes (the reference's scatter never paid
         # per-bin; this is the matmul formulation's equivalent).  Row
-        # r = roff_k + b * Gk + g_local; the key trick is per run.
+        # r = roff_k + b * Gk8 + g_local; the key trick is per run.  Gk
+        # pads to a sublane multiple (8) with never-matching keys so the
+        # Bk tiled concat pieces stay aligned.
         parts = []
         goff = roff = 0
         for Bk, Gk in bin_buckets:
+            Gk8 = bucket_group_pad(Gk)
             sub = bins_G[goff:goff + Gk, :]                  # (Gk, T)
-            gi_k = jax.lax.broadcasted_iota(i32, (Gk, T), 0)
-            key_k = sub * Gk + gi_k + roff
+            if Gk8 > Gk:
+                sub = jnp.concatenate(
+                    [sub, jnp.full((Gk8 - Gk, T), 1 << 24, i32)], axis=0)
+            gi_k = jax.lax.broadcasted_iota(i32, (Gk8, T), 0)
+            key_k = sub * Gk8 + gi_k + roff
             parts.extend([key_k] * Bk)
             goff += Gk
-            roff += Bk * Gk
+            roff += Bk * Gk8
         if m_rows > roff:
             parts.append(jnp.full((m_rows - roff, T), -1, i32))
         key_t = jnp.concatenate(parts, axis=0)               # (m_rows, T)
@@ -362,7 +369,8 @@ def stream_block_rows(bmax: int, num_groups: int = 28,
     B = -(-bmax // 8) * 8
     oh_bytes = 1 if int_hist else 2
     if bin_buckets is not None:
-        m_rows = -(-sum(bk * gk for bk, gk in bin_buckets) // 128) * 128
+        m_rows = -(-sum(bucket_run_rows(bk, gk)
+                        for bk, gk in bin_buckets) // 128) * 128
     else:
         m_rows = num_groups * B
     # int8 one-hots get a 9 MB budget: at MSLR shapes (G=136, B=64) that
@@ -453,7 +461,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         if sum(gk for _, gk in bin_buckets) != G:
             raise ValueError(f"bin_buckets {bin_buckets} do not cover "
                              f"{G} groups")
-        m_tot = sum(bk * gk for bk, gk in bin_buckets)
+        m_tot = sum(bucket_run_rows(bk, gk) for bk, gk in bin_buckets)
         m_rows = -(-m_tot // 128) * 128
     else:
         m_rows = G * B
@@ -503,12 +511,13 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         parts4 = []
         roff = 0
         for Bk, Gk in bin_buckets:
-            blk = hist[roff:roff + Bk * Gk]
-            h4 = blk.reshape(Bk, Gk, 2, S).transpose(3, 1, 0, 2)
+            Gk8 = bucket_group_pad(Gk)
+            blk = hist[roff:roff + Bk * Gk8]
+            h4 = blk.reshape(Bk, Gk8, 2, S)[:, :Gk].transpose(3, 1, 0, 2)
             if Bk < bmax:
                 h4 = jnp.pad(h4, ((0, 0), (0, 0), (0, bmax - Bk), (0, 0)))
             parts4.append(h4[:, :, :bmax, :])
-            roff += Bk * Gk
+            roff += Bk * Gk8
         hist4 = jnp.concatenate(parts4, axis=1)
         return new_leaf, hist4, cnt.reshape(-1)
     # (B*G, 2S) b-major rows -> (S, G, Bmax, 2); int histograms are
