@@ -225,7 +225,7 @@ def run_ranking():
     # (small BENCH_ROWS) stay quick with both workloads on by default
     default_docs = round(2_270_000 * min(1.0, N_ROWS / HIGGS_ROWS))
     n_docs = int(os.environ.get("BENCH_RANK_ROWS", default_docs))
-    n_iters = int(os.environ.get("BENCH_RANK_ITERS", 20))
+    n_iters = int(os.environ.get("BENCH_RANK_ITERS", 30))
     gate = float(os.environ.get("BENCH_NDCG_GATE", 0.70))
     baseline_s_per_tree = 70.417 / 500.0   # MSLR CPU, Experiments.rst:117
     X, y, sizes = make_mslr_like(n_docs, 136)
