@@ -41,23 +41,40 @@ def _mappers_compatible(a, b) -> bool:
     return True
 
 
-def _to_2d_float(data) -> Tuple[np.ndarray, Optional[List[str]], List[int]]:
+def _to_2d_float(data, align_categories=None
+                 ) -> Tuple[np.ndarray, Optional[List[str]], List[int],
+                            Optional[List[list]]]:
     """Coerce supported data containers to float64 ndarray; returns
-    (array, feature_names or None, pandas_categorical_indices).
+    (array, feature_names or None, pandas_categorical_indices,
+    pandas_categorical_lists or None).
 
     Accepts ndarray/DataFrame, a LIST of row chunks (the reference's
     ChunkedArray streaming-push ingestion, include/LightGBM/c_api.h
     LGBM_DatasetCreateFromMats), and pyarrow Table/RecordBatch
-    (include/LightGBM/arrow.h)."""
+    (include/LightGBM/arrow.h).
+
+    align_categories: the TRAINING data's per-categorical-column category
+    lists (by categorical-column order) — predict-time DataFrames remap
+    their categories through them so codes agree with training even when
+    a frame's category order differs; unseen categories become NaN
+    (reference: python-package basic.py _data_from_pandas +
+    pandas_categorical in the model file)."""
     feature_names = None
     cat_idx: List[int] = []
     if isinstance(data, (list, tuple)) and data and all(
             (getattr(c, "ndim", 0) == 2) or hasattr(c, "columns")
             for c in data):
-        # chunked 2-D row blocks (list-of-1-D stays the plain ndarray path)
-        converted = [_to_2d_float(c) for c in data]
-        names0, cats0 = converted[0][1], converted[0][2]
-        return np.vstack([c[0] for c in converted]), names0, cats0
+        # chunked 2-D row blocks (list-of-1-D stays the plain ndarray path);
+        # chunks 1.. align their categorical codes to chunk 0's category
+        # lists, or a chunk whose local category order differs would code
+        # the same value differently
+        first = _to_2d_float(data[0], align_categories)
+        names0, cats0, lists0 = first[1], first[2], first[3]
+        align_rest = align_categories if align_categories is not None \
+            else lists0
+        converted = [first] + [_to_2d_float(c, align_rest)
+                               for c in data[1:]]
+        return np.vstack([c[0] for c in converted]), names0, cats0, lists0
     t_name = type(data).__module__
     if t_name.startswith("pyarrow"):
         import pyarrow as pa
@@ -67,24 +84,48 @@ def _to_2d_float(data) -> Tuple[np.ndarray, Optional[List[str]], List[int]]:
             feature_names = [str(c) for c in data.column_names]
             cols = [np.asarray(data.column(i).to_numpy(zero_copy_only=False),
                                np.float64) for i in range(data.num_columns)]
-            return np.column_stack(cols), feature_names, []
+            return np.column_stack(cols), feature_names, [], None
     if hasattr(data, "dtypes") and hasattr(data, "columns"):  # pandas DataFrame
         import pandas as pd
         feature_names = [str(c) for c in data.columns]
         df = data.copy()
+        cat_lists: List[list] = []
         for i, col in enumerate(df.columns):
             if isinstance(df[col].dtype, pd.CategoricalDtype):
-                df[col] = df[col].cat.codes
+                if align_categories is not None \
+                        and len(cat_lists) < len(align_categories):
+                    train_cats = align_categories[len(cat_lists)]
+                    frame_cats = list(df[col].cat.categories)
+                    if (train_cats and frame_cats
+                            and all(isinstance(t, str) for t in train_cats)
+                            and not set(train_cats) & set(frame_cats)):
+                        # model-file round trip stringifies non-JSON-native
+                        # categories (datetimes); match them by str()
+                        df[col] = df[col].cat.rename_categories(
+                            [str(c) for c in frame_cats])
+                    df[col] = df[col].cat.set_categories(train_cats)
+                cat_lists.append(list(df[col].cat.categories))
+                codes = df[col].cat.codes.astype(np.float64)
+                df[col] = codes.where(codes >= 0, np.nan)  # unseen -> NaN
                 cat_idx.append(i)
             elif df[col].dtype == object:
                 raise LightGBMError(f"DataFrame column {col!r} has object dtype; "
                                     "convert to numeric or categorical first")
+        if align_categories is not None \
+                and len(cat_lists) != len(align_categories):
+            # silent positional mis-alignment would produce wrong codes
+            # (stock: "train and valid dataset categorical_feature do not
+            # match")
+            raise LightGBMError(
+                f"DataFrame has {len(cat_lists)} categorical columns but "
+                f"the training data had {len(align_categories)}; "
+                "categorical columns must match training")
         arr = df.to_numpy(dtype=np.float64, na_value=np.nan)
-        return arr, feature_names, cat_idx
+        return arr, feature_names, cat_idx, (cat_lists or None)
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
-    return arr, feature_names, cat_idx
+    return arr, feature_names, cat_idx, None
 
 
 def _is_scipy_sparse(data) -> bool:
@@ -134,6 +175,7 @@ class Dataset:
         self._categorical_feature_arg = categorical_feature
         self._predictor = None
         self._dist = None
+        self.pandas_categorical = None   # training category lists (DataFrames)
         self.raw_seq = None
         self.raw_arrow = None
 
@@ -250,7 +292,12 @@ class Dataset:
             self._pandas_names, pandas_cat = None, []
             self.num_data_, self.num_feature_ = self.raw_sparse.shape
         else:
-            self.raw_data, self._pandas_names, pandas_cat = _to_2d_float(data)
+            # validation frames align their categorical codes to the
+            # TRAINING data's category lists (reference: pandas_categorical)
+            align = (self.reference.pandas_categorical
+                     if self.reference is not None else None)
+            (self.raw_data, self._pandas_names, pandas_cat,
+             self.pandas_categorical) = _to_2d_float(data, align)
             self.num_data_, self.num_feature_ = self.raw_data.shape
         self._pandas_cat_idx = pandas_cat
 
@@ -1091,7 +1138,7 @@ class Booster:
                 pred_contrib, validate_features, **kwargs)
                 for s in starts]
             return np.concatenate(outs, axis=0)
-        X, _, _ = _to_2d_float(data)
+        X, _, _, _ = _to_2d_float(data, self._pandas_categorical())
         expected = self.num_feature()
         if expected and X.shape[1] != expected:
             raise LightGBMError(
@@ -1302,6 +1349,15 @@ class Booster:
         if self._loaded_trees is not None:
             return self._loaded_trees.convert_output
         return lambda x: x
+
+    def _pandas_categorical(self):
+        """Training DataFrame category lists for predict-time code
+        alignment (reference: pandas_categorical in the model file)."""
+        if self._engine is not None:
+            return getattr(self.engine.train_data, "pandas_categorical", None)
+        if self._loaded_trees is not None:
+            return self._loaded_trees.pandas_categorical
+        return None
 
     def _convert_output_np_fn(self):
         """NumPy output transform for host serving paths — a per-call jax

@@ -194,7 +194,33 @@ def save_model_string(booster, num_iteration: Optional[int] = None,
     for key, val in sorted(params.items()):
         body += f"[{key}: {val}]\n"
     body += "end of parameters\n"
-    body += "\npandas_categorical:null\n"
+    # training DataFrame category lists, so predict-time frames remap their
+    # codes to training's (reference: basic.py dump pandas_categorical)
+    pc = None
+    try:
+        if booster._engine is not None:
+            pc = booster.engine.train_data.pandas_categorical
+        elif booster._loaded_trees is not None:
+            pc = booster._loaded_trees.pandas_categorical
+    except Exception:
+        pc = None
+    if pc:
+        import json as _json
+
+        def _json_default(o):
+            # numpy scalars keep their numeric identity; anything else
+            # (datetimes etc.) stringifies — predict-time alignment
+            # str()-matches those (basic.py _to_2d_float)
+            if isinstance(o, np.integer):
+                return int(o)
+            if isinstance(o, np.floating):
+                return float(o)
+            return str(o)
+
+        body += ("\npandas_categorical:"
+                 + _json.dumps(pc, default=_json_default) + "\n")
+    else:
+        body += "\npandas_categorical:null\n"
     return body
 
 
@@ -211,6 +237,7 @@ class LoadedModel:
         self.objective_string = "regression"
         self.average_output = False
         self.parameters: Dict[str, str] = {}
+        self.pandas_categorical = None
 
     def convert_output(self, raw):
         obj = self.objective_string.split(" ")[0] if self.objective_string else ""
@@ -254,6 +281,17 @@ def load_model_string(model_str: str) -> LoadedModel:
         raise LightGBMError("Model string is not a LightGBM model "
                             "(missing 'tree' header)")
     lm = LoadedModel()
+    for ln in reversed(lines[-8:]):
+        ln = ln.strip()
+        if ln.startswith("pandas_categorical:"):
+            payload = ln[len("pandas_categorical:"):]
+            if payload and payload != "null":
+                import json as _json
+                try:
+                    lm.pandas_categorical = _json.loads(payload)
+                except ValueError:
+                    pass
+            break
     i = 0
     # header
     while i < len(lines):

@@ -2,6 +2,8 @@
 from the chrome trace (dev tool).
 
 Usage: python scripts/profile_grow.py [rows]
+       PROFILE_TASK=ranking python scripts/profile_grow.py [docs]
+(BENCH_EXTRA_PARAMS merges into the training params for either task.)
 """
 import glob
 import gzip
@@ -18,20 +20,28 @@ import numpy as np
 
 
 def main():
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
     import jax
     import lightgbm_tpu as lgb
 
-    rs = np.random.RandomState(7)
-    X = rs.randn(rows, 28).astype(np.float32)
-    y = (rs.rand(rows) < 0.5).astype(np.float64)
+    ranking = os.environ.get("PROFILE_TASK", "") == "ranking"
+    default_rows = 2_270_000 if ranking else 10_500_000
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else default_rows
     params = {"objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
-              "max_bin": 63, "verbosity": -1, "max_splits_per_round": 64,
+              "max_bin": 63, "verbosity": -1,
               "use_quantized_grad": True, "num_grad_quant_bins": 64}
     extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
     if extra:
         params.update(json.loads(extra))
-    ds = lgb.Dataset(X, label=y)
+    if ranking:
+        import bench as B
+        X, y, sizes = B.make_mslr_like(rows, 136)
+        params["objective"] = "lambdarank"
+        ds = lgb.Dataset(X, label=y, group=sizes)
+    else:
+        rs = np.random.RandomState(7)
+        X = rs.randn(rows, 28).astype(np.float32)
+        y = (rs.rand(rows) < 0.5).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params, ds)
     for _ in range(3):      # warmup: compile everything
         bst.update()

@@ -156,3 +156,49 @@ def test_pyarrow_multichunk_never_materializes_column():
                      "verbosity": -1, "min_data_in_leaf": 5},
                     lgb.Dataset(table, label=y), num_boost_round=3)
     assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.8
+
+
+def test_pandas_categorical_alignment_roundtrip():
+    """Predict-time DataFrames with a DIFFERENT category order (or unseen
+    categories) must remap through the TRAINING category lists, in memory
+    and through a model-file round trip (reference: _data_from_pandas +
+    pandas_categorical in the model text)."""
+    pd = pytest.importorskip("pandas")
+    rs = np.random.RandomState(2)
+    n = 1200
+    colors = rs.choice(["red", "green", "blue", "violet"], n)
+    x1 = rs.randn(n)
+    y = ((colors == "red") | (x1 > 0.8)).astype(np.float64)
+    df = pd.DataFrame({
+        "c": pd.Categorical(colors, categories=["red", "green", "blue",
+                                                "violet"]),
+        "x": x1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(df, label=y, categorical_feature=["c"]),
+                    num_boost_round=8)
+    base = bst.predict(df, raw_score=True)
+
+    # same VALUES, different category-list order + an unseen category
+    df2 = pd.DataFrame({
+        "c": pd.Categorical(colors, categories=["violet", "blue", "green",
+                                                "red", "black"]),
+        "x": x1})
+    np.testing.assert_allclose(bst.predict(df2, raw_score=True), base,
+                               rtol=1e-12)
+
+    # model-file round trip carries the mapping
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst2.predict(df2, raw_score=True), base,
+                               rtol=1e-12)
+
+    # unseen category routes like a missing value, not like category 0
+    df3 = pd.DataFrame({
+        "c": pd.Categorical(["black"] * 4, categories=["black"]),
+        "x": np.zeros(4)})
+    p_unseen = bst.predict(df3, raw_score=True)
+    df_nan = pd.DataFrame({
+        "c": pd.Categorical([None] * 4, categories=["red"]),
+        "x": np.zeros(4)})
+    np.testing.assert_allclose(p_unseen, bst.predict(df_nan, raw_score=True),
+                               rtol=1e-12)
