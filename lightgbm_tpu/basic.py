@@ -96,13 +96,16 @@ def _to_2d_float(data, align_categories=None
                         and len(cat_lists) < len(align_categories):
                     train_cats = align_categories[len(cat_lists)]
                     frame_cats = list(df[col].cat.categories)
+                    strs = [str(c) for c in frame_cats]
                     if (train_cats and frame_cats
                             and all(isinstance(t, str) for t in train_cats)
-                            and not set(train_cats) & set(frame_cats)):
+                            and not set(train_cats) & set(frame_cats)
+                            and len(set(strs)) == len(strs)):
                         # model-file round trip stringifies non-JSON-native
-                        # categories (datetimes); match them by str()
-                        df[col] = df[col].cat.rename_categories(
-                            [str(c) for c in frame_cats])
+                        # categories (datetimes); match them by str() —
+                        # unless stringification collides, in which case
+                        # the values are simply unseen (-> missing)
+                        df[col] = df[col].cat.rename_categories(strs)
                     df[col] = df[col].cat.set_categories(train_cats)
                 cat_lists.append(list(df[col].cat.categories))
                 codes = df[col].cat.codes.astype(np.float64)
@@ -121,7 +124,10 @@ def _to_2d_float(data, align_categories=None
                 f"the training data had {len(align_categories)}; "
                 "categorical columns must match training")
         arr = df.to_numpy(dtype=np.float64, na_value=np.nan)
-        return arr, feature_names, cat_idx, (cat_lists or None)
+        # cat_lists may be EMPTY — "DataFrame trained with zero categorical
+        # columns" must stay distinguishable from "not a DataFrame" so the
+        # column-count check above still fires for categorical predict frames
+        return arr, feature_names, cat_idx, cat_lists
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -176,6 +182,7 @@ class Dataset:
         self._predictor = None
         self._dist = None
         self.pandas_categorical = None   # training category lists (DataFrames)
+        self._raw_container = None       # original user container (get_data)
         self.raw_seq = None
         self.raw_arrow = None
 
@@ -298,6 +305,10 @@ class Dataset:
                      if self.reference is not None else None)
             (self.raw_data, self._pandas_names, pandas_cat,
              self.pandas_categorical) = _to_2d_float(data, align)
+            if self._pandas_names is not None:
+                # keep the user's frame (a reference, not a copy) so
+                # get_data() can return the ORIGINAL like stock does
+                self._raw_container = data
             self.num_data_, self.num_feature_ = self.raw_data.shape
         self._pandas_cat_idx = pandas_cat
 
@@ -410,6 +421,46 @@ class Dataset:
                 cats.append(int(c))
         return sorted(set(cats))
 
+    def get_feature_name(self) -> List[str]:
+        """Alias of feature_name() (reference: Dataset.get_feature_name)."""
+        return self.feature_name()
+
+    def get_data(self):
+        """The raw data this Dataset was built from — the ORIGINAL
+        container for DataFrames (reference: Dataset.get_data; raises
+        after free_raw_data)."""
+        for attr in ("_raw_container", "raw_data", "raw_sparse",
+                     "raw_arrow", "raw_seq"):
+            v = getattr(self, attr, None)
+            if v is not None:
+                return v
+        raise LightGBMError(
+            "Cannot access raw data: it was freed (free_raw_data=True) or "
+            "the Dataset was loaded from a file/binary")
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Replace the categorical feature spec (reference:
+        Dataset.set_categorical_feature; must happen before construct())."""
+        if self.binned is not None and \
+                categorical_feature != self._categorical_feature_arg:
+            raise LightGBMError(
+                "Cannot change categorical_feature after the Dataset has "
+                "been constructed; build a new Dataset instead")
+        self._categorical_feature_arg = categorical_feature
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """The chain of reference Datasets reachable from this one
+        (reference: Dataset.get_ref_chain)."""
+        head = self
+        chain = set()
+        while head is not None and len(chain) < ref_limit:
+            if head in chain:
+                break
+            chain.add(head)
+            head = head.reference
+        return chain
+
     def feature_name(self) -> List[str]:
         if self._resolved_feature_names is not None:
             return self._resolved_feature_names
@@ -499,6 +550,7 @@ class Dataset:
         if self.free_raw_data:
             self.raw_data = None
             self.raw_sparse = None
+            self._raw_container = None
         return self
 
     def _arrow_col_chunks(self, f: int):
@@ -1385,6 +1437,143 @@ class Booster:
                    importance_type: str = "split") -> Dict:
         from .model_io import dump_model_dict
         return dump_model_dict(self, num_iteration, start_iteration, importance_type)
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Replace this booster's model with one parsed from `model_str`
+        (reference: basic.py:4445 — in-place load, returns self)."""
+        from .model_io import load_model_string
+        self._loaded_trees = load_model_string(model_str)
+        self._engine = None
+        self._fast1_cache = None
+        self.best_iteration = -1
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Name used for the training set in eval outputs (reference:
+        basic.py set_train_data_name)."""
+        self._train_data_name = name
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Connect this process to a multi-machine job (reference:
+        Booster.set_network / LGBM_NetworkInit — here the socket linker is
+        jax.distributed; see also lgb.init_distributed and the CLI's
+        machines= wiring)."""
+        from .cli import _maybe_init_network
+        if isinstance(machines, (list, tuple, set)):
+            machines = ",".join(str(m) for m in machines)
+        _maybe_init_network({"num_machines": num_machines,
+                             "machines": str(machines),
+                             "local_listen_port": local_listen_port})
+        return self
+
+    def trees_to_dataframe(self):
+        """Parsed model as a pandas DataFrame, one row per node, with the
+        reference's exact column set (reference: basic.py:3775)."""
+        try:
+            import pandas as pd
+        except ImportError as exc:
+            raise LightGBMError(
+                "trees_to_dataframe requires pandas") from exc
+        if self.num_trees() == 0:
+            raise LightGBMError(
+                "There are no trees in this Booster and thus nothing to parse")
+        model = self.dump_model()
+        feat_names = model["feature_names"]
+        rows: List[Dict[str, Any]] = []
+
+        def node_index(node, ti):
+            if "split_index" in node:
+                return f"{ti}-S{node['split_index']}"
+            return f"{ti}-L{node.get('leaf_index', 0)}"
+
+        def walk(node, ti, depth, parent):
+            idx = node_index(node, ti)
+            if "split_index" in node:
+                f = node["split_feature"]
+                rows.append({
+                    "tree_index": ti, "node_depth": depth, "node_index": idx,
+                    "left_child": node_index(node["left_child"], ti),
+                    "right_child": node_index(node["right_child"], ti),
+                    "parent_index": parent,
+                    "split_feature": (feat_names[f]
+                                      if f < len(feat_names) else str(f)),
+                    "split_gain": node["split_gain"],
+                    "threshold": node["threshold"],
+                    "decision_type": node["decision_type"],
+                    "missing_direction": ("left" if node.get("default_left")
+                                          else "right"),
+                    "missing_type": node.get("missing_type"),
+                    "value": node["internal_value"],
+                    "weight": node["internal_weight"],
+                    "count": node["internal_count"]})
+                walk(node["left_child"], ti, depth + 1, idx)
+                walk(node["right_child"], ti, depth + 1, idx)
+            else:
+                rows.append({
+                    "tree_index": ti, "node_depth": depth, "node_index": idx,
+                    "left_child": None, "right_child": None,
+                    "parent_index": parent, "split_feature": None,
+                    "split_gain": np.nan, "threshold": np.nan,
+                    "decision_type": None, "missing_direction": None,
+                    "missing_type": None,
+                    "value": node["leaf_value"],
+                    "weight": node.get("leaf_weight"),
+                    "count": node.get("leaf_count")})
+
+        for ti, tree in enumerate(model["tree_info"]):
+            walk(tree["tree_structure"], ti, 1, None)
+        return pd.DataFrame(rows)
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Value of one leaf (reference: basic.py:4883)."""
+        return float(self._all_trees()[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """Overwrite one leaf's value (reference: Tree::SetLeafOutput via
+        LGBM_BoosterSetLeafValue).  Invalidates cached predictors; under a
+        live engine the device score vectors keep their history — like the
+        reference, continued training after manual leaf edits reflects the
+        edit only in new predictions."""
+        t = self._all_trees()[tree_id]
+        lv = np.asarray(t.leaf_value, np.float64).copy()
+        lv[leaf_id] = value
+        t.leaf_value = lv           # rebind: predictor caches key on identity
+        self._fast1_cache = None
+        return self
+
+    def lower_bound(self) -> float:
+        """Lower bound of raw scores: per-tree minimum leaf values summed
+        (reference: GBDT::GetLowerBoundValue)."""
+        return float(sum(float(np.min(t.leaf_value))
+                         for t in self._all_trees()) or 0.0)
+
+    def upper_bound(self) -> float:
+        """Upper bound of raw scores (reference: GBDT::GetUpperBoundValue)."""
+        return float(sum(float(np.max(t.leaf_value))
+                         for t in self._all_trees()) or 0.0)
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute tree order in [start, end) iterations
+        (reference: GBDT::ShuffleModels; used before refit)."""
+        trees = self._all_trees()
+        k = self.num_model_per_iteration()
+        n_iter = len(trees) // max(k, 1)
+        end = n_iter if end_iteration <= 0 else min(end_iteration, n_iter)
+        idx = np.arange(start_iteration, end)
+        np.random.shuffle(idx)
+        order = list(range(n_iter))
+        order[start_iteration:end] = [int(i) for i in idx]
+        new_trees = []
+        for it in order:
+            new_trees.extend(trees[it * k:(it + 1) * k])
+        trees[:] = new_trees
+        self._fast1_cache = None
+        return self
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:

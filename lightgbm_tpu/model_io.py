@@ -204,7 +204,7 @@ def save_model_string(booster, num_iteration: Optional[int] = None,
             pc = booster._loaded_trees.pandas_categorical
     except Exception:
         pc = None
-    if pc:
+    if pc is not None:
         import json as _json
 
         def _json_default(o):

@@ -1,0 +1,96 @@
+"""API-parity extras (reference: python-package/lightgbm/basic.py):
+trees_to_dataframe, model_from_string, leaf output get/set, score bounds,
+shuffle_models, Dataset get_data/set_categorical_feature/get_ref_chain."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def model():
+    rs = np.random.RandomState(0)
+    X = rs.randn(800, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=5)
+    return bst, ds, X, y
+
+
+def test_trees_to_dataframe(model):
+    pd = pytest.importorskip("pandas")
+    bst, _, _, _ = model
+    df = bst.trees_to_dataframe()
+    expect_cols = ["tree_index", "node_depth", "node_index", "left_child",
+                   "right_child", "parent_index", "split_feature",
+                   "split_gain", "threshold", "decision_type",
+                   "missing_direction", "missing_type", "value", "weight",
+                   "count"]
+    assert list(df.columns) == expect_cols
+    assert df["tree_index"].nunique() == 5
+    # nodes = leaves + internals per tree
+    t0 = df[df.tree_index == 0]
+    leaves = t0[t0.left_child.isna()]
+    assert len(leaves) == (len(t0) + 1) // 2
+    # root has depth 1, no parent
+    root = t0[t0.node_depth == 1]
+    assert len(root) == 1 and root.parent_index.isna().all()
+    # leaf count sums to the training rows
+    assert int(leaves["count"].sum()) == 800
+
+
+def test_model_from_string_inplace(model):
+    bst, _, X, _ = model
+    base = bst.predict(X[:10], raw_score=True)
+    other = lgb.Booster(model_str=bst.model_to_string())
+    fresh = lgb.train({"objective": "regression", "num_leaves": 4,
+                       "verbosity": -1},
+                      lgb.Dataset(X, label=X[:, 0]), num_boost_round=2)
+    fresh.model_from_string(bst.model_to_string())
+    np.testing.assert_allclose(fresh.predict(X[:10], raw_score=True), base,
+                               rtol=1e-12)
+    np.testing.assert_allclose(other.predict(X[:10], raw_score=True), base,
+                               rtol=1e-12)
+
+
+def test_leaf_output_get_set(model):
+    bst, _, X, _ = model
+    b = lgb.Booster(model_str=bst.model_to_string())
+    v = b.get_leaf_output(0, 0)
+    base = b.predict(X[:50], raw_score=True)
+    b.set_leaf_output(0, 0, v + 1.0)
+    assert b.get_leaf_output(0, 0) == pytest.approx(v + 1.0)
+    shifted = b.predict(X[:50], raw_score=True)
+    d = shifted - base
+    # rows landing in that leaf move by exactly +1, others by 0
+    assert set(np.round(d, 9)) <= {0.0, 1.0}
+    assert (d == 1.0).any()
+
+
+def test_bounds_and_shuffle(model):
+    bst, _, X, _ = model
+    b = lgb.Booster(model_str=bst.model_to_string())
+    lo, hi = b.lower_bound(), b.upper_bound()
+    p = b.predict(X, raw_score=True)
+    assert lo <= p.min() and p.max() <= hi
+    np.random.seed(0)
+    b.shuffle_models()
+    # tree order doesn't change summed predictions
+    np.testing.assert_allclose(b.predict(X, raw_score=True), p, rtol=1e-12)
+
+
+def test_dataset_extras(model):
+    _, ds, X, _ = model
+    assert ds.get_data() is not None
+    assert ds.get_feature_name() == ds.feature_name()
+    chain = ds.get_ref_chain()
+    assert ds in chain and len(chain) == 1
+    d2 = lgb.Dataset(X[:100], reference=ds)
+    assert ds in d2.get_ref_chain() and len(d2.get_ref_chain()) == 2
+    with pytest.raises(lgb.LightGBMError, match="constructed"):
+        ds.set_categorical_feature([1])
+    fresh = lgb.Dataset(X)
+    fresh.set_categorical_feature([1])
+    assert fresh._categorical_feature_arg == [1]
