@@ -425,6 +425,36 @@ class Dataset:
         """Alias of feature_name() (reference: Dataset.get_feature_name)."""
         return self.feature_name()
 
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Bin this dataset with `reference`'s mappers (reference:
+        Dataset.set_reference — which also adopts the reference's feature
+        names and categorical spec; must happen before construct())."""
+        if self.binned is not None and reference is not self.reference:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset has been "
+                "constructed; build a new Dataset instead")
+        if self.raw_arrow is not None or self.raw_seq is not None:
+            raise LightGBMError(
+                "set_reference is not supported for arrow/Sequence "
+                "datasets; pass reference= at construction from the same "
+                "source type instead")
+        self.reference = reference
+        # stock adopts the reference's names/categorical spec
+        self._feature_name_arg = "auto"
+        self._resolved_feature_names = None
+        if reference._resolved_feature_names is not None or \
+                isinstance(reference._feature_name_arg, list):
+            self._resolved_feature_names = list(reference.feature_name())
+        self._categorical_feature_arg = reference._categorical_feature_arg
+        # DataFrame categorical codes were baked at __init__ without this
+        # reference's category lists — rebuild them from the ORIGINAL frame
+        if (self._raw_container is not None
+                and getattr(reference, "pandas_categorical", None)):
+            (self.raw_data, self._pandas_names, self._pandas_cat_idx,
+             self.pandas_categorical) = _to_2d_float(
+                self._raw_container, reference.pandas_categorical)
+        return self
+
     def get_data(self):
         """The raw data this Dataset was built from — the ORIGINAL
         container for DataFrames (reference: Dataset.get_data; raises

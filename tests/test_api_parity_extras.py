@@ -94,3 +94,48 @@ def test_dataset_extras(model):
     fresh = lgb.Dataset(X)
     fresh.set_categorical_feature([1])
     assert fresh._categorical_feature_arg == [1]
+
+
+def test_set_reference(model):
+    _, ds, X, _ = model
+    d2 = lgb.Dataset(X[:200])
+    d2.set_reference(ds)
+    d2.construct()
+    # reference mappers adopted: identical binning of shared rows
+    np.testing.assert_array_equal(
+        np.asarray(d2.binned.bins), np.asarray(ds.construct().binned.bins)[:200])
+    with pytest.raises(lgb.LightGBMError, match="constructed"):
+        d2.set_reference(lgb.Dataset(X[:50]))
+
+
+def test_set_reference_realigns_dataframe_categories():
+    """set_reference AFTER __init__ must rebuild the frame's categorical
+    codes through the reference's category lists (they were baked locally
+    at init), and adopt the reference's names/categorical spec."""
+    pd = pytest.importorskip("pandas")
+    rs = np.random.RandomState(1)
+    n = 600
+    colors = rs.choice(["a", "b", "c"], n)
+    x = rs.randn(n)
+    y = (colors == "a").astype(np.float64)
+    train_df = pd.DataFrame({
+        "c": pd.Categorical(colors, categories=["a", "b", "c"]), "x": x})
+    ds = lgb.Dataset(train_df, label=y, categorical_feature=["c"])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=4)
+    # validation frame with a DIFFERENT category order, reference set late
+    val_df = pd.DataFrame({
+        "c": pd.Categorical(colors[:200], categories=["c", "b", "a"]),
+        "x": x[:200]})
+    dv = lgb.Dataset(val_df, label=y[:200]).set_reference(ds)
+    dv.construct()
+    ref_bins = np.asarray(lgb.Dataset(val_df, label=y[:200], reference=ds)
+                          .construct().binned.bins)
+    np.testing.assert_array_equal(np.asarray(dv.binned.bins), ref_bins)
+    assert dv.feature_name() == ds.feature_name()
+    # arrow/Sequence sources fail loud instead of silently re-binning
+    pa = pytest.importorskip("pyarrow")
+    t = pa.table({"x": x})
+    with pytest.raises(lgb.LightGBMError, match="arrow"):
+        lgb.Dataset(t).set_reference(ds)
