@@ -191,28 +191,39 @@ def _rss_kb():
 def _memory_fields(rss_kb_at_start=0):
     """Peak device HBM + host RSS, the reference's published memory metrics
     (docs/Experiments.rst:166 0.897 GB CPU HIGGS; docs/GPU-Performance.rst:186
-    1067 MB GPU).  ru_maxrss is a process-lifetime peak, so when several
-    workloads run in one process the field is only attributable to THIS
-    workload if the peak moved while it ran; otherwise it is omitted."""
-    out = {}
+    1067 MB GPU).  The probes live in lightgbm_tpu.telemetry.metrics (the
+    training loop emits the same fields per iteration when telemetry is on).
+    ru_maxrss is a process-lifetime peak, so when several workloads run in
+    one process the field is only attributable to THIS workload if the peak
+    moved while it ran; otherwise it is omitted."""
+    from lightgbm_tpu.telemetry.metrics import device_memory_gb
+    out = dict(device_memory_gb())
     rss = _rss_kb()
     if rss > rss_kb_at_start:
         out["host_rss_gb"] = round(rss / 2 ** 20, 3)
-    try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
-        if peak:
-            out["peak_hbm_gb"] = round(peak / 2 ** 30, 3)
-        else:
-            # tunnel devices report no allocator stats; live-array residency
-            # is the honest fallback (the training state persists on device,
-            # so this is within one histogram buffer of the true peak)
-            live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                       for a in jax.live_arrays())
-            out["device_hbm_gb"] = round(live / 2 ** 30, 3)
-    except Exception:
-        pass
+    return out
+
+
+def _telemetry_fields(bst):
+    """Telemetry summary merged into the bench JSON line when the run was
+    trained with telemetry on (params — any alias — or BENCH_TELEMETRY=1);
+    the trace file configured via trace_out is flushed here because bench
+    drives Booster.update() directly and never passes through train()."""
+    import lightgbm_tpu.telemetry as tel
+    if not tel.enabled():   # the Booster resolved aliases and configured it
+        return {}
+    tel.flush()
+    s = bst.telemetry_summary()
+    out = {"telemetry": {
+        "recompiles": {k: v["compiles"]
+                       for k, v in s.get("recompiles", {}).items()},
+        "phases": {k: v["total_s"] for k, v in s.get("phases", {}).items()},
+    }}
+    if "train" in s:
+        out["telemetry"]["train"] = s["train"]
+    for k in ("telemetry_out", "trace_out"):
+        if k in s:
+            out["telemetry"][k] = s[k]
     return out
 
 
@@ -247,6 +258,8 @@ def run_ranking():
     extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
     if extra:
         params.update(json.loads(extra))
+    if os.environ.get("BENCH_TELEMETRY", "") == "1":
+        params.setdefault("telemetry", True)
     ds = lgb.Dataset(X[:d_split], label=y[:d_split], group=sizes[:q_split])
     bst = lgb.Booster(params, ds)
     bst.update()
@@ -270,6 +283,7 @@ def run_ranking():
                  f"{'>=' if ok else '< GATE '}{gate})"),
         "vs_baseline": round(vs_baseline, 3) if ok else 0.0,
         **_memory_fields(rss0),
+        **_telemetry_fields(bst),
     }), flush=True)
     return ok
 
@@ -308,6 +322,8 @@ def main():
     extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
     if extra:
         params.update(json.loads(extra))
+    if os.environ.get("BENCH_TELEMETRY", "") == "1":
+        params.setdefault("telemetry", True)
     ds = lgb.Dataset(X_tr, label=y_tr)
     bst = lgb.Booster(params, ds)
     # warmup: compile + first tree
@@ -340,6 +356,7 @@ def main():
                  f"holdout AUC {auc:.4f} >= {AUC_GATE})"),
         "vs_baseline": round(vs_baseline, 3),
         **_memory_fields(rss0),
+        **_telemetry_fields(bst),
     }), flush=True)
     return True
 

@@ -7,8 +7,10 @@ with common LightGBM user code:
     import lightgbm_tpu as lgb
     bst = lgb.train(params, lgb.Dataset(X, label=y))
 """
+from . import telemetry
 from .basic import Sequence, Booster, Dataset
-from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .callback import (early_stopping, log_evaluation, log_telemetry,
+                       record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train
 from .utils.log import LightGBMError, register_logger
 
@@ -17,7 +19,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Dataset", "Booster", "train", "cv", "CVBooster", "init_distributed",
     "train_distributed",
-    "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
+    "early_stopping", "log_evaluation", "log_telemetry", "record_evaluation",
+    "reset_parameter", "telemetry",
     "LightGBMError", "register_logger",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",

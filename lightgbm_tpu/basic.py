@@ -1050,6 +1050,25 @@ class Booster:
             self.params = resolve_aliases(params)
             cfg = Config.from_params(params)
             set_verbosity(cfg.verbosity)
+            from . import telemetry as _tel
+            if cfg.telemetry or cfg.telemetry_out or cfg.trace_out:
+                # sinks imply the switch: a trace_out without telemetry=True
+                # would export an empty span buffer. Param-driven telemetry
+                # is per-model, so drop any previous model's spans/records
+                # before this one starts collecting
+                _tel.reset()
+                _tel.configure(
+                    enabled=True,
+                    metrics_out=cfg.telemetry_out or None,
+                    trace_out=cfg.trace_out or None,
+                    recompile_threshold=cfg.telemetry_recompile_threshold,
+                    _source="params")
+            elif _tel.enabled() and _tel.enabled_source() == "params":
+                # a previous model's param-driven telemetry must not leak
+                # into this one (its JSONL sink, its per-iteration sync);
+                # an explicit telemetry.enable()/configure() by user code
+                # ("api" source) stays on
+                _tel.configure(enabled=False, metrics_out="", trace_out="")
             # merge dataset params (dataset params win for binning keys)
             train_set.params = {**params, **train_set.params}
             train_set.construct()
@@ -1648,11 +1667,45 @@ class Booster:
         self.engine._grow_params = self.engine._make_grow_params()
         import functools
         from .ops.grow import grow_tree as _gt
-        import jax
-        self.engine._grow_fn = jax.jit(functools.partial(
+        from .telemetry import watched_jit
+        # same (name, owner) as the engine's original jit: the rebuild
+        # counts as a retrace of the same entry point, so the recompile
+        # watchdog sees a mid-training parameter reset for what it is
+        self.engine._grow_fn = watched_jit(functools.partial(
             _gt, layout=self.engine.dd.layout, routing=self.engine.dd.routing,
-            params=self.engine._grow_params))
+            params=self.engine._grow_params),
+            name="grow_tree", owner=self.engine)
         return self
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        """Aggregated telemetry for this process: counters/gauges/time
+        histograms, span phase totals, recompile-watchdog rollup, memory,
+        and (when trained with telemetry on) per-iteration statistics.
+        See docs/OBSERVABILITY.md."""
+        stored = getattr(self, "telemetry_summary_", None)
+        if stored:
+            # a rollup shipped from another process (train_distributed rank
+            # 0) answers for this booster; the local registry is empty
+            return stored
+        from . import telemetry as _tel
+        out = _tel.summary()
+        recs = [r for r in _tel.global_registry.records
+                if r.get("event") == "iteration"]
+        if self._engine is not None and recs:
+            walls = np.asarray([r["wall_s"] for r in recs], np.float64)
+            out["train"] = {
+                "iterations_recorded": len(recs),
+                "total_s": round(float(walls.sum()), 6),
+                "mean_iter_s": round(float(walls.mean()), 6),
+                "p50_iter_s": round(float(np.percentile(walls, 50)), 6),
+                "p95_iter_s": round(float(np.percentile(walls, 95)), 6),
+                "last_iter_s": round(float(walls[-1]), 6),
+            }
+            stragglers = [r for r in _tel.global_registry.records
+                          if r.get("event") == "straggler_report"]
+            if stragglers:
+                out["straggler"] = stragglers[-1]
+        return out
 
     def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
         from .model_io import refit_model

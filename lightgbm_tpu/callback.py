@@ -74,6 +74,40 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
     return _callback
 
 
+def log_telemetry(period: int = 10) -> Callable:
+    """Log a compact telemetry line every ``period`` iterations: recent
+    iteration wall time, phase splits, peak memory, and any recompiles.
+    Needs training with ``telemetry=True`` (see docs/OBSERVABILITY.md)."""
+    def _callback(env: CallbackEnv) -> None:
+        if period <= 0 or (env.iteration + 1) % period != 0:
+            return
+        from . import telemetry as _tel
+        if not _tel.enabled():
+            return
+        window = _tel.global_registry.tail(period, event="iteration")
+        if not window:
+            return
+        mean_ms = sum(r["wall_s"] for r in window) / len(window) * 1e3
+        parts = [f"iter {mean_ms:.1f} ms (mean/{len(window)})"]
+        phases: Dict[str, float] = {}
+        for r in window:
+            for k, v in r.get("phases", {}).items():
+                phases[k] = phases.get(k, 0.0) + v
+        for k, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+            parts.append(f"{k[:-2]} {v / len(window) * 1e3:.1f} ms")
+        last = window[-1]
+        hbm = last.get("peak_hbm_gb") or last.get("device_hbm_gb")
+        if hbm:
+            parts.append(f"hbm {hbm:.3f} GB")
+        compiles = sum(s["compiles"]
+                       for s in _tel.watchdog_summary().values())
+        if compiles:
+            parts.append(f"compiles {compiles}")
+        log_info(f"[telemetry] [{env.iteration + 1}]\t" + "\t".join(parts))
+    _callback.order = 40  # type: ignore
+    return _callback
+
+
 def reset_parameter(**kwargs) -> Callable:
     """Reset parameters per iteration: value may be a list (per-iteration values) or a
     function iteration -> value."""

@@ -190,6 +190,15 @@ def run_train(params: Dict[str, Any]) -> None:
     out_model = str(params.get("output_model", "LightGBM_model.txt"))
     bst.save_model(out_model)
     log_info(f"Finished training; model saved to {out_model}")
+    from . import telemetry as _telemetry
+    if _telemetry.enabled():
+        import json
+        s = bst.telemetry_summary()
+        line = {k: s[k] for k in ("train", "memory", "telemetry_out",
+                                  "trace_out") if k in s}
+        line["recompiles"] = {k: v["compiles"]
+                              for k, v in s.get("recompiles", {}).items()}
+        log_info(f"telemetry summary: {json.dumps(line)}")
 
 
 def run_predict(params: Dict[str, Any]) -> None:

@@ -176,6 +176,13 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
+    # --- telemetry (docs/OBSERVABILITY.md) ---
+    "telemetry": ["enable_telemetry"],
+    "telemetry_out": ["telemetry_output", "metrics_out"],
+    "trace_out": ["trace_output", "trace_file"],
+    "telemetry_recompile_threshold": ["recompile_warn_threshold"],
+    "telemetry_straggler_every": ["straggler_check_every"],
+    "telemetry_straggler_skew": ["straggler_warn_skew"],
 }
 
 # alias -> canonical
@@ -418,6 +425,20 @@ class Config:
     max_splits_per_round: int = 0
     mesh_shape: str = ""
     tpu_dtype: str = "f32"
+
+    # --- telemetry (docs/OBSERVABILITY.md) ---
+    # master switch: span tracer + metrics registry + per-iteration records
+    telemetry: bool = False
+    # JSONL sink for per-iteration training records ("" = memory only)
+    telemetry_out: str = ""
+    # Chrome/Perfetto trace-event JSON written at the end of train()
+    trace_out: str = ""
+    # recompile watchdog warns once a jitted entry point traces > N times
+    telemetry_recompile_threshold: int = 2
+    # allgather per-host iteration times every K iterations (multi-host)
+    telemetry_straggler_every: int = 50
+    # warn when the slowest host's mean iter time exceeds skew x median
+    telemetry_straggler_skew: float = 1.25
 
     def __post_init__(self) -> None:
         self._unknown: Dict[str, Any] = {}

@@ -118,6 +118,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         for item in evaluation_result_list:
             best[item[0]][item[1]] = item[2]
         booster.best_score = dict(best)
+    from . import telemetry as _tel
+    if _tel.enabled():
+        # write the configured Chrome-trace file (trace_out param) now that
+        # the span buffer covers the whole run
+        _tel.flush()
     return booster
 
 

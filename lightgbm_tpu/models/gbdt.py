@@ -12,6 +12,7 @@ walk per new tree.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -25,10 +26,22 @@ from ..objectives import ObjectiveFunction
 from ..ops.grow import GrowParams, grow_tree
 from ..ops.split import leaf_output
 from ..ops.predict import StackedTrees, _walk_one_tree
+from ..telemetry import (global_registry as _tel_registry,
+                         global_tracer as _tel_tracer, memory_snapshot,
+                         watched_jit)
 from ..tree import Tree, TreeArrays, finalize_tree
 from ..utils.log import LightGBMError, log_info, log_warning
 from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
+
+# span name -> per-iteration record key for the telemetry phase splits
+_PHASE_KEYS = {
+    "GBDT::Boosting": "boosting_s",
+    "GBDT::TrainTree": "grow_s",
+    "GBDT::FusedIter": "fused_iter_s",
+    "GBDT::FinalizeTrees": "finalize_s",
+    "GBDT::Eval": "eval_s",
+}
 
 
 def quantize_gh(grad, hess, key, num_bins: int, stochastic: bool):
@@ -236,7 +249,8 @@ class GBDT:
             cegb_lazy_pen=self._cegb_lazy_pen_array(),
             mesh=self.mesh if self._mesh_stream else None,
             row_axis=self._row_axis)
-        self._grow_fn = jax.jit(self._grow_partial)
+        self._grow_fn = watched_jit(self._grow_partial, name="grow_tree",
+                                    owner=self)
         self._grow_fn_k = None
         self._iter_fn = None
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
@@ -279,7 +293,8 @@ class GBDT:
                 return grow_tree_voting(bins, g, h, mask, colm,
                                         sp_root, sp, gp, routing)
 
-            self._grow_fn = jax.jit(_vote_fn)
+            self._grow_fn = watched_jit(_vote_fn, name="grow_tree_voting",
+                                        owner=self)
             self._voting = True
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
@@ -296,6 +311,8 @@ class GBDT:
         self._saved_state: Optional[Tuple] = None
         self._grad_fn = None
         self._score_add_fn = None
+        # telemetry: recent per-iteration wall times (straggler window)
+        self._tel_iter_times: List[float] = []
 
     # ------------------------------------------------------------------
     @property
@@ -315,7 +332,8 @@ class GBDT:
             return
         pending = self._lazy_trees
         self._lazy_trees = []
-        with global_timer.scope("GBDT::FinalizeTrees"):
+        with global_timer.scope("GBDT::FinalizeTrees"), \
+                _tel_tracer.span("GBDT::FinalizeTrees", trees=len(pending)):
             got = jax.device_get([e["arrays"] for e in pending])
         mappers = self.train_data.bin_mappers()
         for e, arrays in zip(pending, got):
@@ -407,7 +425,11 @@ class GBDT:
         (f64 arrays cannot exist outside it); used at trace AND call time so
         the jit cache stays consistent."""
         if self._grow_params.hist_double:
-            return jax.enable_x64()
+            # jax.enable_x64 moved under jax.experimental in recent releases
+            ctx = getattr(jax, "enable_x64", None)
+            if ctx is None:
+                from jax.experimental import enable_x64 as ctx
+            return ctx()
         import contextlib
         return contextlib.nullcontext()
 
@@ -865,7 +887,7 @@ class GBDT:
             def _fn(score, bound, pad_mask, qkey):
                 return self._gradient_graph(score, bound, pad_mask, qkey)
 
-            self._grad_fn = jax.jit(_fn)
+            self._grad_fn = watched_jit(_fn, name="gradients", owner=self)
         qkey = jax.random.PRNGKey(
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
         bound = {a: getattr(self.objective, a)
@@ -897,7 +919,8 @@ class GBDT:
                     body, None, (grad2.T, hess2.T, keys, scales))
                 return out
 
-            self._grow_fn_k = jax.jit(_fn)
+            self._grow_fn_k = watched_jit(_fn, name="grow_tree_k",
+                                          owner=self)
         keys = jnp.stack([
             jax.random.PRNGKey((self.config.extra_seed or 3) * 1000003
                                + self.iter_ * (k + 1) + kk)
@@ -959,7 +982,7 @@ class GBDT:
                     delta = lv[leaf_id]
                 return score + delta, arrays, leaf_id, new_state
 
-            self._iter_fn = jax.jit(_fn)
+            self._iter_fn = watched_jit(_fn, name="fused_iter", owner=self)
         qkey = jax.random.PRNGKey(
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
         gkey = None
@@ -980,7 +1003,83 @@ class GBDT:
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter, gbdt.cpp:353).
-        Returns True if no further training is possible (all-zero trees)."""
+        Returns True if no further training is possible (all-zero trees).
+
+        With telemetry enabled this wraps the core step in an iteration
+        span and emits one structured record (wall time, phase splits,
+        leaf count, memory) per iteration; disabled, the guard is a
+        single boolean check and the core runs untouched."""
+        if not _tel_tracer.enabled:
+            return self._train_one_iter_impl(grad, hess)
+        t0 = time.perf_counter()
+        ph0 = _tel_tracer.phase_snapshot()
+        # 1-based, matching the record _emit_iter_record writes after the
+        # impl increments iter_ — span N and JSONL row N are the same step
+        it = self.iter_ + 1
+        with _tel_tracer.span("GBDT::Iteration", iteration=it,
+                              booster=self.boosting_type):
+            finished = self._train_one_iter_impl(grad, hess)
+        self._emit_iter_record(t0, ph0, finished)
+        return finished
+
+    def _emit_iter_record(self, t0: float, ph0: Dict[str, float],
+                          finished: bool) -> None:
+        """One telemetry record per boosting iteration.
+
+        NOTE: reading the new tree's leaf count is a device->host sync;
+        telemetry mode deliberately trades the async pipeline for
+        visibility (the reference's USE_TIMETAG build makes the same
+        trade). Phase splits are diffs of the tracer's cumulative span
+        totals, so between-iteration work (eval of the previous
+        iteration) lands in the next record."""
+        wall = time.perf_counter() - t0
+        ph1 = _tel_tracer.phase_snapshot()
+        phases = {}
+        for span_name, key in _PHASE_KEYS.items():
+            d = ph1.get(span_name, 0.0) - ph0.get(span_name, 0.0)
+            if d > 0.0:
+                phases[key] = round(d, 6)
+        k = self.num_tree_per_iteration
+        num_leaves = None
+        try:
+            if self._lazy_trees:
+                tail = self._lazy_trees[-min(k, len(self._lazy_trees)):]
+                got = jax.device_get([e["arrays"].num_leaves for e in tail])
+                num_leaves = int(np.sum(got))
+            elif self._models_list:
+                num_leaves = int(sum(t.num_leaves
+                                     for t in self._models_list[-k:]))
+        except Exception:
+            pass
+        rec: Dict[str, Any] = {
+            "event": "iteration", "iteration": self.iter_,
+            "trees": self.iter_ * k, "wall_s": round(wall, 6),
+            "phases": phases, "num_leaves": num_leaves,
+            "finished": bool(finished), **memory_snapshot()}
+        _tel_registry.record(rec)
+        _tel_registry.inc("train/iterations")
+        _tel_registry.observe("train/iteration", wall)
+        _tel_tracer.counter("iteration_wall_ms", wall=wall * 1e3)
+        if num_leaves is not None:
+            _tel_tracer.counter("tree_leaves", leaves=num_leaves)
+        hbm = rec.get("peak_hbm_gb") or rec.get("device_hbm_gb")
+        if hbm:
+            _tel_registry.gauge("train/peak_hbm_gb", hbm)
+            _tel_tracer.counter("hbm_gb", gb=hbm)
+        self._tel_iter_times.append(wall)
+        if len(self._tel_iter_times) > 1024:
+            del self._tel_iter_times[:512]
+        K = int(getattr(self.config, "telemetry_straggler_every", 0) or 0)
+        if K > 0 and self.iter_ > 0 and self.iter_ % K == 0 \
+                and jax.process_count() > 1:
+            from ..parallel.straggler import straggler_report
+            straggler_report(
+                self._tel_iter_times[-K:],
+                warn_skew=self.config.telemetry_straggler_skew)
+
+    def _train_one_iter_impl(self, grad: Optional[jax.Array] = None,
+                             hess: Optional[jax.Array] = None) -> bool:
+        """The core boosting step (see train_one_iter)."""
         # ranking per-bucket arrays and position-bias state are rebound as
         # jit arguments (data_bound_attrs / state_attrs), so lambdarank runs
         # the fused path too; rank_xendcg keeps the eager path (fresh host
@@ -991,7 +1090,8 @@ class GBDT:
                      and not self.sample_strategy.is_active()
                      and self._row_sharding is None)
         if fast_path and self._can_fuse_iteration():
-            with global_timer.scope("GBDT::FusedIter"):
+            with global_timer.scope("GBDT::FusedIter"), \
+                    _tel_tracer.span("GBDT::FusedIter"):
                 new_score, arrays, leaf_id = self._iter_fused()
             bias = 0.0
             if (self.iter_ == 0 or self._average_output) and \
@@ -1017,13 +1117,15 @@ class GBDT:
         if fast_path:
             # no bagging: the in-bag mask IS the pad mask, and the gradient
             # chain (incl. quantization) runs as one fused program
-            with global_timer.scope("GBDT::Boosting"):
+            with global_timer.scope("GBDT::Boosting"), \
+                    _tel_tracer.span("GBDT::Boosting"):
                 (graw, hraw, grad, hess, q_scales) = self._boost_padded()
             mask = self._pad_mask
             quant_done = True
         else:
             if grad is None or hess is None:
-                with global_timer.scope("GBDT::Boosting"):
+                with global_timer.scope("GBDT::Boosting"), \
+                        _tel_tracer.span("GBDT::Boosting"):
                     grad, hess = self._boost()
             else:
                 grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
@@ -1058,7 +1160,9 @@ class GBDT:
                 and self._cegb_used is None and not self._voting
                 and not (self.config.use_quantized_grad
                          and self.config.quant_train_renew_leaf)):
-            with global_timer.scope("GBDT::TrainTree"), self._grow_x64_ctx():
+            with global_timer.scope("GBDT::TrainTree"), \
+                    _tel_tracer.span("GBDT::TrainTree", k=k), \
+                    self._grow_x64_ctx():
                 k_results = self._grow_classes(grad, hess, mask, col_mask,
                                                gh_scales, k)
         for kk in range(k):
@@ -1076,6 +1180,7 @@ class GBDT:
                 arrays, leaf_id = k_results[kk]
             else:
                 with global_timer.scope("GBDT::TrainTree"), \
+                        _tel_tracer.span("GBDT::TrainTree"), \
                         self._grow_x64_ctx():
                     out = self._grow_fn(
                         self.dd.bins, g, h, mask, col_mask, key=gkey,
@@ -1130,8 +1235,9 @@ class GBDT:
                                 return score + delta
                             return score.at[:, col].add(delta)
 
-                        self._score_add_fn = jax.jit(
-                            _sadd, static_argnums=(4,))
+                        self._score_add_fn = watched_jit(
+                            _sadd, name="score_add", owner=self,
+                            static_argnums=(4,))
                     self.score = self._score_add_fn(
                         self.score, leaf_id, arrays.leaf_value,
                         jnp.float32(self._shrinkage_rate()), kk)
@@ -1420,24 +1526,26 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
         out = []
-        score = self._score_to_host(self.score, self.num_data)
-        conv = (self.objective.convert_output if self.objective is not None
-                else (lambda x: x))
-        for m in self.train_metrics:
-            for (name, val, hb) in m.evaluate(score, conv):
-                out.append(("training", name, val, hb))
+        with _tel_tracer.span("GBDT::Eval", dataset="training"):
+            score = self._score_to_host(self.score, self.num_data)
+            conv = (self.objective.convert_output
+                    if self.objective is not None else (lambda x: x))
+            for m in self.train_metrics:
+                for (name, val, hb) in m.evaluate(score, conv):
+                    out.append(("training", name, val, hb))
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         conv = (self.objective.convert_output if self.objective is not None
                 else (lambda x: x))
-        for vi, vset in enumerate(self.valid_sets):
-            n = vset.num_data()
-            score = self._score_to_host(self._valid_scores[vi], n)
-            for m in self.valid_metrics[vi]:
-                for (name, val, hb) in m.evaluate(score, conv):
-                    out.append((self.valid_names[vi], name, val, hb))
+        with _tel_tracer.span("GBDT::Eval", dataset="valid"):
+            for vi, vset in enumerate(self.valid_sets):
+                n = vset.num_data()
+                score = self._score_to_host(self._valid_scores[vi], n)
+                for m in self.valid_metrics[vi]:
+                    for (name, val, hb) in m.evaluate(score, conv):
+                        out.append((self.valid_names[vi], name, val, hb))
         return out
 
     # ------------------------------------------------------------------
@@ -1484,7 +1592,7 @@ class DART(GBDT):
         # lazy-finalize optimization cannot skip the per-iter sync anyway
         self._finished_check_every = 1
 
-    def train_one_iter(self, grad=None, hess=None) -> bool:
+    def _train_one_iter_impl(self, grad=None, hess=None) -> bool:
         c = self.config
         k = self.num_tree_per_iteration
         n_iters = self.iter_
@@ -1511,7 +1619,7 @@ class DART(GBDT):
                 self.score = self._add_tree_arrays_to_score(
                     self.score, arrays._replace(leaf_value=-arrays.leaf_value),
                     dd, kk, 1.0)
-        finished = super().train_one_iter(grad, hess)
+        finished = super()._train_one_iter_impl(grad, hess)
         # normalization (reference: dart.hpp Normalize)
         if kfac > 0 and not finished:
             if c.xgboost_dart_mode:
@@ -1587,10 +1695,10 @@ class RF(GBDT):
             "boosting=rf: the averaged-output bookkeeping cannot be rebuilt "
             "from a saved model")
 
-    def train_one_iter(self, grad=None, hess=None) -> bool:
+    def _train_one_iter_impl(self, grad=None, hess=None) -> bool:
         # track tree-sum separately: score = init + tree_sum / iter
         self.score = self._tree_sum
-        finished = GBDT.train_one_iter(self, grad, hess)
+        finished = GBDT._train_one_iter_impl(self, grad, hess)
         self._tree_sum = self.score
         t = max(self.iter_, 1)
         self.score = self._init_score_const + self._tree_sum / t

@@ -464,17 +464,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     # device — already replicated, no collective needed
                     return nl, h, jax.lax.psum(c, row_axis)
 
-                return jax.shard_map(
-                    _local, mesh=mesh,
+                # pallas_call cannot annotate varying-mesh-axes on its
+                # outputs; the psum above makes hist/cnt replicated, so
+                # the replication check is off (check_vma in current jax,
+                # check_rep in the older experimental shard_map)
+                specs = dict(
+                    mesh=mesh,
                     in_specs=(P(None, row_axis), P(None, row_axis),
                               P(None, row_axis), P(None, None),
                               P(None, None)),
                     out_specs=(P(None, row_axis),
-                               P(None, None, None, None), P(None)),
-                    # pallas_call cannot annotate varying-mesh-axes on its
-                    # outputs; the psum above makes hist/cnt replicated
-                    check_vma=False,
-                )(bT, lid_row, wT, tb, bi)
+                               P(None, None, None, None), P(None)))
+                try:
+                    from jax import shard_map as _sm
+                except ImportError:
+                    from jax.experimental.shard_map import shard_map as _sm
+                try:
+                    wrapped = _sm(_local, check_vma=False, **specs)
+                except TypeError:   # older signature spells it check_rep
+                    wrapped = _sm(_local, check_rep=False, **specs)
+                return wrapped(bT, lid_row, wT, tb, bi)
         else:
             def _rh(bT, lid_row, wT, tb, bi, num_slots, with_hist=True):
                 return route_and_hist(

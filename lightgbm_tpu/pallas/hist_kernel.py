@@ -42,7 +42,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# TPUCompilerParams was renamed CompilerParams across JAX releases
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 from ..ops.compact import num_blocks, plan_blocks, plan_single_slot
+from ..telemetry.watchdog import watched_jit
 
 LO = 16  # nibble kernel low-digit width; HI = ceil(Bmax / LO)
 
@@ -140,8 +145,9 @@ def _nibble_kernel(scalar_ref, bins_ref, w_ref, out_ref, acc_ref,
             out_ref[0] = acc_ref[...]
 
 
-@functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
-                                             "block_rows"))
+@functools.partial(watched_jit, name="pallas_hist_direct", warn_after=0,
+                   static_argnames=("num_slots", "bmax", "num_groups",
+                                    "block_rows"))
 def _hist_direct(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
                  block_rows):
     GW, n_tot = bins_T.shape
@@ -166,7 +172,7 @@ def _hist_direct(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((S, 8, G * B), jnp.float32),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
         interpret=_INTERPRET,
     )(scalars, bins_T, w_T)
 
@@ -175,8 +181,9 @@ def _hist_direct(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
     return jnp.where(counts[:, None, None, None] > 0, hist, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
-                                             "block_rows"))
+@functools.partial(watched_jit, name="pallas_hist_nibble", warn_after=0,
+                   static_argnames=("num_slots", "bmax", "num_groups",
+                                    "block_rows"))
 def _hist_nibble(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
                  block_rows):
     GW, n_tot = bins_T.shape
@@ -201,7 +208,7 @@ def _hist_nibble(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((S, 3 * HI, G * LO), jnp.float32),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
         interpret=_INTERPRET,
     )(scalars, bins_T, w_T)
 

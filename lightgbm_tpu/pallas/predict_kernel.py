@@ -26,6 +26,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# TPUCompilerParams was renamed CompilerParams across JAX releases
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+from ..telemetry.watchdog import watched_jit
+
 ROWS_PER_TREE = 24
 (P_WORD_LO, P_WORD_HI, P_SHIFT, P_SPAN, P_DEFBIN, P_BUNDLED, P_HASNAN,
  P_NANBIN, P_NBINS, P_THR, P_DEFLEFT, P_LEFT_LO, P_LEFT_HI, P_RIGHT_LO,
@@ -107,9 +113,9 @@ def _predict_kernel(bins_ref, tabs_ref, out_ref, *, T, L, GW, n_trees,
     out_ref[...] = score
 
 
-@functools.partial(jax.jit, static_argnames=("num_leaves", "n_trees",
-                                             "max_depth", "block_rows",
-                                             "es_freq", "es_margin"))
+@functools.partial(watched_jit, name="predict_stream", warn_after=0,
+                   static_argnames=("num_leaves", "n_trees", "max_depth",
+                                    "block_rows", "es_freq", "es_margin"))
 def predict_stream(bins_T: jax.Array, tabs: jax.Array, num_leaves: int,
                    n_trees: int, max_depth: int, block_rows: int = 1024,
                    es_freq: int = 0, es_margin: float = 0.0):
@@ -132,7 +138,7 @@ def predict_stream(bins_T: jax.Array, tabs: jax.Array, num_leaves: int,
         ],
         out_specs=pl.BlockSpec((1, T), lambda b: (0, b)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_INTERPRET,
     )(bins_T, tabs)

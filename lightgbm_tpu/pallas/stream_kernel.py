@@ -44,7 +44,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# TPUCompilerParams was renamed CompilerParams across JAX releases
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 from .hist_kernel import _wsplit  # shared f32 -> (hi, lo) bf16 split
+from ..telemetry.watchdog import watched_jit
 from ..binning import bucket_group_pad, bucket_run_rows
 
 NUM_TAB = 24          # per-leaf table rows (padded to a sublane multiple)
@@ -423,11 +428,11 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024,
     return StreamLayout(bins_T=packed.T, n_pad=n_pad, num_groups=g)
 
 
-@functools.partial(jax.jit, static_argnames=("num_slots", "bmax", "num_groups",
-                                             "num_leaves", "block_rows",
-                                             "has_cat", "two_pass",
-                                             "int_weights", "with_hist",
-                                             "bin_buckets"))
+@functools.partial(watched_jit, name="route_and_hist", warn_after=0,
+                   static_argnames=("num_slots", "bmax", "num_groups",
+                                    "num_leaves", "block_rows", "has_cat",
+                                    "two_pass", "int_weights", "with_hist",
+                                    "bin_buckets"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
@@ -495,7 +500,7 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interp(),
     )(bins_T, leaf_id, w_T, tabs, bits)
@@ -538,7 +543,8 @@ def _leaf_gather_kernel(lid_ref, val_ref, out_ref, *, T, L):
         preferred_element_type=f32)
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows",))
+@functools.partial(watched_jit, name="leaf_gather", warn_after=0,
+                   static_argnames=("block_rows",))
 def leaf_gather(leaf_id: jax.Array, values: jax.Array,
                 block_rows: int = 1024) -> jax.Array:
     """values[leaf_id] as a streaming one-hot contraction (bit-exact).
@@ -562,7 +568,7 @@ def leaf_gather(leaf_id: jax.Array, values: jax.Array,
         ],
         out_specs=pl.BlockSpec((1, T), lambda b: (0, b)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interp(),
     )(lid, values.reshape(1, L).astype(jnp.float32))

@@ -67,9 +67,12 @@ bst = lgb.train(spec["params"], ds, num_boost_round=spec["rounds"],
                 valid_names=spec["valid_names"] or None,
                 callbacks=[lgb.record_evaluation(evals)] if valid_sets else None)
 if rank == 0:
-    json.dump({"model": bst.model_to_string(), "evals": evals,
-               "best_iteration": bst.best_iteration},
-              open(sys.argv[3], "w"))
+    out = {"model": bst.model_to_string(), "evals": evals,
+           "best_iteration": bst.best_iteration}
+    import lightgbm_tpu.telemetry as _tel
+    if _tel.enabled():   # however the params spelled it (aliases, sinks)
+        out["telemetry"] = bst.telemetry_summary()
+    json.dump(out, open(sys.argv[3], "w"))
 """
 
 
@@ -149,6 +152,11 @@ def train_distributed(params: Dict[str, Any], data_path: str,
     bst.evals_result_ = result["evals"]
     if result.get("best_iteration"):
         bst.best_iteration = result["best_iteration"]
+    if result.get("telemetry"):
+        # rank 0's telemetry rollup (iteration records, straggler reports,
+        # recompiles); Booster.telemetry_summary() answers from this when
+        # set, since the driver process's own registry saw no training
+        bst.telemetry_summary_ = result["telemetry"]
     log_info(f"train_distributed: {num_processes} workers done, "
              f"{bst.num_trees()} trees")
     return bst

@@ -1,0 +1,83 @@
+"""Multi-host straggler detection for data-parallel training.
+
+A data-parallel step runs at the pace of the slowest host — one throttled
+VM, one overloaded NIC, and the whole pod waits in the histogram psum.
+The reference's socket network makes this visible as wait time inside
+Allreduce; under jax.distributed it is invisible unless measured.
+
+Every K iterations (param ``telemetry_straggler_every``) each host
+contributes its recent per-iteration wall-time stats to a
+``process_allgather``, and process 0 logs a skew report (max/median of
+the per-host means). A skew above ``telemetry_straggler_skew`` warns
+with the offending host's process index. All hosts must reach the
+check at the same iteration — the call sites key it off the iteration
+counter, which is replicated by construction.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+
+
+def straggler_report(iter_times: Sequence[float],
+                     warn_skew: float = 1.25,
+                     _all_host_stats: Optional[np.ndarray] = None
+                     ) -> Optional[Dict[str, Any]]:
+    """Aggregate per-host iteration times; returns the report dict.
+
+    ``iter_times`` — this host's recent per-iteration wall times (s).
+    ``_all_host_stats`` — test hook: pre-gathered (H, 3) [n, mean, max]
+    rows standing in for the collective."""
+    if not len(iter_times) and _all_host_stats is None:
+        return None
+    import jax
+
+    t = np.asarray(iter_times, np.float64)
+    local = np.array([len(t), float(t.mean()) if len(t) else 0.0,
+                      float(t.max()) if len(t) else 0.0], np.float64)
+    if _all_host_stats is not None:
+        stats = np.asarray(_all_host_stats, np.float64).reshape(-1, 3)
+        pidx = 0
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        stats = np.asarray(multihost_utils.process_allgather(local))
+        pidx = jax.process_index()
+    else:
+        stats = local[None]
+        pidx = 0
+
+    means = stats[:, 1]
+    median = float(np.median(means))
+    slowest = int(np.argmax(means))
+    worst = float(means[slowest])
+    skew = worst / median if median > 0 else 1.0
+    report: Dict[str, Any] = {
+        "event": "straggler_report",
+        "hosts": int(stats.shape[0]),
+        "window_iters": int(stats[:, 0].max()),
+        "median_host_mean_s": round(median, 6),
+        "max_host_mean_s": round(worst, 6),
+        "max_host_max_s": round(float(stats[:, 2].max()), 6),
+        "slowest_host": slowest,
+        "skew": round(skew, 4),
+    }
+    from ..telemetry import global_registry, global_tracer
+    global_registry.record(report)
+    global_registry.gauge("straggler/skew", skew)
+    global_tracer.counter("straggler_skew", skew=skew)
+    if pidx == 0 and stats.shape[0] > 1:
+        if skew >= warn_skew:
+            log_warning(
+                f"telemetry: straggler detected — host {slowest} averages "
+                f"{worst * 1e3:.1f} ms/iter vs the {median * 1e3:.1f} ms "
+                f"median across {stats.shape[0]} hosts "
+                f"(skew {skew:.2f}x >= {warn_skew:.2f}x)")
+        else:
+            log_info(
+                f"telemetry: {stats.shape[0]} hosts, median "
+                f"{median * 1e3:.1f} ms/iter, max {worst * 1e3:.1f} ms "
+                f"(host {slowest}, skew {skew:.2f}x)")
+    return report

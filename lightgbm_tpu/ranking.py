@@ -20,6 +20,7 @@ import numpy as np
 
 from .config import Config
 from .objectives import ObjectiveFunction
+from .telemetry.watchdog import watched_jit
 from .utils.log import LightGBMError, log_warning
 
 
@@ -125,7 +126,8 @@ def _bucket_scatter_add(vec, vals, idx, valid, span, n):
                                 mode="drop")
 
 
-@functools.partial(jax.jit, static_argnames=("sigma", "norm", "trunc", "chunk"))
+@functools.partial(watched_jit, name="lambdarank_bucket", warn_after=0,
+                   static_argnames=("sigma", "norm", "trunc", "chunk"))
 def _lambdarank_bucket(scores, labels_q, valid, inv_max_dcg, gains_q,
                        sigma: float, norm: bool, trunc: int, chunk: int = 256):
     """Pairwise lambdas for one padded bucket.
@@ -308,7 +310,8 @@ class LambdarankNDCG(ObjectiveFunction):
                            + self._pos_lr * d1 / (jnp.abs(d2) + 0.001))
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(watched_jit, name="xendcg_bucket", warn_after=0,
+                   static_argnames=())
 def _xendcg_bucket(scores, phi, valid):
     """XE-NDCG gradients for one padded bucket (reference: rank_objective.hpp:401-452)."""
     NEG = -1e30

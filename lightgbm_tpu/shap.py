@@ -10,6 +10,7 @@ trees).
 """
 from __future__ import annotations
 
+import functools
 from typing import List
 
 import numpy as np
@@ -389,7 +390,9 @@ def _shap_device(trees: List[Tree], X: np.ndarray, num_class: int,
     Xd = jnp.asarray(X.T, f32)                                  # (nf, N)
     Xnan = jnp.isnan(Xd)
 
-    @jax.jit
+    from .telemetry.watchdog import watched_jit
+
+    @functools.partial(watched_jit, name="shap_batch", warn_after=0)
     def run(Xd, Xnan, arrays):
         # N rides the LAST (lane) axis throughout: the per-row tensors are
         # (L, D, N)-shaped so the 128-lane VPU is fully utilised (an

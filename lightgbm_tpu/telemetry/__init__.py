@@ -1,0 +1,154 @@
+"""Unified telemetry: trace spans, training metrics, recompile watchdog.
+
+One master switch drives the whole subsystem (param ``telemetry=True``,
+or :func:`configure` directly):
+
+  * **span tracer** (:mod:`.tracer`) — nested host-side spans exported as
+    Chrome/Perfetto trace-event JSON via :func:`export_trace`;
+  * **metrics registry** (:mod:`.metrics`) — counters/gauges/time
+    histograms plus the per-iteration training records the GBDT loop
+    emits, streamed to a JSONL sink (param ``telemetry_out``);
+  * **recompile watchdog** (:mod:`.watchdog`) — always-on compile
+    counting per jitted entry point with threshold warnings (param
+    ``telemetry_recompile_threshold``);
+  * **multi-host straggler detection** lives in
+    :mod:`lightgbm_tpu.parallel.straggler` (it needs the process mesh,
+    which is the parallel layer's concern) and reports through the
+    registry here.
+
+Everything is a no-op behind a single boolean check when disabled, so
+instrumentation can stay in hot paths unconditionally.
+"""
+from __future__ import annotations
+
+import atexit
+from typing import Any, Dict, Optional
+
+from .metrics import (MetricsRegistry, device_memory_gb, global_registry,
+                      host_rss_gb, memory_snapshot)
+from .tracer import SpanTracer, global_tracer
+from .watchdog import (WatchEntry, get_recompile_threshold, recompile_counts,
+                       reset_watchdog, set_recompile_threshold,
+                       watchdog_summary, watched_jit)
+
+__all__ = [
+    "SpanTracer", "MetricsRegistry", "WatchEntry",
+    "global_tracer", "global_registry",
+    "configure", "enabled", "enabled_source", "enable", "disable", "reset",
+    "span", "instant", "counter_sample", "inc", "gauge", "observe",
+    "record", "export_trace", "flush", "summary",
+    "watched_jit", "recompile_counts", "watchdog_summary",
+    "set_recompile_threshold", "get_recompile_threshold", "reset_watchdog",
+    "memory_snapshot", "device_memory_gb", "host_rss_gb",
+]
+
+_trace_out: Optional[str] = None
+# who enabled telemetry: "api" (user called configure/enable directly) or
+# "params" (a Booster's construction params). Param-driven enablement is
+# per-model: constructing a later Booster WITHOUT telemetry params turns it
+# off again, so model B never inherits model A's sinks or per-iteration
+# sync overhead; an explicit API enable is never clobbered by a Booster.
+_enabled_source: Optional[str] = None
+
+
+def configure(enabled: bool = True, metrics_out: Optional[str] = None,
+              trace_out: Optional[str] = None,
+              recompile_threshold: Optional[int] = None,
+              _source: str = "api") -> None:
+    """Turn telemetry on/off and point its sinks.
+
+    ``metrics_out`` — JSONL path for streamed records; ``trace_out`` —
+    Chrome trace JSON written by :func:`flush` (training calls it at the
+    end of ``train()``); ``recompile_threshold`` — watchdog warn level."""
+    global _trace_out, _enabled_source
+    if enabled:
+        global_tracer.enable()
+        global_registry.enable()
+        _enabled_source = _source
+    else:
+        global_tracer.disable()
+        global_registry.disable()
+        _enabled_source = None
+    if metrics_out is not None:
+        global_registry.set_sink(metrics_out or None)
+    if trace_out is not None:
+        _trace_out = trace_out or None
+    if recompile_threshold is not None:
+        set_recompile_threshold(recompile_threshold)
+
+
+def enabled_source() -> Optional[str]:
+    return _enabled_source
+
+
+def enabled() -> bool:
+    return global_tracer.enabled or global_registry.enabled
+
+
+def enable() -> None:
+    configure(enabled=True)
+
+
+def disable() -> None:
+    configure(enabled=False)
+
+
+def reset() -> None:
+    """Clear collected spans/metrics (keeps enabled state and sinks)."""
+    global_tracer.reset()
+    global_registry.reset()
+
+
+# -- thin instrument aliases (the hot-path entry points) --------------------
+span = global_tracer.span
+instant = global_tracer.instant
+counter_sample = global_tracer.counter
+inc = global_registry.inc
+gauge = global_registry.gauge
+observe = global_registry.observe
+record = global_registry.record
+
+
+def export_trace(path: str) -> str:
+    """Write the span buffer as Chrome/Perfetto trace-event JSON."""
+    return global_tracer.export_trace(path)
+
+
+def trace_out_path() -> Optional[str]:
+    return _trace_out
+
+
+def flush() -> None:
+    """Write the configured trace file (if any). Safe to call repeatedly."""
+    if _trace_out:
+        try:
+            export_trace(_trace_out)
+        except OSError:
+            pass
+
+
+@atexit.register
+def _flush_at_exit() -> None:   # best-effort for CLI / script runs
+    flush()
+
+
+def summary() -> Dict[str, Any]:
+    """One dict with everything: metrics snapshot, span phase totals,
+    recompile rollup, memory, and sink locations."""
+    phases = global_tracer.phase_snapshot()
+    counts = global_tracer.phase_counts()
+    out: Dict[str, Any] = {
+        "enabled": enabled(),
+        **global_registry.snapshot(),
+        "phases": {k: {"total_s": round(v, 6), "calls": counts.get(k, 0),
+                       "mean_s": round(v / max(counts.get(k, 1), 1), 6)}
+                   for k, v in sorted(phases.items(),
+                                      key=lambda kv: -kv[1])},
+        "recompiles": watchdog_summary(),
+        "memory": memory_snapshot(),
+    }
+    if global_registry.sink_path:
+        out["telemetry_out"] = global_registry.sink_path
+    if _trace_out:
+        out["trace_out"] = _trace_out
+    return out

@@ -1,0 +1,189 @@
+"""Nested span tracer with Chrome/Perfetto trace-event export.
+
+The host-side analog of the reference's ``Common::Timer``/``FunctionTimer``
+RAII scopes (include/LightGBM/utils/common.h:980) — but structured: spans
+nest, carry attributes, and export to the Chrome trace-event JSON format
+(the ``chrome://tracing`` / https://ui.perfetto.dev schema), so a training
+run can be inspected on the same timeline tooling used for device profiles.
+
+Design constraints:
+  * zero overhead when disabled — ``span()`` returns one shared no-op
+    context manager behind a single boolean check, allocating nothing;
+  * thread-safe — events append under a lock, nesting is tracked per
+    thread (trace-event "B"/"E" pairs nest per ``tid`` by construction);
+  * bounded — the event buffer is capped; overflow increments a drop
+    counter instead of growing without limit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+# Chrome trace-event phases used here: B/E = nested begin/end duration
+# events, C = counter track, i = instant event, M = metadata.
+_MAX_EVENTS = int(os.environ.get("LIGHTGBM_TPU_TRACE_MAX_EVENTS", 2_000_000))
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records a "B" event on enter and an "E" on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._tracer._emit("B", self._name, self._t0, self._args)
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer._emit("E", self._name, t1, None)
+        self._tracer._account(self._name, t1 - self._t0)
+        return False
+
+
+class SpanTracer:
+    """Nested, thread-safe span recorder (low-overhead when disabled)."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._epoch = time.perf_counter()
+        self._phase_totals: Dict[str, float] = {}
+        self._phase_counts: Dict[str, int] = {}
+        self._local = threading.local()
+
+    # -- control -----------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+            self._epoch = time.perf_counter()
+            self._phase_totals = {}
+            self._phase_counts = {}
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, **args: Any):
+        """Context manager for a traced region; no-op when disabled.
+
+        The disabled path is a single boolean check returning a shared
+        object — safe to leave in hot loops."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Point-in-time marker (watchdog warnings, stop events, ...)."""
+        if not self.enabled:
+            return
+        self._emit("i", name, time.perf_counter(), args or None,
+                   extra={"s": "t"})
+
+    def counter(self, name: str, **values: float) -> None:
+        """Counter-track sample: renders as a stacked area in Perfetto."""
+        if not self.enabled:
+            return
+        self._emit("C", name, time.perf_counter(),
+                   {k: float(v) for k, v in values.items()})
+
+    def _emit(self, ph: str, name: str, t: float, args: Optional[dict],
+              extra: Optional[dict] = None) -> None:
+        ev: Dict[str, Any] = {
+            "name": name, "ph": ph, "pid": os.getpid(),
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "ts": (t - self._epoch) * 1e6,
+        }
+        if args:
+            ev["args"] = args
+        if extra:
+            ev.update(extra)
+        with self._lock:
+            if len(self._events) < _MAX_EVENTS:
+                self._events.append(ev)
+            else:
+                self._dropped += 1
+
+    def _account(self, name: str, dt: float) -> None:
+        with self._lock:
+            self._phase_totals[name] = self._phase_totals.get(name, 0.0) + dt
+            self._phase_counts[name] = self._phase_counts.get(name, 0) + 1
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def phase_snapshot(self) -> Dict[str, float]:
+        """Copy of cumulative per-span-name wall totals (seconds)."""
+        with self._lock:
+            return dict(self._phase_totals)
+
+    def phase_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._phase_counts)
+
+    # -- export ------------------------------------------------------------
+    def export_trace(self, path: str) -> str:
+        """Write the collected events as Chrome trace-event JSON.
+
+        The output object is the standard ``{"traceEvents": [...]}``
+        envelope (plus process/thread metadata), loadable directly in
+        Perfetto or chrome://tracing."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        meta: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+            "args": {"name": "lightgbm_tpu host"},
+        }]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": os.getpid(), "tid": tid,
+                         "args": {"name": f"host-thread-{tid}"}})
+        blob = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "lightgbm_tpu.telemetry",
+                          "dropped_events": dropped},
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            # default=str: span attributes are user-supplied (numpy scalars,
+            # paths, ...) and must never make the end-of-run export raise
+            json.dump(blob, fh, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+global_tracer = SpanTracer()

@@ -1,0 +1,180 @@
+"""Recompile watchdog: count XLA traces per jitted entry point.
+
+Retraces are the #1 silent TPU perf killer — a shape or dtype drift turns
+a cached dispatch into a multi-second XLA compile in the middle of
+training, with nothing in the logs. ``watched_jit`` wraps ``jax.jit`` so
+every trace of the underlying function increments a per-entry counter
+(tracing happens exactly once per compilation-cache miss; steady-state
+dispatches go through jit's C++ fast path and never touch the wrapper),
+and an entry that retraces beyond a configurable threshold logs a warning
+carrying the offending argument shapes/dtypes.
+
+Entries are identified by (name, owner): engine-owned jits pass their
+engine instance as ``owner`` so a rebuild of the same logical entry point
+(e.g. ``Booster.reset_parameter`` re-jitting the grower mid-training)
+keeps counting against the same entry, while a fresh model's first
+compile does not inherit another model's count. Module-level kernel jits
+that legitimately re-specialize per shape (pallas kernels, ranking
+buckets) pass ``warn_after=0`` to count without ever warning.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import weakref
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..utils.log import log_warning
+
+_lock = threading.Lock()
+# weak enumeration for summaries: an entry stays alive exactly as long as
+# something can still trace it (the jitted closure and, for owned entries,
+# the owner's `_telemetry_watches` dict hold the strong references), so a
+# dead model's counters neither leak nor get inherited by an unrelated new
+# model that happens to reuse its memory address
+_entries: "weakref.WeakSet[WatchEntry]" = weakref.WeakSet()
+_default_threshold = 2
+
+
+class WatchEntry:
+    """Compile counter for one watched entry point."""
+
+    def __init__(self, name: str, warn_after: Optional[int]) -> None:
+        self.name = name
+        self.warn_after = warn_after   # None = use the global threshold
+        self.count = 0
+        self.signatures: List[str] = []   # last few trace signatures
+        self.warned = 0
+
+    def effective_threshold(self) -> int:
+        return _default_threshold if self.warn_after is None else self.warn_after
+
+    def note_trace(self, args: tuple, kwargs: dict) -> None:
+        sig = _signature(args, kwargs)
+        with _lock:
+            self.count += 1
+            self.signatures.append(sig)
+            if len(self.signatures) > 4:
+                del self.signatures[0]
+            count = self.count
+            prev = self.signatures[-2] if len(self.signatures) >= 2 else None
+        thr = self.effective_threshold()
+        if thr > 0 and count > thr:
+            with _lock:
+                self.warned += 1
+            msg = (f"telemetry: {self.name!r} recompiled (trace #{count}, "
+                   f"threshold {thr}) — mid-training retraces stall the "
+                   f"device for the full XLA compile; new signature {sig}")
+            if prev is not None and prev != sig:
+                msg += f"; previous signature {prev}"
+            log_warning(msg)
+            from .tracer import global_tracer
+            global_tracer.instant(f"recompile:{self.name}", count=count,
+                                  signature=sig)
+        from .metrics import global_registry
+        global_registry.inc(f"recompile/{self.name}")
+
+
+def _abbrev(x: Any) -> str:
+    aval = getattr(x, "aval", None)
+    if aval is not None and hasattr(aval, "shape"):
+        return f"{getattr(aval.dtype, 'name', aval.dtype)}{list(aval.shape)}"
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return f"{getattr(x.dtype, 'name', x.dtype)}{list(x.shape)}"
+    r = repr(x)
+    return r if len(r) <= 24 else r[:21] + "..."
+
+
+def _signature(args: tuple, kwargs: dict) -> str:
+    try:
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        return "(" + ", ".join(_abbrev(v) for v in leaves[:24]) + \
+            (", ..." if len(leaves) > 24 else "") + ")"
+    except Exception:
+        return "(?)"
+
+
+def set_recompile_threshold(n: int) -> None:
+    """Global warn threshold: entries warn on trace count > n (0 = never)."""
+    global _default_threshold
+    _default_threshold = int(n)
+
+
+def get_recompile_threshold() -> int:
+    return _default_threshold
+
+
+def watched_jit(fun=None, *, name: Optional[str] = None, owner: Any = None,
+                warn_after: Optional[int] = None, **jit_kwargs):
+    """``jax.jit`` with per-entry-point compile counting.
+
+    Usable directly (``watched_jit(f, name=...)``) or as a decorator
+    factory (``@watched_jit(name=..., static_argnames=...)``). ``owner``
+    scopes the counter: passing the same (name, owner) pair again — e.g.
+    when an engine re-jits one of its entry points — reuses the counter,
+    which is exactly what turns a parameter-reset retrace into a warning.
+    """
+    def wrap(f):
+        wname = name or getattr(f, "__name__", "jit_fn")
+        entry = None
+        if owner is not None:
+            watches = owner.__dict__.setdefault("_telemetry_watches", {})
+            entry = watches.get(wname)
+        if entry is None:
+            entry = WatchEntry(wname, warn_after)
+            if owner is not None:
+                watches[wname] = entry
+        with _lock:
+            _entries.add(entry)
+
+        @functools.wraps(f)
+        def traced(*args, **kwargs):
+            # runs ONLY while jax traces (i.e. on a compilation-cache miss)
+            entry.note_trace(args, kwargs)
+            return f(*args, **kwargs)
+
+        jitted = jax.jit(traced, **jit_kwargs)
+        try:
+            jitted._telemetry_watch = entry
+        except AttributeError:
+            pass   # PjitFunction may reject attributes; the registry has it
+        return jitted
+
+    return wrap if fun is None else wrap(fun)
+
+
+def recompile_counts() -> Dict[str, int]:
+    """Aggregate trace counts by entry-point name (live entries; the
+    metrics registry's ``recompile/<name>`` counters are cumulative)."""
+    out: Dict[str, int] = {}
+    with _lock:
+        for entry in _entries:
+            out[entry.name] = out.get(entry.name, 0) + entry.count
+    return out
+
+
+def watchdog_summary() -> Dict[str, Any]:
+    """Per-name {entries, compiles, max_per_entry, warned} rollup."""
+    out: Dict[str, Dict[str, int]] = {}
+    with _lock:
+        for entry in _entries:
+            s = out.setdefault(entry.name, {"entries": 0, "compiles": 0,
+                                            "max_per_entry": 0, "warned": 0})
+            s["entries"] += 1
+            s["compiles"] += entry.count
+            s["max_per_entry"] = max(s["max_per_entry"], entry.count)
+            s["warned"] += entry.warned
+    return out
+
+
+def reset_watchdog() -> None:
+    """Zero every live entry's counters. Entries stay registered — the
+    module-level kernel jits were wrapped once at import and can never
+    re-register, so clearing the set would blind the watchdog to them."""
+    with _lock:
+        for entry in _entries:
+            entry.count = 0
+            entry.signatures = []
+            entry.warned = 0

@@ -9,8 +9,13 @@ import logging
 from typing import Any, Callable, Optional
 
 _logger: Any = logging.getLogger("lightgbm_tpu")
-_logger.addHandler(logging.StreamHandler())
-_logger.setLevel(logging.INFO)
+# guard against duplicate handlers on re-import/reload, and respect a logger
+# the user configured before importing this package: only attach our default
+# StreamHandler when none exists, and only set a level when none was chosen
+if not _logger.handlers:
+    _logger.addHandler(logging.StreamHandler())
+if _logger.level == logging.NOTSET:
+    _logger.setLevel(logging.INFO)
 
 _info_method_name = "info"
 _warning_method_name = "warning"

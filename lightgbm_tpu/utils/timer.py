@@ -2,7 +2,8 @@
 
 Reference: include/LightGBM/utils/common.h:980 (Common::Timer / global_timer, RAII
 FunctionTimer, printed at exit under USE_TIMETAG). TPU equivalent additionally wraps
-jax.named_scope so regions show up in xprof traces.
+jax.named_scope so regions show up in xprof traces, and feeds the telemetry span
+tracer (lightgbm_tpu.telemetry) so the same regions land in exported Chrome traces.
 """
 from __future__ import annotations
 
@@ -11,18 +12,44 @@ import contextlib
 import os
 import time
 from collections import defaultdict
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
 
 import jax
 
+from ..telemetry.tracer import global_tracer
+
 
 class Timer:
-    """Accumulating named wall-clock timer (host-side)."""
+    """Accumulating named wall-clock timer (host-side).
+
+    ``enabled`` re-reads ``LIGHTGBM_TPU_TIMETAG`` lazily on every check, so
+    setting the env var after import works; :meth:`enable`/:meth:`disable`
+    (or assigning ``enabled``) override the env var for this process."""
 
     def __init__(self) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
-        self.enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+        self._enabled_override: Optional[bool] = None
+
+    @property
+    def enabled(self) -> bool:
+        if self._enabled_override is not None:
+            return self._enabled_override
+        return os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._enabled_override = bool(value)
+
+    def enable(self) -> None:
+        self._enabled_override = True
+
+    def disable(self) -> None:
+        self._enabled_override = False
+
+    def reset_enabled(self) -> None:
+        """Drop any override; follow the env var again."""
+        self._enabled_override = None
 
     @contextlib.contextmanager
     def scope(self, name: str) -> Iterator[None]:
@@ -37,8 +64,15 @@ class Timer:
             self.counts[name] += 1
 
     def report(self) -> str:
-        lines = [f"{name}: {total:.3f}s ({self.counts[name]} calls)"
-                 for name, total in sorted(self.totals.items())]
+        """Hot spots first: sorted by total time descending, with per-call
+        mean (the alphabetical order of the original hid the hot paths)."""
+        lines = []
+        for name, total in sorted(self.totals.items(),
+                                  key=lambda kv: -kv[1]):
+            n = self.counts[name]
+            mean_ms = total / n * 1e3 if n else 0.0
+            lines.append(f"{name}: {total:.3f}s ({n} calls, "
+                         f"{mean_ms:.3f} ms/call)")
         return "\n".join(lines)
 
 
@@ -53,7 +87,9 @@ def _print_timers() -> None:
 
 @contextlib.contextmanager
 def named_scope(name: str) -> Iterator[None]:
-    """Combined host timer + device trace annotation (shows in JAX profiler)."""
+    """Combined device trace annotation (JAX profiler) + host timer +
+    telemetry span (Chrome trace export) for one region."""
     with jax.named_scope(name):
         with global_timer.scope(name):
-            yield
+            with global_tracer.span(name):
+                yield

@@ -8,6 +8,9 @@ and shifts time into trivial-tree host syncs, so it cannot attribute time).
 Usage: python scripts/kernel_bench.py [rows] — runs ONE configuration per
 process; the sweep driver loops over LGBTPU_KABLATE values externally
 (the probe is read at stream_kernel import time).
+
+KB_TRACE_OUT=<path> records each pass as a telemetry span and writes a
+Chrome/Perfetto trace (lightgbm_tpu.telemetry.export_trace) on exit.
 """
 import os
 import sys
@@ -75,19 +78,29 @@ def main():
             int_weights=int_path)
         return nl, hist, cnt
 
-    nl, hist, cnt = run(leaf_id)
-    jax.block_until_ready((nl, hist, cnt))
+    from lightgbm_tpu import telemetry as tel
+    trace_out = os.environ.get("KB_TRACE_OUT", "")
+    if trace_out:
+        tel.configure(enabled=True, trace_out=trace_out)
+
+    with tel.span("kernel_bench::warmup", rows=rows):
+        nl, hist, cnt = run(leaf_id)
+        jax.block_until_ready((nl, hist, cnt))
     reps = 10
     # chain each rep on the previous output so every dispatch is real
     # sequential device work (identical repeated dispatches measured
     # impossibly fast through the tunnel)
     lid = nl % L
     t0 = time.time()
-    for _ in range(reps):
-        out = run(lid)
-        lid = out[0] % L
+    for rep in range(reps):
+        with tel.span("kernel_bench::route_and_hist", rep=rep):
+            out = run(lid)
+            lid = out[0] % L
     jax.block_until_ready(out)
     dt = (time.time() - t0) / reps
+    if trace_out:
+        tel.flush()
+        print(f"KB trace written to {trace_out}")
     gbps = (layout.bins_T.size * 4 + n_pad * (4 + 12)) / dt / 1e9
     print(f"KB ablate={os.environ.get('LGBTPU_KABLATE','')!r} "
           f"int={int_path} two_pass={two_pass} rows={rows} T={T} "

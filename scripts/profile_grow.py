@@ -4,6 +4,11 @@ from the chrome trace (dev tool).
 Usage: python scripts/profile_grow.py [rows]
        PROFILE_TASK=ranking python scripts/profile_grow.py [docs]
 (BENCH_EXTRA_PARAMS merges into the training params for either task.)
+
+PROFILE_TRACE_OUT=<path> additionally records the profiled iterations
+through the telemetry span tracer and writes the host-side Chrome trace
+there (load it in the same Perfetto tab as the device trace to line up
+host phases against device ops).
 """
 import glob
 import gzip
@@ -42,6 +47,10 @@ def main():
         X = rs.randn(rows, 28).astype(np.float32)
         y = (rs.rand(rows) < 0.5).astype(np.float64)
         ds = lgb.Dataset(X, label=y)
+    host_trace = os.environ.get("PROFILE_TRACE_OUT", "")
+    from lightgbm_tpu import telemetry as tel
+    if host_trace:
+        tel.configure(enabled=True, trace_out=host_trace)
     bst = lgb.Booster(params, ds)
     for _ in range(3):      # warmup: compile everything
         bst.update()
@@ -56,6 +65,11 @@ def main():
         bst.engine.score.block_until_ready()
         wall = time.time() - t0
     print(f"3 iters wall: {wall*1e3:.1f} ms ({wall/3*1e3:.1f} ms/iter)")
+    if host_trace:
+        tel.flush()
+        s = bst.telemetry_summary()
+        print(f"host trace written to {host_trace}; phases:",
+              {k: v["total_s"] for k, v in s.get("phases", {}).items()})
 
     files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
     if not files:

@@ -3,5 +3,8 @@
 # nightly consistency suites. ~17 min total on the 8-device CPU mesh.
 set -e
 cd "$(dirname "$0")/.."
+# telemetry first: cheapest suite, and a broken observability layer makes
+# every later perf triage lie
+python -m pytest tests/test_telemetry.py -x -q
 python -m pytest tests/ -x -q
 python -m pytest tests/ -x -q -m slow
