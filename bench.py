@@ -31,7 +31,13 @@ N_ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 N_FEATURES = 28
 NUM_LEAVES = 255
 N_ITERS = int(os.environ.get("BENCH_ITERS", 30))
-AUC_GATE = float(os.environ.get("BENCH_AUC_GATE", 0.84))
+# Quality gate tightened toward stock parity (was a loose 0.84): the
+# quantized full-size run measures 0.9035 (full-precision 0.9025), and the
+# reference's GPU-vs-CPU protocol accepts ~0.0005 AUC slack at reduced bin
+# counts (docs/GPU-Performance.rst:126, 0.845209 vs 0.845724) — 0.885 keeps
+# >1.8% slack for bin/seed noise while rejecting quality regressions the
+# old gate let through.
+AUC_GATE = float(os.environ.get("BENCH_AUC_GATE", 0.885))
 BASELINE_S_PER_TREE = 130.094 / 500.0  # LightGBM CPU HIGGS, 255-bin
 HIGGS_ROWS = 10_500_000
 
@@ -237,7 +243,12 @@ def run_ranking():
     default_docs = round(2_270_000 * min(1.0, N_ROWS / HIGGS_ROWS))
     n_docs = int(os.environ.get("BENCH_RANK_ROWS", default_docs))
     n_iters = int(os.environ.get("BENCH_RANK_ITERS", 30))
-    gate = float(os.environ.get("BENCH_NDCG_GATE", 0.70))
+    # tightened from the loose 0.70: a deliberately UNDERTRAINED probe (4
+    # trees, 63 leaves, 30k docs) already measures NDCG@10 0.781 on this
+    # generator, so the full-size 255-leaf run clears 0.75 with margin
+    # while quality regressions (wrong histograms, broken lambdarank
+    # gradients) land far below it
+    gate = float(os.environ.get("BENCH_NDCG_GATE", 0.75))
     baseline_s_per_tree = 70.417 / 500.0   # MSLR CPU, Experiments.rst:117
     X, y, sizes = make_mslr_like(n_docs, 136)
     # holdout: last ~10% of queries
@@ -281,6 +292,96 @@ def run_ranking():
         "unit": (f"s/tree (lower is better; 2.27M docs, 255 leaves, 63 bins, "
                  f"holdout NDCG@10 {ndcg:.4f} "
                  f"{'>=' if ok else '< GATE '}{gate})"),
+        "vs_baseline": round(vs_baseline, 3) if ok else 0.0,
+        **_memory_fields(rss0),
+        **_telemetry_fields(bst),
+    }), flush=True)
+    return ok
+
+
+def make_multiclass_like(n, f, k=10, seed=17):
+    """Synthetic K-class softmax task: 28 continuous features, linear class
+    logits plus a shared nonlinear confusion term, calibrated so a 255-leaf
+    GBDT reaches ~0.9 top-1 accuracy at 2M rows (chance = 1/K)."""
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).astype(np.float32)
+    W = rs.randn(f, k).astype(np.float32) * 0.9
+    logits = X @ W
+    logits += (0.8 * np.sin(3 * X[:, :1]) + 0.6 * X[:, 1:2] * X[:, 2:3])
+    y = np.argmax(logits + rs.randn(n, k).astype(np.float32) * 0.8,
+                  axis=1).astype(np.float64)
+    return X, y
+
+
+def run_multiclass():
+    """Third workload: K-class softmax — the batched multiclass growth
+    target (one widened histogram contraction serves all K class trees).
+    Reports ms/iter (one iteration = K trees) and the multiclass:binary
+    per-iteration ratio on the SAME rows/features/leaf budget: measured
+    9.3x before batching (docs/PERF.md, 716 vs 77 ms/iter at 2M rows,
+    K=10); the widened path targets <= 3.5x."""
+    import lightgbm_tpu as lgb
+
+    rss0 = _rss_kb()
+    default_rows = round(2_000_000 * min(1.0, N_ROWS / HIGGS_ROWS))
+    n = int(os.environ.get("BENCH_MC_ROWS", default_rows))
+    n_iters = int(os.environ.get("BENCH_MC_ITERS", 30))
+    k = int(os.environ.get("BENCH_MC_CLASSES", 10))
+    # top-1 accuracy gate (chance = 1/K): a LINEAR probe on this generator
+    # measures 0.766 at 300k rows, so a healthy 255-leaf GBDT at full size
+    # clears 0.80 while broken training cannot
+    gate = float(os.environ.get("BENCH_MC_ACC_GATE", 0.80))
+    X, y = make_multiclass_like(n, N_FEATURES, k)
+    n_test = min(200_000, max(n // 10, 1))
+    X_tr, y_tr = X[:-n_test], y[:-n_test]
+    X_te, y_te = X[-n_test:], y[-n_test:]
+    params = {
+        "objective": "multiclass",
+        "num_class": k,
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "verbosity": -1,
+    }
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
+    if os.environ.get("BENCH_TELEMETRY", "") == "1":
+        params.setdefault("telemetry", True)
+
+    def _time_iters(p, label):
+        ds = lgb.Dataset(X_tr, label=label)
+        bst = lgb.Booster(p, ds)
+        bst.update()
+        bst.engine.score.block_until_ready()
+        t0 = time.time()
+        for _ in range(n_iters):
+            bst.update()
+        bst.engine.score.block_until_ready()
+        return (time.time() - t0) / n_iters, bst
+
+    mc_s_per_iter, bst = _time_iters(params, y_tr)
+    # binary probe on the SAME matrix and leaf budget: the denominator of
+    # the multiclass:binary per-iteration ratio
+    bparams = {kk: v for kk, v in params.items() if kk != "num_class"}
+    bparams["objective"] = "binary"
+    bin_s_per_iter, _ = _time_iters(bparams, (y_tr % 2).astype(np.float64))
+    ratio = mc_s_per_iter / max(bin_s_per_iter, 1e-12)
+
+    prob = np.asarray(bst.predict(X_te))
+    acc = float(np.mean(np.argmax(prob, axis=1) == y_te))
+    ok = acc >= gate
+    # baseline: the pre-batching scan path measured 9.3x binary per
+    # iteration — vs_baseline > 1 means the widened program beats it
+    vs_baseline = (9.3 * bin_s_per_iter) / mc_s_per_iter
+    print(json.dumps({
+        "metric": f"multiclass_softmax_ms_per_iter_{n}rows_k{k}",
+        "value": round(mc_s_per_iter * 1e3, 3),
+        "unit": (f"ms/iter = {k} trees (lower is better; {NUM_LEAVES} "
+                 f"leaves, 63 bins, holdout top-1 acc {acc:.4f} "
+                 f"{'>=' if ok else '< GATE '}{gate})"),
+        "mc_binary_ratio": round(ratio, 3),
+        "binary_ms_per_iter": round(bin_s_per_iter * 1e3, 3),
         "vs_baseline": round(vs_baseline, 3) if ok else 0.0,
         **_memory_fields(rss0),
         **_telemetry_fields(bst),
@@ -363,8 +464,9 @@ def main():
 
 if __name__ == "__main__":
     task = os.environ.get("BENCH_TASK", "")
-    if task not in ("", "higgs", "ranking"):
-        sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking")
+    if task not in ("", "higgs", "ranking", "multiclass"):
+        sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "
+                 "multiclass")
     ok = True
     if task in ("", "higgs"):
         ok = main() and ok
@@ -372,5 +474,9 @@ if __name__ == "__main__":
         import gc
         gc.collect()   # drop the HIGGS matrices before the ranking ingest
         ok = run_ranking() and ok
+    if task in ("", "multiclass"):
+        import gc
+        gc.collect()
+        ok = run_multiclass() and ok
     if not ok:
         sys.exit(1)

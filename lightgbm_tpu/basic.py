@@ -1358,17 +1358,22 @@ class Booster:
     def _single_row_fast_cached(self, use, start_iteration, end_iteration, k):
         """Internal predict() fast path: averaging/conversion stay in the
         generic tail, so the packed predictor is raw with factor 1.  The
-        key carries every tree's leaf_value array identity: in-place model
-        mutation (DART drop-rescale calls tree.shrink, which REBINDS
-        leaf_value) must invalidate the packed arrays."""
-        key = (start_iteration, end_iteration, k,
-               tuple(id(t.leaf_value) for t in use))
+        cache holds STRONG references to every tree's leaf_value array and
+        compares with ``is``: model mutation (DART drop-rescale calls
+        tree.shrink, which REBINDS leaf_value) must invalidate the packed
+        arrays, and identity keyed on id() alone could false-hit when a
+        dropped array's address is recycled for a rebound one."""
+        key = (start_iteration, end_iteration, k)
+        vals = [t.leaf_value for t in use]
         cached = getattr(self, "_fast1_cache", None)
-        if cached is None or cached[0] != key:
+        if (cached is None or cached[0] != key
+                or len(cached[1]) != len(vals)
+                or any(a is not b for a, b in zip(cached[1], vals))):
             from .predict_fast import SingleRowFastPredictor
-            cached = (key, SingleRowFastPredictor(use, k, self.num_feature()))
+            cached = (key, vals,
+                      SingleRowFastPredictor(use, k, self.num_feature()))
             self._fast1_cache = cached
-        return cached[1]
+        return cached[2]
 
     _DEVICE_PREDICT_MIN_ROWS = 20_000
 
@@ -1608,13 +1613,19 @@ class Booster:
     def shuffle_models(self, start_iteration: int = 0,
                        end_iteration: int = -1) -> "Booster":
         """Randomly permute tree order in [start, end) iterations
-        (reference: GBDT::ShuffleModels; used before refit)."""
+        (reference: GBDT::ShuffleModels; used before refit).  Uses a LOCAL
+        RNG seeded from data_random_seed so refit pipelines are
+        reproducible and the global numpy RNG state stays untouched."""
         trees = self._all_trees()
         k = self.num_model_per_iteration()
         n_iter = len(trees) // max(k, 1)
         end = n_iter if end_iteration <= 0 else min(end_iteration, n_iter)
+        seed = int((getattr(self, "params", None) or {})
+                   .get("data_random_seed", 1) or 1)
+        rng = np.random.RandomState((seed * 65539 + start_iteration * 9973
+                                     + max(end, 0)) % (2 ** 31 - 1))
         idx = np.arange(start_iteration, end)
-        np.random.shuffle(idx)
+        rng.shuffle(idx)
         order = list(range(n_iter))
         order[start_iteration:end] = [int(i) for i in idx]
         new_trees = []

@@ -174,6 +174,7 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "hist_backend": [],          # auto | segsum | onehot | pallas | stream
     "hist_precision": [],        # auto | mixed (two-pass bf16, ~f32) | single
     "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
+    "multiclass_batched": ["batched_multiclass"],
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
     # --- telemetry (docs/OBSERVABILITY.md) ---
@@ -423,6 +424,12 @@ class Config:
     # growth can deviate from best-first only when the leaf budget runs out
     # mid-round (children of just-split leaves aren't candidates yet).
     max_splits_per_round: int = 0
+    # grow all K class trees in ONE widened lockstep program (one histogram
+    # contraction serves every class's gradient channels); falls back to the
+    # per-class scan when a constraint feature is active. Trees are
+    # bit-identical either way — LGBTPU_MULTICLASS_BATCHED=1/0 forces the
+    # choice for A/B experiments.
+    multiclass_batched: bool = True
     mesh_shape: str = ""
     tpu_dtype: str = "f32"
 

@@ -292,8 +292,11 @@ def load_init_score_file(path: str) -> Optional[np.ndarray]:
     multiclass; reference: metadata.cpp:759 LoadInitialScore)."""
     ipath = path + ".init"
     if os.path.exists(ipath):
-        arr = np.loadtxt(ipath, dtype=np.float64)
-        return arr if arr.ndim > 1 else arr.reshape(-1)
+        # ndmin=2 keeps a one-row multiclass file at (1, num_class) —
+        # loadtxt would otherwise squeeze it to (num_class,) and the
+        # column count (= class count) would be unrecoverable
+        arr = np.loadtxt(ipath, dtype=np.float64, ndmin=2)
+        return arr.reshape(-1) if arr.shape[1] == 1 else arr
     return None
 
 

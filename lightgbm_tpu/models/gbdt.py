@@ -252,6 +252,10 @@ class GBDT:
         self._grow_fn = watched_jit(self._grow_partial, name="grow_tree",
                                     owner=self)
         self._grow_fn_k = None
+        self._grow_fn_kb = None
+        self._score_add_k_fn = None
+        self._mc_batched_last = False
+        self._mc_stacked = None
         self._iter_fn = None
         self._cegb_used = (jnp.zeros(dd.num_features, bool)
                            if self._grow_params.has_cegb else None)
@@ -898,10 +902,84 @@ class GBDT:
             setattr(self.objective, a, v)
         return out[:5]
 
+    def _use_batched_multiclass(self) -> bool:
+        """Eligibility for the WIDENED lockstep multiclass path
+        (ops.grow.grow_tree_k): one histogram contraction per growth round
+        serves all K classes' gradient channels, instead of the per-class
+        lax.scan rebuilding the class-independent one-hot construct K
+        times. LGBTPU_MULTICLASS_BATCHED=1/0 forces the choice (A/B
+        experiments); config multiclass_batched=False opts out."""
+        import os as _os
+        force = _os.environ.get("LGBTPU_MULTICLASS_BATCHED", "")
+        if force == "0":
+            return False
+        # everything below the env hook is static for the training run —
+        # evaluate once (the forced-splits gate re-reads a JSON file)
+        cached = getattr(self, "_mc_batched_static", None)
+        if cached is None:
+            gp = self._grow_params
+            ok = not (gp.has_monotone or gp.has_interaction or gp.has_cegb
+                      or gp.extra_trees or gp.bynode_fraction < 1.0
+                      or gp.path_smooth > 0.0 or self._needs_grow_key
+                      or self._parse_forced_splits() is not None)
+            if ok and gp.hist_backend == "stream":
+                # the widened (m_rows, 2*S*K) histogram block stays VMEM-
+                # resident across the whole kernel grid; past ~12 MB the
+                # scan path (per-class blocks) is the safe fallback
+                K = self.num_tree_per_iteration
+                S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+                Bpad = -(-self.dd.max_bins // 8) * 8
+                if gp.bin_buckets is not None:
+                    from ..binning import bucket_run_rows
+                    m_rows = -(-sum(bucket_run_rows(b, g)
+                                    for b, g in gp.bin_buckets) // 128) * 128
+                else:
+                    m_rows = self.dd.num_groups * Bpad
+                ok = m_rows * 2 * S * K * 4 <= 12 * 2 ** 20
+            cached = self._mc_batched_static = ok
+        if not cached:
+            return False
+        return force == "1" or self.config.multiclass_batched
+
+    def _grow_classes_batched(self, grad, hess, mask, col_mask, gh_scales,
+                              k: int):
+        """All K class trees from ONE widened lockstep program
+        (ops.grow.grow_tree_k): the dominant one-hot bin construct and its
+        MXU contraction are built once per growth round and contract
+        against the stacked (N, 2K) grad/hess channel block."""
+        if self._grow_fn_kb is None:
+            from ..ops.grow import grow_tree_k
+            dd = self.dd
+            gp = self._grow_params
+            mesh = self.mesh if self._mesh_stream else None
+            row_axis = self._row_axis
+
+            def _fn(bins, grad2, hess2, mask, colm, packed, scales):
+                return grow_tree_k(bins, grad2.T, hess2.T, mask, colm,
+                                   layout=dd.layout, routing=dd.routing,
+                                   params=gp, packed=packed,
+                                   gh_scales=scales, mesh=mesh,
+                                   row_axis=row_axis)
+
+            self._grow_fn_kb = watched_jit(_fn, name="grow_tree_k",
+                                           owner=self)
+        scales = (jnp.transpose(gh_scales) if gh_scales is not None
+                  else jnp.zeros((k, 2), jnp.float32))
+        arrays_k, leaf_k = self._grow_fn_kb(
+            self.dd.bins, grad, hess, mask, col_mask, self._packed, scales)
+        self._mc_stacked = (arrays_k, leaf_k)
+        return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
+                for kk in range(k)]
+
     def _grow_classes(self, grad, hess, mask, col_mask, gh_scales, k: int):
-        """Grow all K class trees inside one jitted lax.scan (one launch
-        per iteration instead of K; reference: the per-class tree loop in
-        GBDT::TrainOneIter, gbdt.cpp:412)."""
+        """Grow all K class trees inside one jitted program: the widened
+        lockstep path (grow_tree_k) when eligible, else a lax.scan over
+        classes (one launch per iteration either way; reference: the
+        per-class tree loop in GBDT::TrainOneIter, gbdt.cpp:412)."""
+        self._mc_batched_last = self._use_batched_multiclass()
+        if self._mc_batched_last:
+            return self._grow_classes_batched(grad, hess, mask, col_mask,
+                                              gh_scales, k)
         if self._grow_fn_k is None:
             grow = self._grow_partial
             needs_key = self._needs_grow_key
@@ -919,7 +997,7 @@ class GBDT:
                     body, None, (grad2.T, hess2.T, keys, scales))
                 return out
 
-            self._grow_fn_k = watched_jit(_fn, name="grow_tree_k",
+            self._grow_fn_k = watched_jit(_fn, name="grow_tree_k_scan",
                                           owner=self)
         keys = jnp.stack([
             jax.random.PRNGKey((self.config.extra_seed or 3) * 1000003
@@ -930,6 +1008,7 @@ class GBDT:
         arrays_k, leaf_k = self._grow_fn_k(
             self.dd.bins, grad, hess, mask, col_mask, self._packed,
             scales, keys)
+        self._mc_stacked = (arrays_k, leaf_k)
         return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
                 for kk in range(k)]
 
@@ -1165,6 +1244,34 @@ class GBDT:
                     self._grow_x64_ctx():
                 k_results = self._grow_classes(grad, hess, mask, col_mask,
                                                gh_scales, k)
+        # stacked multiclass score update: ONE launch adds every class's
+        # leaf outputs to the (N, K) score block from the grower's stacked
+        # outputs, replacing K per-class gathers. BOTH multiclass grow
+        # paths (widened lockstep and per-class scan) go through this same
+        # jit so their training scores stay bit-identical — a jitted and an
+        # eager update round differently (FMA fusion), which would leak
+        # ulp-level score drift into later trees.
+        batched_score_done = False
+        if (k_results is not None and self._mc_stacked is not None
+                and not self.config.linear_tree
+                and (self.objective is None
+                     or not self.objective.need_renew_leaf)):
+            arrays_k, leaf_k = self._mc_stacked
+            if self._score_add_k_fn is None:
+                def _sadd_k(score, lid_k, lv_k, rate):
+                    Lk = lv_k.shape[1]
+                    flat = lv_k.reshape(-1) * rate
+                    off = (jnp.arange(lv_k.shape[0]) * Lk)[:, None]
+                    delta = flat[lid_k + off]                # (K, N)
+                    return score + delta.T
+
+                self._score_add_k_fn = watched_jit(_sadd_k,
+                                                   name="score_add_k",
+                                                   owner=self)
+            self.score = self._score_add_k_fn(
+                self.score, leaf_k, arrays_k.leaf_value,
+                jnp.float32(self._shrinkage_rate()))
+            batched_score_done = True
         for kk in range(k):
             g = grad if k == 1 else grad[:, kk]
             h = hess if k == 1 else hess[:, kk]
@@ -1206,6 +1313,14 @@ class GBDT:
             if (self.iter_ == 0 or self._average_output) and \
                     self.init_scores[kk] != 0.0:
                 bias = self.init_scores[kk]
+            if batched_score_done:
+                # score already updated from the stacked outputs in one
+                # launch; only record the tree for lazy finalization
+                self._lazy_trees.append({"arrays": arrays,
+                                         "rate": self._shrinkage_rate(),
+                                         "bias": bias})
+                new_arrays.append(arrays)
+                continue
             if self.config.linear_tree:
                 # host-synced path: fit linear leaf models on the raw features
                 # (reference: linear_tree_learner.cpp CalculateLinear, Eq 3 of

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tree import TreeArrays
-from .histogram import build_histograms
+from .histogram import build_histograms, build_histograms_k
 from .split import (NEG_INF, EPS_HESS, FeatureLayout, SplitResult,
                     categorical_left_bitset, constrained_child_outputs,
                     find_best_splits, gather_feature_histograms, leaf_output,
@@ -449,6 +449,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             # the reference's per-worker histogram construction followed by
             # ReduceScatter (data_parallel_tree_learner.cpp:285-299)
             from jax.sharding import PartitionSpec as P
+            from ..parallel.mesh import shard_map_rows
 
             def _rh(bT, lid_row, wT, tb, bi, num_slots, with_hist=True):
                 def _local(bT, lid_row, wT, tb, bi):
@@ -464,25 +465,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     # device — already replicated, no collective needed
                     return nl, h, jax.lax.psum(c, row_axis)
 
-                # pallas_call cannot annotate varying-mesh-axes on its
-                # outputs; the psum above makes hist/cnt replicated, so
-                # the replication check is off (check_vma in current jax,
-                # check_rep in the older experimental shard_map)
-                specs = dict(
-                    mesh=mesh,
-                    in_specs=(P(None, row_axis), P(None, row_axis),
-                              P(None, row_axis), P(None, None),
-                              P(None, None)),
-                    out_specs=(P(None, row_axis),
-                               P(None, None, None, None), P(None)))
-                try:
-                    from jax import shard_map as _sm
-                except ImportError:
-                    from jax.experimental.shard_map import shard_map as _sm
-                try:
-                    wrapped = _sm(_local, check_vma=False, **specs)
-                except TypeError:   # older signature spells it check_rep
-                    wrapped = _sm(_local, check_rep=False, **specs)
+                wrapped = shard_map_rows(
+                    _local, mesh,
+                    (P(None, row_axis), P(None, row_axis),
+                     P(None, row_axis), P(None, None), P(None, None)),
+                    (P(None, row_axis), P(None, None, None, None), P(None)))
                 return wrapped(bT, lid_row, wT, tb, bi)
         else:
             def _rh(bT, lid_row, wT, tb, bi, num_slots, with_hist=True):
@@ -1284,3 +1271,537 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     if use_lazy:
         return tree, final.leaf_id[:N], final.cegb_lazy
     return tree, final.leaf_id[:N]
+
+
+class _GrowStateK(NamedTuple):
+    """Channelized grow state — every per-class array gains a leading K
+    axis; the round body updates all K class trees in lockstep."""
+    leaf_id: jax.Array          # (K, N_pad) i32
+    split_feature: jax.Array    # (K, L) i32 — node arrays
+    threshold_bin: jax.Array
+    dir_flags: jax.Array
+    left_child: jax.Array
+    right_child: jax.Array
+    split_gain: jax.Array       # (K, L) f32
+    internal_value: jax.Array
+    internal_weight: jax.Array
+    internal_count: jax.Array
+    cat_bitset: jax.Array       # (K, L, Bmax) bool
+    sum_g: jax.Array            # (K, L) hdt — per-leaf stats
+    sum_h: jax.Array
+    cnt: jax.Array
+    depth: jax.Array            # (K, L) i32
+    leaf_parent: jax.Array
+    best_gain: jax.Array        # (K, L) hdt — cached best splits
+    best_feat: jax.Array
+    best_thr: jax.Array
+    best_dir: jax.Array
+    best_left_g: jax.Array
+    best_left_h: jax.Array
+    best_left_c: jax.Array
+    hist: jax.Array             # (K, L, G, Bmax, 2)
+    num_leaves_cur: jax.Array   # (K,) i32
+    progressed: jax.Array       # (K,) bool
+
+
+def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                cnt_w: jax.Array, col_mask: jax.Array,
+                layout: FeatureLayout, routing: RoutingLayout,
+                params: GrowParams,
+                packed=None, gh_scales: Optional[jax.Array] = None,
+                mesh=None, row_axis: Optional[str] = None,
+                ) -> Tuple[TreeArrays, jax.Array]:
+    """Grow K class trees in LOCKSTEP inside one widened XLA program
+    (batched multiclass). Returns (TreeArrays with a leading K axis,
+    leaf_id (K, N)) — the same stacked layout the per-class lax.scan path
+    produces.
+
+    grad/hess: (K, N) class-major gradient channels (bagging mask applied).
+    gh_scales: (K, 2) per-class (grad_scale, hess_scale) or None.
+
+    The dominant per-round cost — the class-independent one-hot bin
+    construct and its MXU contraction — is built ONCE and contracted
+    against the stacked class x slot channel axis: the stream backend runs
+    ONE route_and_hist kernel over (K, N) leaf ids with a (m_rows, 2*S*K)
+    histogram block (the reference's one-histogram-pass-serves-all-classes
+    layout, cuda_histogram_constructor.cu), the onehot/pallas backends go
+    through build_histograms_k. Everything per-class (candidate selection,
+    split scans, node bookkeeping) is computed batched over the K axis with
+    the SAME per-class arithmetic as grow_tree, and classes whose per-class
+    loop would have exited are frozen to exact no-ops — so the trees are
+    bit-identical to the per-class scan path (exact on the segsum backend
+    and on the MXU kernel paths, where each output column's contraction is
+    independent of the operand's column count; CPU-interpret/onehot blocked
+    contractions can differ in final-ulp accumulation order).
+
+    Only the plain feature set is supported (no monotone/interaction/CEGB/
+    forced splits/path smoothing/extra_trees/bynode sampling); the caller
+    falls back to the per-class scan otherwise.
+    """
+    if (params.has_monotone or params.has_interaction or params.has_cegb
+            or params.extra_trees or params.bynode_fraction < 1.0
+            or params.path_smooth > 0.0):
+        raise ValueError("grow_tree_k supports the plain feature set only; "
+                         "use the per-class grow_tree scan path")
+    K, N = grad.shape
+    G = bins.shape[1]
+    L = params.num_leaves
+    S = min(params.max_splits_per_round, max(L - 1, 1))
+    Bmax = layout.valid_mask.shape[1]
+    F = layout.gather_idx.shape[0]
+    f32, i32 = jnp.float32, jnp.int32
+    hdt = jnp.float64 if params.hist_double else jnp.float32
+    kI = jnp.arange(K)
+
+    find_splits = functools.partial(
+        find_best_splits,
+        layout=layout,
+        lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,
+        min_data_in_leaf=max(params.min_data_in_leaf, 1),
+        min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
+        min_gain_to_split=params.min_gain_to_split,
+        cat_l2=params.cat_l2, cat_smooth=params.cat_smooth,
+        max_cat_threshold=params.max_cat_threshold,
+        max_cat_to_onehot=params.max_cat_to_onehot,
+        min_data_per_group=params.min_data_per_group,
+        enable_categorical=params.has_categorical,
+        max_delta_step=params.max_delta_step,
+    )
+
+    def ta(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    # ---- root ----
+    use_stream = params.hist_backend == "stream"
+    bins_packed = None
+    Bpad = -(-Bmax // 8) * 8
+    if use_stream:
+        from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
+                                            route_and_hist,
+                                            stream_block_rows)
+        T_rows = stream_block_rows(Bmax, G, params.int_hist,
+                                   bin_buckets=params.bin_buckets,
+                                   hist_channels=2 * S * K)
+        if packed is None:
+            with jax.named_scope("pack_bins"):
+                bins_T = pack_bins_T(bins, T_rows, max_bins=Bmax).bins_T
+        else:
+            bins_T = packed.bins_T if hasattr(packed, "bins_T") else packed
+        n_pad = bins_T.shape[1]
+        use_int = params.int_hist and gh_scales is not None
+        if use_int:
+            inv = 1.0 / jnp.maximum(gh_scales, 1e-30)        # (K, 2)
+            w_grad = grad * inv[:, 0:1]
+            w_hess = hess * inv[:, 1:2]
+            hscale = gh_scales                               # (K, 2)
+        else:
+            w_grad, w_hess = grad, hess
+        w_rows = 2 * K + 1
+        w_pad_rows = -(-w_rows // 8) * 8
+        w2 = jnp.stack([w_grad, w_hess], axis=1).reshape(2 * K, N)
+        w_T = jnp.zeros((w_pad_rows, n_pad), f32)
+        w_T = w_T.at[:2 * K, :N].set(w2).at[2 * K, :N].set(cnt_w)
+
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.mesh import shard_map_rows
+
+            def _rh(bT, lid, wT, tb, bi, num_slots, with_hist=True):
+                def _local(bT, lid, wT, tb, bi):
+                    nl, h, c = route_and_hist(
+                        bT, lid, wT, tb, bi, num_slots, Bmax, G, L,
+                        block_rows=T_rows, has_cat=params.has_categorical,
+                        two_pass=params.hist_two_pass, int_weights=use_int,
+                        with_hist=with_hist, bin_buckets=params.bin_buckets,
+                        num_class=K)
+                    if with_hist:
+                        h = jax.lax.psum(h, row_axis)
+                    return nl, h, jax.lax.psum(c, row_axis)
+
+                wrapped = shard_map_rows(
+                    _local, mesh,
+                    (P(None, row_axis), P(None, row_axis),
+                     P(None, row_axis), P(None, None), P(None, None)),
+                    (P(None, row_axis), P(None, None, None, None, None),
+                     P(None, None)))
+                return wrapped(bT, lid, wT, tb, bi)
+        else:
+            def _rh(bT, lid, wT, tb, bi, num_slots, with_hist=True):
+                return route_and_hist(
+                    bT, lid, wT, tb, bi, num_slots, Bmax, G, L,
+                    block_rows=T_rows, has_cat=params.has_categorical,
+                    two_pass=params.hist_two_pass, int_weights=use_int,
+                    with_hist=with_hist, bin_buckets=params.bin_buckets,
+                    num_class=K)
+
+        zKL = jnp.zeros(K * L, i32)
+        tabs0 = build_route_tables(zKL, zKL, zKL, zKL, zKL, zKL, zKL,
+                                   zKL.at[kI * L].set(1), routing, K * L)
+        bits0 = jnp.zeros((Bpad, K * L), jnp.bfloat16)
+        leaf_id = jnp.zeros((K, n_pad), i32)
+        _, root_hist, _ = _rh(bins_T, leaf_id, w_T, tabs0, bits0, 1)
+        if use_int:
+            root_hist = root_hist.astype(f32) \
+                * hscale[:, None, None, None, :]
+    else:
+        if params.hist_backend == "pallas":
+            if packed is not None:
+                bins_packed = packed
+            else:
+                from ..pallas.hist_kernel import pack_bins
+                bins_packed = pack_bins(bins)
+        leaf_id = jnp.zeros((K, N), i32)
+        root_hist = build_histograms_k(
+            bins, leaf_id, grad, hess, cnt_w, K, 1, Bmax,
+            backend=params.hist_backend, bins_packed=bins_packed,
+            acc_dtype=hdt)[..., :2]
+    root_g = jnp.sum(grad, axis=1, dtype=hdt)                # (K,)
+    root_h = jnp.sum(hess, axis=1, dtype=hdt)
+    root_c = jnp.broadcast_to(jnp.sum(cnt_w, dtype=hdt), (K,))
+    cm_root = jnp.broadcast_to(col_mask[None, :], (K, F))
+    root_split = find_splits(root_hist.reshape(K, G, Bmax, 2),
+                             root_g, root_h, root_c, col_mask=cm_root)
+
+    hist = jnp.zeros((K, L, G, Bmax, 2), hdt).at[:, 0].set(
+        root_hist.reshape(K, G, Bmax, 2))
+    state = _GrowStateK(
+        leaf_id=leaf_id,
+        split_feature=jnp.zeros((K, L), i32),
+        threshold_bin=jnp.zeros((K, L), i32),
+        dir_flags=jnp.zeros((K, L), i32),
+        left_child=jnp.zeros((K, L), i32),
+        right_child=jnp.zeros((K, L), i32),
+        split_gain=jnp.zeros((K, L), f32),
+        internal_value=jnp.zeros((K, L), f32),
+        internal_weight=jnp.zeros((K, L), f32),
+        internal_count=jnp.zeros((K, L), f32),
+        cat_bitset=jnp.zeros((K, L, Bmax), bool),
+        sum_g=jnp.zeros((K, L), hdt).at[:, 0].set(root_g),
+        sum_h=jnp.zeros((K, L), hdt).at[:, 0].set(root_h),
+        cnt=jnp.zeros((K, L), hdt).at[:, 0].set(root_c),
+        depth=jnp.zeros((K, L), i32),
+        leaf_parent=jnp.full((K, L), -1, i32),
+        best_gain=jnp.full((K, L), NEG_INF, hdt).at[:, 0].set(
+            root_split.gain),
+        best_feat=jnp.zeros((K, L), i32).at[:, 0].set(root_split.feature),
+        best_thr=jnp.zeros((K, L), i32).at[:, 0].set(root_split.threshold),
+        best_dir=jnp.zeros((K, L), i32).at[:, 0].set(root_split.dir_flags),
+        best_left_g=jnp.zeros((K, L), hdt).at[:, 0].set(
+            root_split.left_sum_g),
+        best_left_h=jnp.zeros((K, L), hdt).at[:, 0].set(
+            root_split.left_sum_h),
+        best_left_c=jnp.zeros((K, L), hdt).at[:, 0].set(
+            root_split.left_count),
+        hist=hist,
+        num_leaves_cur=jnp.ones(K, i32),
+        progressed=jnp.ones(K, bool),
+    )
+
+    def cond_k(st: _GrowStateK):
+        return jnp.any(st.progressed & (st.num_leaves_cur < L))
+
+    sprint = (use_stream and S >= 64 and params.max_depth <= 0)
+    S_f = min(2 * S, 255, max(L - 1, 1))
+
+    def can_finish(st: _GrowStateK):
+        remaining = L - st.num_leaves_cur
+        splittable = jnp.sum((st.best_gain > 0).astype(i32), axis=1)
+        return (remaining <= S_f) & (remaining <= splittable)
+
+    def make_body_k(S: int, with_hist: bool = True,
+                    freeze_sprint: bool = False):
+        """Lockstep round body. A class whose per-class loop would have
+        exited (no progress, leaf budget reached, or — with freeze_sprint —
+        sprint-ready) takes an exact no-op this round: its split count is
+        forced to 0, every update indexes out of bounds with mode="drop",
+        and its progressed flag is preserved. Frozen sprint-ready classes
+        replay their sprint from untouched state, so per-class results
+        match grow_tree's sequential schedule split for split."""
+        def body(st: _GrowStateK) -> _GrowStateK:
+            cur = st.num_leaves_cur                          # (K,)
+            remaining = L - cur
+            drop = jnp.asarray(2 ** 30, i32)
+            active = st.progressed & (cur < L)
+            if freeze_sprint:
+                active = active & ~can_finish(st)
+
+            # ---- candidate selection: per-class top-S splittable ----
+            depth_ok = (params.max_depth <= 0) | (st.depth < jnp.asarray(
+                params.max_depth if params.max_depth > 0 else 2 ** 30, i32))
+            cand = jnp.where((st.best_gain > 0) & depth_ok, st.best_gain,
+                             NEG_INF)
+            order = jnp.argsort(-cand, axis=1)               # (K, L)
+            k_budget = jnp.minimum(remaining, S)
+            sorted_gain = ta(cand, order)
+            chosen_rank = (jnp.arange(L)[None, :] < k_budget[:, None]) \
+                & (sorted_gain > 0)
+            ksp = jnp.where(active,
+                            jnp.sum(chosen_rank, axis=1, dtype=i32), 0)
+
+            sS = jnp.arange(S, dtype=i32)
+            pair_valid = sS[None, :] < ksp[:, None]          # (K, S)
+            pair_old = jnp.where(pair_valid, order[:, :S].astype(i32), 0)
+            pair_new = jnp.where(pair_valid, cur[:, None] + sS[None, :], 0)
+            pair_node = jnp.where(pair_valid,
+                                  (cur - 1)[:, None] + sS[None, :], 0)
+            node_idx = jnp.where(pair_valid, pair_node, drop)
+            new_idx = jnp.where(pair_valid, pair_new, drop)
+            old_idx = jnp.where(pair_valid, pair_old, drop)
+
+            feat = ta(st.best_feat, pair_old)
+            thr = ta(st.best_thr, pair_old)
+            dirf = ta(st.best_dir, pair_old)
+            gain = ta(st.best_gain, pair_old)
+            pg, ph, pc = (ta(st.sum_g, pair_old), ta(st.sum_h, pair_old),
+                          ta(st.cnt, pair_old))
+            lg, lh, lc = (ta(st.best_left_g, pair_old),
+                          ta(st.best_left_h, pair_old),
+                          ta(st.best_left_c, pair_old))
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+
+            # ---- categorical bitsets (rows are class x slot) ----
+            parent_hist = st.hist[kI[:, None], pair_old]     # (K, S, G, B, 2)
+            if params.has_categorical:
+                hf = gather_feature_histograms(
+                    parent_hist.reshape(K * S, G, Bmax, 2), layout,
+                    pg.reshape(-1), ph.reshape(-1))
+                hf_feat = hf[jnp.arange(K * S), feat.reshape(-1)]
+                bitset = categorical_left_bitset(
+                    hf_feat, thr.reshape(-1), dirf.reshape(-1),
+                    layout.valid_mask[feat.reshape(-1)],
+                    params.cat_smooth, params.min_data_per_group,
+                    (pc / jnp.maximum(ph, EPS_HESS)).reshape(-1)
+                ).reshape(K, S, Bmax)
+            else:
+                bitset = jnp.zeros((K, S, Bmax), bool)
+
+            # ---- node array updates ----
+            out = leaf_output(pg, ph, params.lambda_l1, params.lambda_l2,
+                              params.max_delta_step)
+            k2 = kI[:, None]
+            st2 = st._replace(
+                split_feature=st.split_feature.at[k2, node_idx].set(
+                    feat, mode="drop"),
+                threshold_bin=st.threshold_bin.at[k2, node_idx].set(
+                    thr, mode="drop"),
+                dir_flags=st.dir_flags.at[k2, node_idx].set(
+                    dirf, mode="drop"),
+                split_gain=st.split_gain.at[k2, node_idx].set(
+                    gain.astype(f32), mode="drop"),
+                internal_value=st.internal_value.at[k2, node_idx].set(
+                    out.astype(f32), mode="drop"),
+                internal_weight=st.internal_weight.at[k2, node_idx].set(
+                    ph.astype(f32), mode="drop"),
+                internal_count=st.internal_count.at[k2, node_idx].set(
+                    pc.astype(f32), mode="drop"),
+                cat_bitset=st.cat_bitset.at[k2, node_idx].set(
+                    bitset, mode="drop"),
+                left_child=st.left_child.at[k2, node_idx].set(
+                    ~pair_old, mode="drop"),
+                right_child=st.right_child.at[k2, node_idx].set(
+                    ~pair_new, mode="drop"),
+            )
+            parent_of_old = ta(st.leaf_parent, pair_old)
+            was_left = (ta(st2.left_child,
+                           jnp.where(parent_of_old >= 0, parent_of_old, 0))
+                        == ~pair_old) & (parent_of_old >= 0)
+            lp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & was_left,
+                               parent_of_old, drop)
+            rp_idx = jnp.where(pair_valid & (parent_of_old >= 0) & ~was_left,
+                               parent_of_old, drop)
+            st2 = st2._replace(
+                left_child=st2.left_child.at[k2, lp_idx].set(
+                    pair_node, mode="drop"),
+                right_child=st2.right_child.at[k2, rp_idx].set(
+                    pair_node, mode="drop"),
+                leaf_parent=(st2.leaf_parent
+                             .at[k2, old_idx].set(pair_node, mode="drop")
+                             .at[k2, new_idx].set(pair_node, mode="drop")),
+            )
+
+            # ---- route rows of chosen leaves (all classes at once) ----
+            leaf_chosen = jnp.zeros((K, L), bool).at[k2, old_idx].set(
+                pair_valid, mode="drop")
+            leaf_new_id = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                pair_new, mode="drop")
+            leaf_feat = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                feat, mode="drop")
+            leaf_thr = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                thr, mode="drop")
+            leaf_dir = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                dirf, mode="drop")
+            smaller_is_left = lc <= rc
+
+            if use_stream:
+                si1 = jnp.broadcast_to(sS[None, :] + 1, (K, S))
+                sl1 = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                    jnp.where(smaller_is_left, si1, 0), mode="drop")
+                sr1 = jnp.zeros((K, L), i32).at[k2, old_idx].set(
+                    jnp.where(smaller_is_left, 0, si1), mode="drop")
+                bits_l = jnp.zeros((K, L, Bpad), jnp.bfloat16).at[
+                    k2, old_idx].set(
+                    jnp.pad(bitset, ((0, 0), (0, 0), (0, Bpad - Bmax))
+                            ).astype(jnp.bfloat16), mode="drop")
+                tabs = build_route_tables(
+                    leaf_chosen.reshape(-1).astype(i32),
+                    leaf_feat.reshape(-1), leaf_thr.reshape(-1),
+                    leaf_dir.reshape(-1), leaf_new_id.reshape(-1),
+                    sl1.reshape(-1), sr1.reshape(-1),
+                    jnp.zeros(K * L, i32), routing, K * L)
+                with jax.named_scope("route_and_hist_k"):
+                    new_leaf_id, hist_small, slot_cnt = _rh(
+                        bins_T, st.leaf_id, w_T, tabs,
+                        bits_l.reshape(K * L, Bpad).T, S,
+                        with_hist=with_hist)
+                if use_int and with_hist:
+                    hist_small = hist_small.astype(f32) \
+                        * hscale[:, None, None, None, :]
+            else:
+                leaf_bits = jnp.zeros((K, L, Bmax), bool).at[
+                    k2, old_idx].set(bitset, mode="drop")
+                lid = st.leaf_id                             # (K, N)
+                r_chosen = ta(leaf_chosen, lid)
+                r_feat = ta(leaf_feat, lid)
+                r_grp = routing.feat_group[r_feat]           # (K, N)
+                gb = jnp.take_along_axis(
+                    bins, r_grp.T.astype(jnp.int32), axis=1).T
+                fb = feature_local_bin(gb, r_feat, routing)
+                r_thr = ta(leaf_thr, lid)
+                r_dir = ta(leaf_dir, lid)
+                is_cat = (r_dir & 2) != 0
+                default_left = (r_dir & 1) != 0
+                is_nan = (routing.nan_bin[r_feat] >= 0) \
+                    & (fb == routing.nan_bin[r_feat])
+                mzb_r = (routing.mzero_bin[r_feat]
+                         if routing.mzero_bin is not None
+                         else jnp.full_like(r_feat, -1))
+                is_miss = is_nan | ((mzb_r >= 0) & (fb == mzb_r))
+                go_left_num = jnp.where(is_miss, default_left, fb <= r_thr)
+                go_left_cat = leaf_bits.reshape(-1)[
+                    (k2 * L + lid) * Bmax + fb]
+                go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+                new_leaf_id = jnp.where(r_chosen & ~go_left,
+                                        ta(leaf_new_id, lid), lid)
+
+            # ---- histograms for the smaller children + EXACT counts ----
+            smaller_id_pre = jnp.where(smaller_is_left, pair_old, pair_new)
+            if not use_stream:
+                slot_map = jnp.full((K, L), -1, i32).at[
+                    k2, jnp.where(pair_valid, smaller_id_pre, drop)].set(
+                    jnp.broadcast_to(sS[None, :], (K, S)), mode="drop")
+                slot = ta(slot_map, new_leaf_id)             # (K, N)
+                hist3 = build_histograms_k(
+                    bins, slot, grad, hess, cnt_w, K, S, Bmax,
+                    backend=params.hist_backend, bins_packed=bins_packed,
+                    acc_dtype=hdt)
+                hist_small = hist3[..., :2]
+                slot_cnt = hist3[:, :, 0, :, 2].sum(axis=-1)
+            lc_x = jnp.where(smaller_is_left, slot_cnt, pc - slot_cnt)
+            rc_x = pc - lc_x
+
+            # ---- per-leaf stats for the children ----
+            st2 = st2._replace(
+                leaf_id=new_leaf_id,
+                sum_g=st2.sum_g.at[k2, old_idx].set(lg, mode="drop")
+                               .at[k2, new_idx].set(rg, mode="drop"),
+                sum_h=st2.sum_h.at[k2, old_idx].set(lh, mode="drop")
+                               .at[k2, new_idx].set(rh, mode="drop"),
+                cnt=st2.cnt.at[k2, old_idx].set(lc_x, mode="drop")
+                           .at[k2, new_idx].set(rc_x, mode="drop"),
+                depth=st2.depth.at[k2, new_idx].set(
+                    ta(st.depth, pair_old) + 1, mode="drop")
+                               .at[k2, old_idx].set(
+                    ta(st.depth, pair_old) + 1, mode="drop"),
+            )
+
+            if not with_hist:
+                # sprint round: the trees are complete after these splits
+                return st2._replace(
+                    num_leaves_cur=cur + ksp,
+                    progressed=jnp.where(active, ksp > 0, st.progressed))
+
+            # ---- histogram subtraction for the larger siblings ----
+            larger_id = jnp.where(smaller_is_left, pair_new, pair_old)
+            hist_large = parent_hist - hist_small
+            sm_idx = jnp.where(pair_valid, smaller_id_pre, drop)
+            lg_idx = jnp.where(pair_valid, larger_id, drop)
+            new_hist = (st2.hist
+                        .at[k2, sm_idx].set(hist_small, mode="drop")
+                        .at[k2, lg_idx].set(hist_large, mode="drop"))
+            st2 = st2._replace(hist=new_hist)
+
+            # ---- best splits for the 2S children of every class ----
+            ids2 = jnp.concatenate([pair_old, pair_new], axis=1)  # (K, 2S)
+            valid2 = jnp.concatenate([pair_valid, pair_valid], axis=1)
+            hist2 = new_hist[k2, ids2]
+            cm2 = jnp.broadcast_to(col_mask[None, :], (K * 2 * S, F))
+            with jax.named_scope("find_splits_k"):
+                res = find_splits(hist2.reshape(K * 2 * S, G, Bmax, 2),
+                                  ta(st2.sum_g, ids2).reshape(-1),
+                                  ta(st2.sum_h, ids2).reshape(-1),
+                                  ta(st2.cnt, ids2).reshape(-1),
+                                  col_mask=cm2)
+            ids2_m = jnp.where(valid2, ids2, drop)
+
+            def rs(a):
+                return a.reshape(K, 2 * S)
+            st2 = st2._replace(
+                best_gain=st2.best_gain.at[k2, ids2_m].set(
+                    rs(res.gain), mode="drop"),
+                best_feat=st2.best_feat.at[k2, ids2_m].set(
+                    rs(res.feature), mode="drop"),
+                best_thr=st2.best_thr.at[k2, ids2_m].set(
+                    rs(res.threshold), mode="drop"),
+                best_dir=st2.best_dir.at[k2, ids2_m].set(
+                    rs(res.dir_flags), mode="drop"),
+                best_left_g=st2.best_left_g.at[k2, ids2_m].set(
+                    rs(res.left_sum_g), mode="drop"),
+                best_left_h=st2.best_left_h.at[k2, ids2_m].set(
+                    rs(res.left_sum_h), mode="drop"),
+                best_left_c=st2.best_left_c.at[k2, ids2_m].set(
+                    rs(res.left_count), mode="drop"),
+            )
+            return st2._replace(
+                num_leaves_cur=cur + ksp,
+                progressed=jnp.where(active, ksp > 0, st.progressed))
+        return body
+
+    # streaming rounds: same specialized small-S prefix as grow_tree
+    if use_stream and S > 64:
+        b64 = make_body_k(64)
+        for _ in range(7):
+            state = jax.lax.cond(cond_k(state), b64, lambda s: s, state)
+
+    if sprint:
+        # full rounds while ANY class still needs one; sprint-ready classes
+        # FREEZE (exact no-op) so their final route-only sprint replays from
+        # the same state the per-class schedule would have sprinted from
+        def cond_sprint_k(st: _GrowStateK):
+            return jnp.any(st.progressed & (L - st.num_leaves_cur > 0)
+                           & ~can_finish(st))
+        state = jax.lax.while_loop(
+            cond_sprint_k, make_body_k(S, freeze_sprint=True), state)
+        final = jax.lax.cond(
+            cond_k(state), make_body_k(S_f, with_hist=False),
+            lambda s: s, state)
+    else:
+        final = jax.lax.while_loop(cond_k, make_body_k(S), state)
+
+    leaf_value = leaf_output(final.sum_g, final.sum_h, params.lambda_l1,
+                             params.lambda_l2, params.max_delta_step)
+    leaf_value = jnp.where(final.num_leaves_cur[:, None] > 1,
+                           leaf_value, 0.0)
+    tree = TreeArrays(
+        split_feature=final.split_feature, threshold_bin=final.threshold_bin,
+        dir_flags=final.dir_flags, left_child=final.left_child,
+        right_child=final.right_child, split_gain=final.split_gain,
+        internal_value=final.internal_value,
+        internal_weight=final.internal_weight,
+        internal_count=final.internal_count, cat_bitset=final.cat_bitset,
+        leaf_value=leaf_value.astype(f32),
+        leaf_weight=final.sum_h.astype(f32),
+        leaf_count=final.cnt.astype(f32),
+        leaf_parent=final.leaf_parent, num_leaves=final.num_leaves_cur,
+        leaf_depth=final.depth,
+    )
+    return tree, final.leaf_id[:, :N]

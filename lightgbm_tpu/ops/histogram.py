@@ -129,6 +129,111 @@ def _hist_onehot(bins, slot, grad, hess, cnt, num_slots, max_group_bins, block_r
     return jnp.transpose(hist, (2, 0, 1, 3))
 
 
+def build_histograms_k(bins: jax.Array, slot: jax.Array, grad: jax.Array,
+                       hess: jax.Array, cnt: jax.Array, num_class: int,
+                       num_slots: int, max_group_bins: int,
+                       backend: str = "auto", block_rows: int = 16384,
+                       dtype=jnp.float32,
+                       bins_packed: Optional[jax.Array] = None,
+                       acc_dtype=jnp.float32) -> jax.Array:
+    """Per-class per-slot histograms for the BATCHED MULTICLASS path.
+
+    slot/grad/hess: (K, N) — class k's histogram slot / gradient per row;
+    cnt: (N,) shared count weight. Returns (K, S, G, Bmax, 3) acc_dtype.
+
+    The onehot and pallas backends amortize the class-independent bin
+    one-hot across the stacked class x slot channel axis — ONE widened
+    contraction serves all K classes' gradient channels (the reference's
+    single histogram pass over all class gradients,
+    cuda_histogram_constructor.cu) — while segsum vmaps the per-class
+    scatter so each class's sums are bit-identical to a standalone call.
+    """
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() in ("tpu", "axon") \
+            else "segsum"
+    if backend == "segsum":
+        return jax.vmap(
+            lambda s, g, h: _hist_segsum(bins, s, g, h, cnt, num_slots,
+                                         max_group_bins, acc_dtype)
+        )(slot, grad, hess)
+    if backend == "onehot":
+        return _hist_onehot_k(bins, slot, grad, hess, cnt, num_class,
+                              num_slots, max_group_bins, block_rows, dtype,
+                              acc_dtype)
+    if backend == "pallas":
+        from ..pallas.hist_kernel import (build_histograms_sorted,
+                                          build_histograms_wide,
+                                          wide_hist_fits)
+        if wide_hist_fits(num_class, num_slots, max_group_bins,
+                          bins.shape[1]):
+            return build_histograms_wide(bins, slot, grad, hess, cnt,
+                                         num_slots, max_group_bins,
+                                         bins_packed=bins_packed)
+        # widened block too large for VMEM: per-class sorted kernels
+        # (scan-equivalent cost, always correct)
+        return jnp.stack([
+            build_histograms_sorted(bins, slot[k], grad[k], hess[k], cnt,
+                                    num_slots, max_group_bins,
+                                    bins_packed=bins_packed)
+            for k in range(num_class)])
+    raise ValueError(f"unknown hist backend {backend!r}")
+
+
+def _hist_onehot_k(bins, slot, grad, hess, cnt, num_class, num_slots,
+                   max_group_bins, block_rows, dtype,
+                   acc_dtype=jnp.float32):
+    """Widened blocked one-hot matmul: per block and group, ONE (Bmax, T)
+    bin one-hot contracted against the stacked (T, K*S*3) class x slot
+    weight operand — all K classes' histograms from a single pass over the
+    bin matrix (vs K passes each rebuilding the one-hot)."""
+    n, num_groups = bins.shape
+    K, S = num_class, num_slots
+    # W carries K*S*3 channels; shrink blocks so its footprint stays put
+    block_rows = max(256, block_rows // max(K, 1))
+    nb = -(-n // block_rows)
+    pad = nb * block_rows - n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        slot = jnp.pad(slot, ((0, 0), (0, pad)), constant_values=-1)
+        grad = jnp.pad(grad, ((0, 0), (0, pad)))
+        hess = jnp.pad(hess, ((0, 0), (0, pad)))
+        cnt = jnp.pad(cnt, (0, pad))
+
+    valid = slot >= 0
+    s = jnp.where(valid, slot, 0)
+    w3 = jnp.stack([grad.astype(dtype), hess.astype(dtype),
+                    jnp.broadcast_to(cnt, grad.shape).astype(dtype)],
+                   axis=2)                                   # (K, N, 3)
+    bins_b = bins.reshape(nb, block_rows, num_groups)
+    s_b = s.reshape(K, nb, block_rows).transpose(1, 0, 2)    # (nb, K, T)
+    v_b = valid.reshape(K, nb, block_rows).transpose(1, 0, 2)
+    w_b = w3.reshape(K, nb, block_rows, 3).transpose(1, 0, 2, 3)
+
+    def block_body(carry, xs):
+        b_blk, s_blk, v_blk, w_blk = xs
+        slot_oh = jax.nn.one_hot(s_blk, S, dtype=dtype) \
+            * v_blk[..., None].astype(dtype)                 # (K, T, S)
+        W = (slot_oh[..., :, None] * w_blk[..., None, :])    # (K, T, S, 3)
+        W = W.transpose(1, 0, 2, 3).reshape(block_rows, K * S * 3)
+
+        def group_body(g, acc):
+            col = jax.lax.dynamic_index_in_dim(b_blk, g, axis=1,
+                                               keepdims=False)
+            oh = jax.nn.one_hot(col.astype(jnp.int32), max_group_bins,
+                                dtype=dtype, axis=0)         # (Bmax, T)
+            h = jax.lax.dot(oh, W,
+                            preferred_element_type=acc_dtype)
+            return acc.at[g].add(h)
+        return jax.lax.fori_loop(0, num_groups, group_body, carry), None
+
+    init = jnp.zeros((num_groups, max_group_bins, K * S * 3), acc_dtype)
+    hist, _ = jax.lax.scan(block_body, init, (bins_b, s_b, v_b, w_b))
+    hist = hist.reshape(num_groups, max_group_bins, K, S, NUM_CHANNELS)
+    return jnp.transpose(hist, (2, 3, 0, 1, 4))              # (K, S, G, B, 3)
+
+
 def hist_subtract(parent: jax.Array, child: jax.Array) -> jax.Array:
-    """Histogram subtraction trick (reference: serial_tree_learner.cpp:481 use_subtract)."""
+    """Histogram subtraction trick (reference: serial_tree_learner.cpp:481
+    use_subtract). Shape-agnostic: works on (S, G, Bmax, C) and on the
+    batched multiclass (K, S, G, Bmax, C) channel layout alike."""
     return parent - child

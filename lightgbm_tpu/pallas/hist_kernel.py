@@ -218,6 +218,142 @@ def _hist_nibble(bins_T, w_T, scalars, counts, num_slots, bmax, num_groups,
     return jnp.where(counts[:, None, None, None] > 0, hist, 0.0)
 
 
+def _wide_kernel(bins_ref, slot_ref, w_ref, out_ref, *, T: int, G: int,
+                 B: int, S: int, K: int, f32_dots: bool):
+    """K-channel natural-order accumulate path (batched multiclass): rows
+    stream through in natural order, the class-independent bin one-hot is
+    built ONCE per block, and the contraction runs against the stacked
+    (3*S*K, T) class x slot weight operand. The sorted direct/nibble
+    kernels cannot serve this case — each row belongs to K DIFFERENT slots
+    (one per class tree), so no single sort order exists."""
+    b = pl.program_id(0)
+    i32, f32 = jnp.int32, jnp.float32
+    bf16 = f32 if f32_dots else jnp.bfloat16
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # unpack the 4-per-word packed group bins -> (G, T)
+    rows = []
+    for g in range(G):  # static unroll
+        word_g = bins_ref[g // 4:g // 4 + 1, :]
+        rows.append(jax.lax.shift_right_logical(word_g, (g % 4) * 8) & 0xFF)
+    bins_G = jnp.concatenate(rows, axis=0)
+    # B-major one-hot rows r = b * G + g via the key/iota compare (the
+    # stream kernel's measured-fastest construct)
+    g_iota = jax.lax.broadcasted_iota(i32, (G, T), 0)
+    key = bins_G * G + g_iota
+    key_t = jnp.concatenate([key] * B, axis=0)               # (B*G, T)
+    r_iota = jax.lax.broadcasted_iota(i32, (B * G, T), 0)
+    oh = (key_t == r_iota).astype(bf16)
+
+    s_iota = jax.lax.broadcasted_iota(i32, (S, T), 0)
+    sohs = [(s_iota == slot_ref[k:k + 1, :]).astype(bf16)
+            for k in range(K)]                               # (S, T) each
+    w_hi, w_lo = _wsplit(w_ref[...])                         # (Wpad, T)
+
+    def build_A(w):
+        # class-major rows j = k*3S + c*S + s; c in (grad, hess, cnt);
+        # cnt is the shared row 2K
+        return jnp.concatenate(
+            [w[r:r + 1, :] * sohs[k]
+             for k in range(K)
+             for r in (2 * k, 2 * k + 1, 2 * K)], axis=0)    # (3*S*K, T)
+
+    dot = functools.partial(jax.lax.dot_general,
+                            dimension_numbers=(((1,), (1,)), ((), ())),
+                            preferred_element_type=f32)
+    out_ref[...] += dot(oh, build_A(w_hi)) + dot(oh, build_A(w_lo))
+
+
+def wide_block_rows(bmax: int, num_groups: int, num_class: int,
+                    num_slots: int) -> int:
+    """Block size for the wide K-channel kernel: the (G*B, T) bf16 one-hot
+    plus the T-independent (G*B, 3*S*K) f32 VMEM-resident histogram block
+    must share the ~16 MB/core budget."""
+    B = -(-bmax // 8) * 8
+    m_rows = num_groups * B
+    budget = 12 * 2 ** 20 - m_rows * 3 * num_slots * num_class * 4
+    for T in (2048, 1024, 512, 256):
+        if m_rows * T * 2 <= budget:
+            return T
+    return 256
+
+
+def wide_hist_fits(num_class: int, num_slots: int, bmax: int,
+                   num_groups: int) -> bool:
+    """True when the widened (G*B, 3*S*K) block leaves room for a useful
+    one-hot block; otherwise callers fall back to per-class sorted
+    kernels."""
+    B = -(-bmax // 8) * 8
+    if bmax > 128:
+        return False   # the key construct is sized for the direct regime
+    hist_bytes = num_groups * B * 3 * num_slots * num_class * 4
+    return hist_bytes + num_groups * B * 256 * 2 <= 12 * 2 ** 20
+
+
+@functools.partial(watched_jit, name="pallas_hist_wide", warn_after=0,
+                   static_argnames=("num_slots", "bmax", "num_groups",
+                                    "num_class", "block_rows"))
+def _hist_wide(bins_T, slot, w_T, num_slots, bmax, num_groups, num_class,
+               block_rows):
+    GW, n_pad = bins_T.shape
+    K, S, T, G = num_class, num_slots, block_rows, num_groups
+    B = -(-bmax // 8) * 8
+    NB = n_pad // T
+    out = pl.pallas_call(
+        functools.partial(_wide_kernel, T=T, G=G, B=B, S=S, K=K,
+                          f32_dots=_INTERPRET
+                          or jax.default_backend() not in ("tpu", "axon")),
+        grid=(NB,),
+        in_specs=[
+            pl.BlockSpec((GW, T), lambda b: (0, b)),
+            pl.BlockSpec((K, T), lambda b: (0, b)),
+            pl.BlockSpec((w_T.shape[0], T), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((B * G, 3 * S * K), lambda b: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * G, 3 * S * K), jnp.float32),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET or jax.default_backend() not in ("tpu", "axon"),
+    )(bins_T, slot, w_T)
+    # (B*G, 3SK) b-major rows -> (K, S, G, Bmax, 3)
+    hist = out.reshape(B, G, K, 3, S).transpose(2, 4, 1, 0, 3)
+    return hist[:, :, :, :bmax, :]
+
+
+def build_histograms_wide(bins: jax.Array, slot: jax.Array, grad: jax.Array,
+                          hess: jax.Array, cnt: jax.Array, num_slots: int,
+                          max_group_bins: int,
+                          bins_packed: jax.Array = None) -> jax.Array:
+    """K-class histograms from ONE widened kernel pass (batched multiclass).
+
+    slot/grad/hess: (K, N) per-class; cnt: (N,) shared.
+    Returns (K, S, G, Bmax, 3) float32.
+    """
+    K, n = slot.shape
+    G = bins.shape[1]
+    if bins_packed is None:
+        bins_packed = pack_bins(bins)
+    gw = bins_packed.shape[1]
+    gw_pad = -(-gw // 8) * 8
+    T = wide_block_rows(max_group_bins, G, K, num_slots)
+    n_pad = -(-n // T) * T
+    bins_T = jnp.pad(bins_packed.T.astype(jnp.int32),
+                     ((0, gw_pad - gw), (0, n_pad - n)))
+    slot_p = jnp.pad(slot.astype(jnp.int32), ((0, 0), (0, n_pad - n)),
+                     constant_values=-1)
+    w_rows = 2 * K + 1
+    w_pad = -(-w_rows // 8) * 8
+    w2 = jnp.stack([grad, hess], axis=1).reshape(2 * K, n)   # 2k/2k+1 rows
+    w_T = jnp.concatenate([w2.astype(jnp.float32),
+                           cnt.reshape(1, n).astype(jnp.float32),
+                           jnp.zeros((w_pad - w_rows, n), jnp.float32)],
+                          axis=0)
+    w_T = jnp.pad(w_T, ((0, 0), (0, n_pad - n)))
+    return _hist_wide(bins_T, slot_p, w_T, num_slots, max_group_bins, G, K, T)
+
+
 def build_histograms_sorted(bins: jax.Array, slot: jax.Array, grad: jax.Array,
                             hess: jax.Array, cnt: jax.Array, num_slots: int,
                             max_group_bins: int, block_rows: int = 1024,

@@ -95,12 +95,12 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        has_cat: bool, two_pass: bool = True,
                        int_weights: bool = False, f32_dots: bool = False,
                        u8_layout: bool = False, with_hist: bool = True,
-                       bin_buckets=None, m_rows: int = 0):
+                       bin_buckets=None, m_rows: int = 0, K: int = 1):
     if with_hist:
         hist_ref, cnt_ref = outs
     else:
         # route-only variant: no histogram output ref exists at all, so the
-        # (G*B, 2S) VMEM-resident block is never allocated
+        # (G*B, 2*S*K) VMEM-resident block is never allocated
         hist_ref, (cnt_ref,) = None, outs
     b = pl.program_id(0)
     i32, f32 = jnp.int32, jnp.float32
@@ -109,84 +109,95 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # contraction results match the TPU MXU's bf16 x bf16 -> f32 exactly
     bf16 = f32 if f32_dots else jnp.bfloat16
 
-    # ---------------- route ----------------
-    lid = leaf_ref[0:1, :]                                   # (1, T) i32
+    # ---------------- route (per class; the bin one-hot below is shared) ---
+    # K > 1 is the BATCHED MULTICLASS path: K class trees grow in lockstep,
+    # so the kernel routes each row through K per-class split tables and
+    # accumulates one widened (m_rows, 2*S*K) histogram block — the
+    # class-independent bin one-hot is built ONCE and contracted against
+    # the class x slot channel axis (vs K separate kernel launches each
+    # rebuilding the one-hot).
     l_iota = jax.lax.broadcasted_iota(i32, (L, T), 0)
-    leaf_oh = (l_iota == lid).astype(bf16)                   # (L, T)
-    vals = jax.lax.dot_general(
-        tabs_ref[...], leaf_oh, (((1,), (0,)), ((), ())),
-        preferred_element_type=f32)                          # (NUM_TAB, T)
-    # flags stay i32 (0/1) throughout — Mosaic cannot handle i1 vectors as
-    # select OPERANDS (i8<->i1 truncation); predicates are fresh comparisons
-    iv = vals.astype(i32)
-    chosen_i = iv[T_CHOSEN:T_CHOSEN + 1, :]
-    newid = iv[T_NEWID_LO:T_NEWID_LO + 1, :] + (iv[T_NEWID_HI:T_NEWID_HI + 1, :] << 7)
-    wordi = iv[T_WORD_LO:T_WORD_LO + 1, :] + (iv[T_WORD_HI:T_WORD_HI + 1, :] << 7)
-    shift = iv[T_SHIFT:T_SHIFT + 1, :]
-    span = iv[T_SPAN:T_SPAN + 1, :]
-    defbin = iv[T_DEFBIN:T_DEFBIN + 1, :]
-    bundled_i = iv[T_BUNDLED:T_BUNDLED + 1, :]
-    has_nan_i = iv[T_HASNAN:T_HASNAN + 1, :]
-    nanbin = iv[T_NANBIN:T_NANBIN + 1, :]
-    nbins = iv[T_NBINS:T_NBINS + 1, :]
-    thr = iv[T_THR:T_THR + 1, :]
-    defleft_i = iv[T_DEFLEFT:T_DEFLEFT + 1, :]
-    is_cat_i = iv[T_ISCAT:T_ISCAT + 1, :]
-    slot_l1 = iv[T_SLOT_L:T_SLOT_L + 1, :]
-    slot_r1 = iv[T_SLOT_R:T_SLOT_R + 1, :]
-    slot_k1 = iv[T_SLOT_KEEP:T_SLOT_KEEP + 1, :]
+    bins32 = bins_ref[...].astype(i32) if u8_layout else None  # (G_pad, T)
+    slots = []                                               # per-class (1,T)
+    for k in range(K):  # static unroll
+        lid = leaf_ref[k:k + 1, :]                           # (1, T) i32
+        leaf_oh = (l_iota == lid).astype(bf16)               # (L, T)
+        vals = jax.lax.dot_general(
+            tabs_ref[:, k * L:(k + 1) * L], leaf_oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                      # (NUM_TAB, T)
+        # flags stay i32 (0/1) throughout — Mosaic cannot handle i1 vectors
+        # as select OPERANDS (i8<->i1 truncation); predicates are fresh
+        # comparisons
+        iv = vals.astype(i32)
+        chosen_i = iv[T_CHOSEN:T_CHOSEN + 1, :]
+        newid = iv[T_NEWID_LO:T_NEWID_LO + 1, :] + (iv[T_NEWID_HI:T_NEWID_HI + 1, :] << 7)
+        wordi = iv[T_WORD_LO:T_WORD_LO + 1, :] + (iv[T_WORD_HI:T_WORD_HI + 1, :] << 7)
+        shift = iv[T_SHIFT:T_SHIFT + 1, :]
+        span = iv[T_SPAN:T_SPAN + 1, :]
+        defbin = iv[T_DEFBIN:T_DEFBIN + 1, :]
+        bundled_i = iv[T_BUNDLED:T_BUNDLED + 1, :]
+        has_nan_i = iv[T_HASNAN:T_HASNAN + 1, :]
+        nanbin = iv[T_NANBIN:T_NANBIN + 1, :]
+        nbins = iv[T_NBINS:T_NBINS + 1, :]
+        thr = iv[T_THR:T_THR + 1, :]
+        defleft_i = iv[T_DEFLEFT:T_DEFLEFT + 1, :]
+        is_cat_i = iv[T_ISCAT:T_ISCAT + 1, :]
+        slot_l1 = iv[T_SLOT_L:T_SLOT_L + 1, :]
+        slot_r1 = iv[T_SLOT_R:T_SLOT_R + 1, :]
+        slot_k1 = iv[T_SLOT_KEEP:T_SLOT_KEEP + 1, :]
 
-    # select the split feature's group-local bin for every row
-    if u8_layout:
-        # unpacked (G_pad, T) int8 storage: same HBM bytes as the packed
-        # 4-per-word form (28 B/row either way at G=28) but no per-group
-        # shift/mask unpack work in the kernel
-        bins32 = bins_ref[...].astype(i32)                   # (G_pad, T)
-        grpi = wordi * 4 + jax.lax.shift_right_logical(shift, 3)
-        gp_iota = jax.lax.broadcasted_iota(i32, bins32.shape, 0)
-        gb = jnp.sum(jnp.where(gp_iota == grpi, bins32, 0), axis=0,
-                     keepdims=True)                          # (1, T)
-    else:
-        # packed: select the word of the split feature's group, then its byte
-        words = bins_ref[...]                                # (GW, T) i32
-        gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
-        word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
-                       keepdims=True)                        # (1, T)
-        gb = jax.lax.shift_right_logical(word, shift) & 0xFF  # group-local bin
+        # select the split feature's group-local bin for every row
+        if u8_layout:
+            # unpacked (G_pad, T) int8 storage: same HBM bytes as the packed
+            # 4-per-word form (28 B/row either way at G=28) but no per-group
+            # shift/mask unpack work in the kernel
+            grpi = wordi * 4 + jax.lax.shift_right_logical(shift, 3)
+            gp_iota = jax.lax.broadcasted_iota(i32, bins32.shape, 0)
+            gb = jnp.sum(jnp.where(gp_iota == grpi, bins32, 0), axis=0,
+                         keepdims=True)                      # (1, T)
+        else:
+            # packed: select the split feature's group word, then its byte
+            words = bins_ref[...]                            # (GW, T) i32
+            gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
+            word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
+                           keepdims=True)                    # (1, T)
+            gb = jax.lax.shift_right_logical(word, shift) & 0xFF
 
-    # feature-local bin for EFB bundles (ops/grow.py feature_local_bin)
-    ls = gb - span
-    ge_def = jnp.where(ls >= defbin, 1, 0)
-    fb_b = jnp.where((ls >= 0) & (ls < nbins - 1), ls + ge_def, defbin)
-    fb = jnp.where(bundled_i > 0, fb_b, gb)
+        # feature-local bin for EFB bundles (ops/grow.py feature_local_bin)
+        ls = gb - span
+        ge_def = jnp.where(ls >= defbin, 1, 0)
+        fb_b = jnp.where((ls >= 0) & (ls < nbins - 1), ls + ge_def, defbin)
+        fb = jnp.where(bundled_i > 0, fb_b, gb)
 
-    has_mz_i = iv[T_HASMZ:T_HASMZ + 1, :]
-    mzbin = iv[T_MZBIN:T_MZBIN + 1, :]
-    is_nan_i = has_nan_i * jnp.where(fb == nanbin, 1, 0)
-    is_mz_i = has_mz_i * jnp.where(fb == mzbin, 1, 0)
-    le_thr = jnp.where(fb <= thr, 1, 0)
-    go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
-    if has_cat:
-        # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, then pick fb
-        br = jax.lax.dot_general(bits_ref[...].astype(bf16), leaf_oh,
-                                 (((1,), (0,)), ((), ())),
-                                 preferred_element_type=f32)  # (B, T)
-        b_iota_c = jax.lax.broadcasted_iota(i32, (B, T), 0)
-        cat_bit = jnp.sum(jnp.where(b_iota_c == fb, br, 0.0), axis=0,
-                          keepdims=True)
-        go_left_cat = jnp.where(cat_bit > 0.5, 1, 0)
-        go_left_i = jnp.where(is_cat_i > 0, go_left_cat, go_left_i)
+        has_mz_i = iv[T_HASMZ:T_HASMZ + 1, :]
+        mzbin = iv[T_MZBIN:T_MZBIN + 1, :]
+        is_nan_i = has_nan_i * jnp.where(fb == nanbin, 1, 0)
+        is_mz_i = has_mz_i * jnp.where(fb == mzbin, 1, 0)
+        le_thr = jnp.where(fb <= thr, 1, 0)
+        go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
+        if has_cat:
+            # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, pick fb
+            br = jax.lax.dot_general(
+                bits_ref[:, k * L:(k + 1) * L].astype(bf16), leaf_oh,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=f32)                  # (B, T)
+            b_iota_c = jax.lax.broadcasted_iota(i32, (B, T), 0)
+            cat_bit = jnp.sum(jnp.where(b_iota_c == fb, br, 0.0), axis=0,
+                              keepdims=True)
+            go_left_cat = jnp.where(cat_bit > 0.5, 1, 0)
+            go_left_i = jnp.where(is_cat_i > 0, go_left_cat, go_left_i)
 
-    new_lid = jnp.where(chosen_i * (1 - go_left_i) > 0, newid, lid)  # (1, T)
-    slot1 = jnp.where(chosen_i > 0,
-                      jnp.where(go_left_i > 0, slot_l1, slot_r1), slot_k1)
-    if _ABLATE == "dblroute":    # perf probe: one extra route gather
-        leaf_oh2 = (l_iota == lid + L).astype(bf16)
-        vals2 = jax.lax.dot_general(
-            tabs_ref[...], leaf_oh2, (((1,), (0,)), ((), ())),
-            preferred_element_type=f32)
-        new_lid = new_lid + vals2[0:1, :].astype(i32)
-    newleaf_ref[0:1, :] = new_lid
+        new_lid = jnp.where(chosen_i * (1 - go_left_i) > 0, newid, lid)
+        slot1 = jnp.where(chosen_i > 0,
+                          jnp.where(go_left_i > 0, slot_l1, slot_r1), slot_k1)
+        if _ABLATE == "dblroute":    # perf probe: one extra route gather
+            leaf_oh2 = (l_iota == lid + L).astype(bf16)
+            vals2 = jax.lax.dot_general(
+                tabs_ref[:, k * L:(k + 1) * L], leaf_oh2,
+                (((1,), (0,)), ((), ())), preferred_element_type=f32)
+            new_lid = new_lid + vals2[0:1, :].astype(i32)
+        newleaf_ref[k:k + 1, :] = new_lid
+        slots.append(slot1 - 1)
 
     # ---------------- histogram ----------------
     @pl.when(b == 0)
@@ -195,13 +206,15 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
             hist_ref[...] = jnp.zeros_like(hist_ref)
         cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
-    slot = slot1 - 1
     s_iota = jax.lax.broadcasted_iota(i32, (S, T), 0)
-    slot_oh = (s_iota == slot).astype(bf16)                  # (S, T)
-    # EXACT per-slot data counts (one tiny (1,T)x(T,S) dot) — needed by every
-    # variant including route-only rounds: they become the model's leaf_count
-    # values (reference: DataPartition::leaf_count, serial_tree_learner.cpp:798)
-    cnt_row = w_ref[2:3, :]
+    slot_ohs = [(s_iota == slot).astype(bf16) for slot in slots]  # (S, T) ea
+    slot_oh = (jnp.concatenate(slot_ohs, axis=0) if K > 1
+               else slot_ohs[0])                             # (S*K, T)
+    # EXACT per-slot data counts (one tiny (1,T)x(T,S*K) dot) — needed by
+    # every variant including route-only rounds: they become the model's
+    # leaf_count values (DataPartition::leaf_count,
+    # serial_tree_learner.cpp:798)
+    cnt_row = w_ref[2 * K:2 * K + 1, :]
     cnt_ref[0:1, :] += jax.lax.dot_general(
         cnt_row.astype(bf16), slot_oh, (((1,), (1,)), ((), ())),
         preferred_element_type=f32)
@@ -211,7 +224,7 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         # contraction — and the whole VMEM-resident histogram block — is
         # dropped)
         return
-    w2 = w_ref[0:2, :]                                       # (2, T) f32
+    w2 = w_ref[0:2 * K, :]                                   # (2K, T) f32
     w_hi, w_lo = _wsplit(w2)
 
     # build the bin-match one-hot shared by the int and float contraction
@@ -255,11 +268,16 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         for Bk, Gk in bin_buckets:
             Gk8 = bucket_group_pad(Gk)
             sub = bins_G[goff:goff + Gk, :]                  # (Gk, T)
-            if Gk8 > Gk:
-                sub = jnp.concatenate(
-                    [sub, jnp.full((Gk8 - Gk, T), 1 << 24, i32)], axis=0)
-            gi_k = jax.lax.broadcasted_iota(i32, (Gk8, T), 0)
+            # real keys first, then pad rows pinned to -1 (below every
+            # r_iota value). Padding the BIN value instead (1 << 24) only
+            # worked while (1 << 24) * Gk8 stayed inside int32 — at
+            # Gk8 >= 128 that product wraps and a pad row could alias a
+            # real histogram row.
+            gi_k = jax.lax.broadcasted_iota(i32, (Gk, T), 0)
             key_k = sub * Gk8 + gi_k + roff
+            if Gk8 > Gk:
+                key_k = jnp.concatenate(
+                    [key_k, jnp.full((Gk8 - Gk, T), -1, i32)], axis=0)
             parts.extend([key_k] * Bk)
             goff += Gk
             roff += Bk * Gk8
@@ -276,11 +294,13 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         # runs on the int8 MXU (~25% faster than bf16 at these shapes), and
         # int32 accumulation makes the histogram sums EXACT.
         # build A in i32 (Mosaic cannot legalize i8*i8 multiplies), then
-        # convert the (2S, T) operand to int8 once
-        slot_oh_i = (s_iota == slot).astype(i32)
+        # convert the (2*S*K, T) operand to int8 once; class-major rows
+        # j = k*2S + c*S + s match the caller's unflatten
+        slot_ohs_i = [(s_iota == slot).astype(i32) for slot in slots]
         w_i = jnp.round(w2).astype(i32)                      # int-valued rows
         A_i = jnp.concatenate(
-            [w_i[c:c + 1, :] * slot_oh_i for c in range(2)], axis=0)
+            [w_i[2 * k + c:2 * k + c + 1, :] * slot_ohs_i[k]
+             for k in range(K) for c in range(2)], axis=0)
         if _ABLATE == "nohist":      # int-path probe: no one-hot, no dot
             hist_ref[...] += jnp.sum(A_i, axis=1)[None, :]
             return
@@ -317,10 +337,12 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # came from the hoisted cnt dot above)
     def build_A(w):
         # (1, T) x (S, T) broadcast-multiplies + sublane concat; the 3-D
-        # broadcast form lowers to a much slower relayout
+        # broadcast form lowers to a much slower relayout. Class-major rows
+        # j = k*2S + c*S + s (matches the caller's unflatten).
         return jnp.concatenate(
-            [w[c:c + 1, :].astype(bf16) * slot_oh for c in range(2)],
-            axis=0)                                          # (2S, T)
+            [w[2 * k + c:2 * k + c + 1, :].astype(bf16) * slot_ohs[k]
+             for k in range(K) for c in range(2)],
+            axis=0)                                          # (2*S*K, T)
 
     A_hi = build_A(w_hi)
     if _ABLATE == "dblA":        # perf probe: one extra A-operand build
@@ -357,13 +379,18 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
 
 def stream_block_rows(bmax: int, num_groups: int = 28,
                       int_hist: bool = False,
-                      bin_buckets=None) -> int:
+                      bin_buckets=None, hist_channels: int = 0) -> int:
     """Rows per kernel block, sized so the (G*B, T) one-hot operand stays
     within ~8 MB of VMEM: int8 one-hots (quantized-gradient path) take
     4096-row blocks (measured ~3% faster than 2048 end to end), bf16
     one-hots 2048 (4096 at bf16 REGRESSES 5x — VMEM pressure kills the
     pipeline). Wide layouts (many EFB groups, e.g. high-dimensional sparse
-    data) step down to 512/256-row blocks."""
+    data) step down to 512/256-row blocks.
+
+    hist_channels: column count of the VMEM-resident histogram block
+    (2*S*K on the batched multiclass path). When > 0 its T-independent
+    footprint is charged against the one-hot budget, so the widened
+    K-channel program steps the block size down instead of blowing VMEM."""
     import os
     env = os.environ.get("LGBTPU_BLOCK_ROWS")
     if env:
@@ -385,6 +412,11 @@ def stream_block_rows(bmax: int, num_groups: int = 28,
     # the one-hot fit the budget (VMEM pressure kills the pipeline), and
     # small bucketed m_rows would otherwise re-admit it
     budget = (9 if int_hist else 8) * 2 ** 20
+    if hist_channels:
+        # the (m_rows, C) histogram block stays VMEM-resident across the
+        # whole grid; the binary path's C=2S block was small enough to
+        # ignore, the K-widened block is not
+        budget -= max(0, m_rows * hist_channels * 4 - 2 * 2 ** 20)
     tiers = (4096, 2048, 1024, 512, 256) if int_hist \
         else (2048, 1024, 512, 256)
     for T in tiers:
@@ -432,31 +464,40 @@ def pack_bins_T(bins: jax.Array, block_rows: int = 1024,
                    static_argnames=("num_slots", "bmax", "num_groups",
                                     "num_leaves", "block_rows", "has_cat",
                                     "two_pass", "int_weights", "with_hist",
-                                    "bin_buckets"))
+                                    "bin_buckets", "num_class"))
 def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                    tabs: jax.Array, bits: jax.Array, num_slots: int, bmax: int,
                    num_groups: int, num_leaves: int, block_rows: int = 1024,
                    has_cat: bool = True, two_pass: bool = True,
                    int_weights: bool = False, with_hist: bool = True,
-                   bin_buckets=None):
+                   bin_buckets=None, num_class: int = 1):
     """One fused streaming pass: route rows through this round's splits and
     build grad/hess histograms and exact data counts of the rows' NEW slots.
 
     bins_T: (GW_pad, N_pad) i32 from pack_bins_T.
-    leaf_id: (1, N_pad) i32 current leaf per row.
-    w_T: (8, N_pad) f32, rows 0..2 = grad, hess, cnt (bagging mask applied).
-    tabs: (NUM_TAB, L) f32 per-leaf split tables (see build_route_tables).
-    bits: (L, Bpad) bf16 categorical left-side bitsets (dummy when !has_cat).
-    Returns (new_leaf_id (1, N_pad) i32, hist (S, G, Bmax, 2) f32 grad/hess,
-    slot_cnt (S,) f32 exact per-slot data counts).
+    leaf_id: (K, N_pad) i32 current leaf per row (per class; K = num_class).
+    w_T: (Wpad, N_pad) f32, rows 2k/2k+1 = class k's grad/hess (bagging mask
+    applied) and row 2K = cnt; K=1 keeps the legacy 0..2 = grad, hess, cnt.
+    tabs: (NUM_TAB, K*L) f32 per-leaf split tables (see build_route_tables).
+    bits: (Bpad, K*L) bf16 categorical left bitsets (dummy when !has_cat).
+    Returns (new_leaf_id (K, N_pad) i32, hist (S, G, Bmax, 2) f32 grad/hess
+    — (K, S, G, Bmax, 2) when num_class > 1 — and slot_cnt (S,) / (K, S)
+    f32 exact per-slot data counts).
+
+    num_class > 1 is the BATCHED MULTICLASS path: all K class trees route
+    and accumulate inside ONE widened program whose bin one-hot (the
+    dominant construct) is built once per block and contracted against the
+    stacked class x slot channel axis.
     """
     GW, n_pad = bins_T.shape
     T = block_rows
     NB = n_pad // T
-    S, G, L = num_slots, num_groups, num_leaves
+    S, G, L, K = num_slots, num_groups, num_leaves, num_class
     if S > MAX_SLOTS:
         raise ValueError(f"stream kernel supports at most {MAX_SLOTS} "
                          f"histogram slots per round, got {S}")
+    if K > 1 and _ABLATE:
+        raise ValueError("LGBTPU_KABLATE probes require num_class == 1")
     B = -(-bmax // 8) * 8
     u8_layout = bins_T.dtype == jnp.int8
     if bin_buckets is not None:
@@ -473,14 +514,14 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
 
     hist_dtype = jnp.int32 if int_weights else jnp.float32
     out_specs = [
-        pl.BlockSpec((1, T), lambda b: (0, b)),
-        pl.BlockSpec((m_rows, 2 * S), lambda b: (0, 0)),
-        pl.BlockSpec((1, S), lambda b: (0, 0)),
+        pl.BlockSpec((K, T), lambda b: (0, b)),
+        pl.BlockSpec((m_rows, 2 * S * K), lambda b: (0, 0)),
+        pl.BlockSpec((1, S * K), lambda b: (0, 0)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-        jax.ShapeDtypeStruct((m_rows, 2 * S), hist_dtype),
-        jax.ShapeDtypeStruct((1, S), jnp.float32),
+        jax.ShapeDtypeStruct((K, n_pad), jnp.int32),
+        jax.ShapeDtypeStruct((m_rows, 2 * S * K), hist_dtype),
+        jax.ShapeDtypeStruct((1, S * K), jnp.float32),
     ]
     if not with_hist:
         del out_specs[1], out_shape[1]
@@ -489,14 +530,14 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
                           has_cat=has_cat, two_pass=two_pass,
                           int_weights=int_weights, f32_dots=_interp(),
                           u8_layout=u8_layout, with_hist=with_hist,
-                          bin_buckets=bin_buckets, m_rows=m_rows),
+                          bin_buckets=bin_buckets, m_rows=m_rows, K=K),
         grid=(NB,),
         in_specs=[
             pl.BlockSpec((GW, T), lambda b: (0, b)),
-            pl.BlockSpec((1, T), lambda b: (0, b)),
-            pl.BlockSpec((8, T), lambda b: (0, b)),
-            pl.BlockSpec((NUM_TAB, L), lambda b: (0, 0)),
-            pl.BlockSpec((B, L), lambda b: (0, 0)),
+            pl.BlockSpec((K, T), lambda b: (0, b)),
+            pl.BlockSpec((w_T.shape[0], T), lambda b: (0, b)),
+            pl.BlockSpec((NUM_TAB, K * L), lambda b: (0, 0)),
+            pl.BlockSpec((B, K * L), lambda b: (0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -505,30 +546,39 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
         interpret=_interp(),
     )(bins_T, leaf_id, w_T, tabs, bits)
 
+    def _cnt_out(cnt):
+        return cnt.reshape(-1) if K == 1 else cnt.reshape(K, S)
+
     if not with_hist:
         new_leaf, cnt = outs
-        hist4 = jnp.zeros((S, G, bmax, 2), hist_dtype)
-        return new_leaf, hist4, cnt.reshape(-1)
+        shape4 = (S, G, bmax, 2) if K == 1 else (K, S, G, bmax, 2)
+        return new_leaf, jnp.zeros(shape4, hist_dtype), _cnt_out(cnt)
     new_leaf, hist, cnt = outs
     if bin_buckets is not None:
-        # per-run unpack: rows [roff, roff + Bk*Gk) -> (S, Gk, Bk, 2),
+        # per-run unpack: rows [roff, roff + Bk*Gk) -> (K, S, Gk, Bk, 2),
         # bins padded up to Bmax, runs concatenated in layout group order
         parts4 = []
         roff = 0
         for Bk, Gk in bin_buckets:
             Gk8 = bucket_group_pad(Gk)
             blk = hist[roff:roff + Bk * Gk8]
-            h4 = blk.reshape(Bk, Gk8, 2, S)[:, :Gk].transpose(3, 1, 0, 2)
+            h4 = blk.reshape(Bk, Gk8, K, 2, S)[:, :Gk].transpose(2, 4, 1, 0, 3)
             if Bk < bmax:
-                h4 = jnp.pad(h4, ((0, 0), (0, 0), (0, bmax - Bk), (0, 0)))
-            parts4.append(h4[:, :, :bmax, :])
+                h4 = jnp.pad(h4, ((0, 0), (0, 0), (0, 0),
+                                  (0, bmax - Bk), (0, 0)))
+            parts4.append(h4[:, :, :, :bmax, :])
             roff += Bk * Gk8
-        hist4 = jnp.concatenate(parts4, axis=1)
-        return new_leaf, hist4, cnt.reshape(-1)
-    # (B*G, 2S) b-major rows -> (S, G, Bmax, 2); int histograms are
+        hist4 = jnp.concatenate(parts4, axis=2)
+        if K == 1:
+            hist4 = hist4[0]
+        return new_leaf, hist4, _cnt_out(cnt)
+    # (B*G, 2*S*K) b-major rows -> (K, S, G, Bmax, 2); int histograms are
     # unscaled by the caller
-    hist4 = hist.reshape(B, G, 2, S).transpose(3, 1, 0, 2)[:, :, :bmax, :]
-    return new_leaf, hist4, cnt.reshape(-1)
+    hist4 = hist.reshape(B, G, K, 2, S).transpose(2, 4, 1, 0, 3)[
+        :, :, :, :bmax, :]
+    if K == 1:
+        hist4 = hist4[0]
+    return new_leaf, hist4, _cnt_out(cnt)
 
 
 def _leaf_gather_kernel(lid_ref, val_ref, out_ref, *, T, L):

@@ -202,10 +202,7 @@ def voting_split_round(bins_s, slot_s, grad_s, hess_s, cnt_s, parent_g,
 def make_voting_splitter(mesh: Mesh, num_slots: int, bmax: int, top_k: int,
                          cfg, layout=None) -> "callable":
     """shard_map-wrapped voting split finder bound to the mesh + layout."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_rows
     axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
     scan_kw = dict(
         lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
@@ -228,17 +225,10 @@ def make_voting_splitter(mesh: Mesh, num_slots: int, bmax: int, top_k: int,
         axis=axis)
     row = P(axis)
     rep = P()
-    kwargs = dict(mesh=mesh,
-                  in_specs=(P(axis, None), row, row, row, row,
-                            rep, rep, rep, rep),
-                  out_specs=(rep,) * 8)
-    try:
-        return shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:
-        try:
-            return shard_map(fn, check_rep=False, **kwargs)
-        except TypeError:
-            return shard_map(fn, **kwargs)
+    return shard_map_rows(
+        fn, mesh,
+        (P(axis, None), row, row, row, row, rep, rep, rep, rep),
+        (rep,) * 8)
 
 
 def voting_supported(layout, routing) -> bool:

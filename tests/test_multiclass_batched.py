@@ -1,0 +1,205 @@
+"""Batched multiclass growth (ops.grow.grow_tree_k).
+
+The widened lockstep path — one histogram contraction per growth round
+serving all K class trees' gradient channels — must produce trees
+bit-identical to the per-class lax.scan path (LGBTPU_MULTICLASS_BATCHED=1/0
+A/B), stay serial-vs-data-parallel consistent, and trace exactly once.
+Satellite regressions (one-row multiclass .init files, the packed-predictor
+cache, seeded shuffle_models) ride along.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mc_data(n=800, f=8, k=4, seed=7):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    logits = np.stack([X[:, i % f] + 0.5 * X[:, (i + 1) % f]
+                       for i in range(k)], axis=1)
+    y = np.argmax(logits + rs.randn(n, k) * 0.5, axis=1).astype(np.float64)
+    return X, y
+
+
+def _train_str(X, y, k, rounds=6, **extra):
+    params = {"objective": "multiclass", "num_class": k, "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 5, "max_bin": 63, **extra}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst.model_to_string()
+
+
+def _strip_params(s):
+    """Drop the parameters dump (records e.g. tree_learner name)."""
+    return s.split("\nparameters:")[0]
+
+
+def _structure(s):
+    return (re.findall(r"split_feature=([^\n]*)", s),
+            re.findall(r"\nthreshold=([^\n]*)", s))
+
+
+def _leaf_values(s):
+    return [np.array([float(v) for v in line.split()])
+            for line in re.findall(r"leaf_value=([^\n]*)", s)]
+
+
+@pytest.mark.parametrize("objective", ["multiclass", "multiclassova"])
+def test_batched_bit_identical_to_scan(objective, monkeypatch):
+    """The widened path's trees must be BIT-IDENTICAL to the per-class
+    scan path's (acceptance criterion of the batched-growth redesign)."""
+    X, y = _mc_data()
+    monkeypatch.setenv("LGBTPU_MULTICLASS_BATCHED", "1")
+    a = _train_str(X, y, 4, objective=objective)
+    monkeypatch.setenv("LGBTPU_MULTICLASS_BATCHED", "0")
+    b = _train_str(X, y, 4, objective=objective)
+    assert a == b
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_batched_matches_scan_stream_backend(quantized, monkeypatch):
+    """Stream backend A/B (pallas kernel in interpret mode on CPU): the
+    widened kernel contracts (m_rows, 2*S*K) columns where the scan path
+    contracts (m_rows, 2*S) per class. On the MXU each output column's
+    systolic reduction is independent of the operand's column count; CPU
+    interpret mode runs Eigen f32 dots whose reduction order is NOT
+    column-count-independent, so values get a one-ulp tolerance here while
+    the tree structure must match exactly."""
+    X, y = _mc_data(n=400, f=6, k=3)
+    extra = {"hist_backend": "stream", "num_leaves": 8, "max_bin": 31}
+    if quantized:
+        extra.update(use_quantized_grad=True, num_grad_quant_bins=64)
+    monkeypatch.setenv("LGBTPU_MULTICLASS_BATCHED", "1")
+    a = _train_str(X, y, 3, rounds=3, **extra)
+    monkeypatch.setenv("LGBTPU_MULTICLASS_BATCHED", "0")
+    b = _train_str(X, y, 3, rounds=3, **extra)
+    assert _structure(a) == _structure(b)
+    for va, vb in zip(_leaf_values(a), _leaf_values(b)):
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=5e-6)
+
+
+def test_batched_matches_scan_stream_bucketed(monkeypatch):
+    """Bucketed one-hot M-axis + K channels: low-cardinality features give
+    the stream kernel a bucketed layout, whose per-run unflatten gains a
+    class axis on the widened path."""
+    rs = np.random.RandomState(3)
+    n, k = 400, 3
+    # >= 8 groups per bucket: the bucketed M-axis only beats uniform once
+    # the 8-group sublane padding amortizes (gbdt._resolved_bin_buckets)
+    X = np.column_stack([rs.randn(n, 8),
+                         rs.randint(0, 5, (n, 16)).astype(np.float64)])
+    y = (np.argmax(np.stack([X[:, i] + X[:, 8 + i] for i in range(k)], 1)
+                   + rs.randn(n, k), axis=1).astype(np.float64))
+    extra = {"hist_backend": "stream", "num_leaves": 8, "max_bin": 63}
+
+    def train(force):
+        monkeypatch.setenv("LGBTPU_MULTICLASS_BATCHED", force)
+        params = {"objective": "multiclass", "num_class": k, "num_leaves": 8,
+                  "verbosity": -1, "min_data_in_leaf": 5, **extra}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+        return bst
+
+    ba = train("1")
+    assert ba.engine._grow_params.bin_buckets is not None  # layout engaged
+    a = ba.model_to_string()
+    b = train("0").model_to_string()
+    assert _structure(a) == _structure(b)
+    for va, vb in zip(_leaf_values(a), _leaf_values(b)):
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=5e-6)
+
+
+def test_multiclass_serial_vs_data_parallel():
+    """Multiclass trees from the row-sharded mesh must equal the serial
+    run's (the widened program's histogram reduce under GSPMD is exact —
+    the reference's ReduceScatter property, test_tree_equality extended to
+    the K-class path)."""
+    X, y = _mc_data(n=1200, f=8, k=3, seed=5)
+    s = _train_str(X, y, 3, rounds=4, tree_learner="serial")
+    d = _train_str(X, y, 3, rounds=4, tree_learner="data")
+    assert _strip_params(s) == _strip_params(d)
+
+
+def test_batched_path_traces_once():
+    """watched_jit telemetry: ONE grow_tree_k trace for the whole run (no
+    K-per-shape retraces — the per-iteration cost target depends on it)."""
+    import lightgbm_tpu.telemetry as tel
+    X, y = _mc_data(n=400, f=6, k=3)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 8,
+              "verbosity": -1, "min_data_in_leaf": 5, "max_bin": 31,
+              "telemetry": True}
+    try:
+        bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+        rec = bst.telemetry_summary().get("recompiles", {})
+        assert "grow_tree_k" in rec
+        # the summary aggregates every LIVE entry of the name (other
+        # models in the process may hold their own); no single model may
+        # have traced the widened grower more than once
+        assert rec["grow_tree_k"]["max_per_entry"] == 1
+    finally:
+        tel.configure(enabled=False, metrics_out="", trace_out="")
+        tel.reset()
+
+
+def test_one_row_multiclass_init_score(tmp_path):
+    """A one-row multiclass .init file must keep its (1, num_class) shape
+    (np.loadtxt squeezes to (num_class,) without ndmin=2)."""
+    from lightgbm_tpu.dataset_io import load_init_score_file
+    base = tmp_path / "train.txt"
+    base.write_text("1 0.5 0.25\n")
+    (tmp_path / "train.txt.init").write_text("0.1 0.2 0.7\n")
+    arr = load_init_score_file(str(base))
+    assert arr.shape == (1, 3)
+    np.testing.assert_allclose(arr[0], [0.1, 0.2, 0.7])
+    # a one-column multirow file stays 1-D (regression init scores)
+    (tmp_path / "train.txt.init").write_text("0.1\n0.2\n0.3\n")
+    arr = load_init_score_file(str(base))
+    assert arr.shape == (3,)
+
+
+def test_fast_predict_cache_rebinds_on_leaf_mutation():
+    """The packed single-row predictor must invalidate when a tree's
+    leaf_value array is REBOUND (DART shrink / set_leaf_output), and must
+    be reused while the model is untouched. The cache holds strong
+    references compared with `is` — id() recycling cannot false-hit."""
+    X, y = _mc_data(n=300, f=5, k=3)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 6,
+              "verbosity": -1, "min_data_in_leaf": 5, "max_bin": 31}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    row = X[:1]
+    p1 = bst.predict(row, raw_score=True)
+    pred1 = bst._fast1_cache[2]
+    bst.predict(row, raw_score=True)
+    assert bst._fast1_cache[2] is pred1          # unchanged model: reused
+    t = bst._all_trees()[0]
+    lv = np.asarray(t.leaf_value, np.float64).copy() + 1.0
+    t.leaf_value = lv                            # rebind without Booster API
+    p2 = bst.predict(row, raw_score=True)
+    assert bst._fast1_cache[2] is not pred1      # rebind invalidates
+    assert not np.allclose(p1, p2)
+
+
+def test_shuffle_models_seeded_and_rng_isolated():
+    """shuffle_models must permute deterministically (seeded local RNG) and
+    leave the global numpy RNG stream untouched (reproducible refit
+    pipelines)."""
+    X, y = _mc_data(n=300, f=5, k=3)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 6,
+              "verbosity": -1, "min_data_in_leaf": 5, "max_bin": 31}
+
+    def fresh():
+        return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    b1, b2 = fresh(), fresh()
+    np.random.seed(123)
+    before = np.random.rand(4)
+    np.random.seed(123)
+    b1.shuffle_models()
+    b2.shuffle_models()
+    after = np.random.rand(4)
+    np.testing.assert_array_equal(before, after)   # global RNG untouched
+    assert b1.model_to_string() == b2.model_to_string()
+    # the permutation actually changed tree order for this seed
+    assert b1.model_to_string() != fresh().model_to_string()
