@@ -440,6 +440,43 @@ def main():
     s_per_tree_full = s_per_tree * scale
     vs_baseline = BASELINE_S_PER_TREE / s_per_tree_full
 
+    resume_ok = True
+    if os.environ.get("BENCH_RESUME", "") == "1":
+        # checkpoint-write overhead at snapshot_freq=10 as % of iteration
+        # wall time (gate < 2%): the crash-consistent checkpoints
+        # (docs/ROBUSTNESS.md) must stay cheap enough to leave on for every
+        # production run
+        import shutil
+        import tempfile
+        td = tempfile.mkdtemp(prefix="lgb_bench_ckpt_")
+        try:
+            ck_path = os.path.join(td, "model.txt")
+            ck_time = 0.0
+            t0 = time.time()
+            for i in range(N_ITERS):
+                bst.update()
+                if (i + 1) % 10 == 0:
+                    # measure the checkpoint calls directly (differencing
+                    # two whole blocks would fold in run-to-run noise and
+                    # the larger model's growing iteration cost)
+                    bst.engine.score.block_until_ready()
+                    c0 = time.perf_counter()
+                    bst.checkpoint(ck_path, bst.current_iteration(), keep=2)
+                    ck_time += time.perf_counter() - c0
+            bst.engine.score.block_until_ready()
+            ck_elapsed = time.time() - t0
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+        overhead_pct = ck_time / max(ck_elapsed - ck_time, 1e-9) * 100.0
+        resume_ok = overhead_pct < 2.0
+        print(json.dumps({
+            "metric": "checkpoint_overhead_pct_freq10",
+            "value": round(overhead_pct, 3),
+            "unit": ("% of iteration wall time at snapshot_freq=10 "
+                     f"({'OK' if resume_ok else 'FAIL'}: gate < 2%)"),
+            "vs_baseline": None,
+        }), flush=True)
+
     auc = auc_score(y_te, bst.predict(X_te, raw_score=True))
     if auc < AUC_GATE:
         print(json.dumps({
@@ -459,7 +496,7 @@ def main():
         **_memory_fields(rss0),
         **_telemetry_fields(bst),
     }), flush=True)
-    return True
+    return resume_ok
 
 
 if __name__ == "__main__":

@@ -1487,6 +1487,19 @@ class Booster:
         from .model_io import save_model_string
         return save_model_string(self, num_iteration, start_iteration, importance_type)
 
+    def checkpoint(self, output_model: str, iteration: Optional[int] = None,
+                   keep: int = -1) -> str:
+        """Write a crash-consistent checkpoint resumable via
+        ``lgb.train(..., resume_from=...)``: model text + engine state
+        (score vector, RNG streams) + a sealed JSON manifest, all via
+        tmp-file + ``os.replace``, pruned to the ``keep`` newest
+        (docs/ROBUSTNESS.md).  Returns the snapshot path.  Multi-process:
+        every rank must call this at the same iteration (the state capture
+        is collective); only rank 0 writes."""
+        from .robustness.checkpoint import write_checkpoint
+        it = int(iteration) if iteration is not None else self.current_iteration()
+        return write_checkpoint(self, str(output_model), it, keep=keep)
+
     def dump_model(self, num_iteration: Optional[int] = None, start_iteration: int = 0,
                    importance_type: str = "split") -> Dict:
         from .model_io import dump_model_dict

@@ -98,6 +98,8 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "output_model": ["model_output", "model_out"],
     "saved_feature_importance_type": [],
     "snapshot_freq": ["save_period"],
+    "snapshot_keep": [],
+    "resume_from": ["resume"],
     "linear_tree": ["linear_trees"],
     "max_bin": ["max_bins"],
     "max_bin_by_feature": [],
@@ -177,6 +179,10 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "multiclass_batched": ["batched_multiclass"],
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
+    # --- robustness (docs/ROBUSTNESS.md) ---
+    "nan_guard": ["nan_policy"],
+    "dist_retries": [],
+    "dist_backoff": [],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -333,6 +339,12 @@ class Config:
     output_model: str = "LightGBM_model.txt"
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
+    # newest crash-consistent snapshots retained after each checkpoint
+    # write (-1 = keep all; docs/ROBUSTNESS.md)
+    snapshot_keep: int = -1
+    # checkpoint path to resume training from; validates the manifest and
+    # continues bit-identically to an uninterrupted run (alias: resume)
+    resume_from: str = ""
     linear_tree: bool = False
 
     # Dataset
@@ -433,6 +445,16 @@ class Config:
     mesh_shape: str = ""
     tpu_dtype: str = "f32"
 
+    # --- robustness (docs/ROBUSTNESS.md) ---
+    # non-finite gradient/hessian policy: warn (log + skip the poisoned
+    # iteration), skip (silent skip), raise (abort), none (guard off)
+    nan_guard: str = "warn"
+    # supervised launcher: cohort relaunches from the newest valid
+    # snapshot after a worker failure/hang, at most this many times
+    dist_retries: int = 0
+    # seconds before the first cohort relaunch (doubles each retry)
+    dist_backoff: float = 2.0
+
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records
     telemetry: bool = False
@@ -485,6 +507,11 @@ class Config:
         if obj not in ("multiclass", "multiclassova") and self.num_class != 1:
             if obj != "none":
                 raise ValueError("num_class must be 1 for non-multiclass objectives")
+        from .robustness.guards import VALID_MODES
+        if str(self.nan_guard).strip().lower() not in VALID_MODES:
+            raise ValueError(
+                f"nan_guard={self.nan_guard!r} is not one of "
+                f"{', '.join(repr(m) for m in VALID_MODES)}")
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
                 # rf requires bagging (reference: config.cpp CheckParamConflict)

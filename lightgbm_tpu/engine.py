@@ -14,6 +14,7 @@ from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config, resolve_aliases
+from .robustness import chaos as _chaos
 from .utils.log import LightGBMError, log_info, log_warning
 
 
@@ -23,9 +24,23 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           feval: Optional[Union[Callable, List[Callable]]] = None,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Train a booster (reference: engine.py:109)."""
+          callbacks: Optional[List[Callable]] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train a booster (reference: engine.py:109).
+
+    ``resume_from`` (or param ``resume_from``/``resume``) names a
+    checkpoint written by ``snapshot_freq`` training: the manifest is
+    validated (checksums, params identity, topology), the trees become
+    the init model, the engine state (score, RNG streams) is restored,
+    and the loop continues from the snapshot iteration BIT-IDENTICALLY to
+    a run that was never interrupted (docs/ROBUSTNESS.md).  Callback
+    state is NOT checkpointed: an early-stopping window restarts at the
+    resume point, so runs that stop early may stop differently."""
     params = resolve_aliases(dict(params or {}))
+    # popped so the resumed booster's params (and saved params block) match
+    # the uninterrupted run's exactly
+    resume_from = resume_from or params.pop("resume_from", None) or None
+    params.pop("resume_from", None)
     if "num_iterations" in params:
         num_boost_round = int(params["num_iterations"])
     params["num_iterations"] = num_boost_round
@@ -35,6 +50,25 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     if init_model is not None and isinstance(init_model, str):
         init_model = Booster(model_file=init_model)
+
+    start_iteration = 0
+    resume_state = None
+    if resume_from:
+        if init_model is not None:
+            raise LightGBMError(
+                "pass either init_model or resume_from, not both (a "
+                "checkpoint already carries its model)")
+        from .robustness.checkpoint import load_checkpoint
+        model_str, manifest, resume_state = load_checkpoint(
+            str(resume_from), params=params)
+        init_model = Booster(model_str=model_str)
+        start_iteration = int(manifest["iteration"])
+        if start_iteration >= num_boost_round:
+            log_warning(
+                f"resume_from checkpoint is at iteration {start_iteration} "
+                f">= num_boost_round={num_boost_round}; nothing to train")
+        log_info(f"resuming from {resume_from} at iteration "
+                 f"{start_iteration}/{num_boost_round}")
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
@@ -47,7 +81,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         else:
             trees = copy.deepcopy(list(init_model._loaded_trees.trees))
             k = init_model._loaded_trees.num_tree_per_iteration
-        booster.engine.load_init_model(trees, k)
+        booster.engine.load_init_model(
+            trees, k, skip_score_rebuild=resume_state is not None)
+    if resume_state is not None:
+        from .robustness.checkpoint import restore_state
+        restore_state(booster, resume_state)
     if valid_sets:
         if valid_names is not None and len(valid_names) != len(valid_sets):
             raise LightGBMError(
@@ -77,18 +115,22 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    snapshot_keep = int(params.get("snapshot_keep", -1) or -1)
     output_model = str(params.get("output_model", "LightGBM_model.txt"))
 
     evaluation_result_list: List = []
-    for i in range(num_boost_round):
+    for i in range(start_iteration, num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,
                            begin_iteration=0, end_iteration=num_boost_round,
                            evaluation_result_list=[]))
         finished = booster.update()
         if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            # periodic checkpoint (reference: gbdt.cpp:259-263 Train snapshots)
-            booster.save_model(f"{output_model}.snapshot_iter_{i + 1}")
+            # periodic crash-consistent checkpoint: tmp + os.replace with a
+            # sealed manifest, resumable via resume_from (reference:
+            # gbdt.cpp:259-263 Train snapshots; docs/ROBUSTNESS.md)
+            booster.checkpoint(output_model, i + 1, keep=snapshot_keep)
+        _chaos.maybe_kill(i + 1)
 
         evaluation_result_list: List = []
         if valid_sets is not None or feval is not None:
@@ -112,6 +154,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # engine's deferred finished-flag polls — drop any trailing no-op
         # trees so the saved model matches the reference's immediate stop
         booster.engine._trim_trailing_trivial()
+    booster.engine.flush_nan_guard()
 
     if evaluation_result_list:
         best: Dict[str, Dict[str, float]] = collections.defaultdict(dict)
@@ -285,6 +328,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                 results[k] = results[k][:cvbooster.best_iteration]
             break
 
+    for bst in cvbooster.boosters:
+        bst.engine.flush_nan_guard()
     if return_cvbooster:
         results["cvbooster"] = cvbooster  # type: ignore
     return dict(results)

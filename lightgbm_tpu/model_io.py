@@ -293,6 +293,7 @@ def load_model_string(model_str: str) -> LoadedModel:
                     pass
             break
     i = 0
+    end_seen = False
     # header
     while i < len(lines):
         line = lines[i].strip()
@@ -301,6 +302,7 @@ def load_model_string(model_str: str) -> LoadedModel:
             i -= 1
             break
         if line == "end of trees":
+            end_seen = True
             break
         if "=" in line:
             key, _, val = line.partition("=")
@@ -323,6 +325,7 @@ def load_model_string(model_str: str) -> LoadedModel:
     while i < len(lines):
         line = lines[i].strip()
         if line == "end of trees":
+            end_seen = True
             break
         if not line.startswith("Tree="):
             i += 1
@@ -342,12 +345,48 @@ def load_model_string(model_str: str) -> LoadedModel:
             key, _, val = ln.partition("=")
             block[key] = val
             i += 1
-        lm.trees.append(_tree_from_block(block))
+        lm.trees.append(_tree_from_block(block, len(lm.trees)))
+    if not end_seen:
+        # a complete save always writes the marker (save_model_string) —
+        # its absence means the file was cut mid-write or mid-copy
+        raise LightGBMError(
+            f"truncated model text: missing 'end of trees' marker after "
+            f"{len(lm.trees)} parsed tree(s)")
     return lm
 
 
-def _tree_from_block(block: Dict[str, str]) -> Tree:
+def _tree_from_block(block: Dict[str, str], index: int = 0) -> Tree:
+    try:
+        return _tree_from_block_checked(block, index)
+    except (KeyError, ValueError, IndexError) as e:
+        # a cleanly saved model never produces these — a half-written line,
+        # a missing array, or a garbled count means the text was cut/corrupt
+        raise LightGBMError(
+            f"truncated model text: tree {index} block is incomplete or "
+            f"corrupt ({type(e).__name__}: {e})")
+
+
+def _check_tree_arrays(block: Dict[str, str], index: int, nl: int,
+                       t: Tree) -> None:
+    ni = max(nl - 1, 0)
+    wants = (("leaf_value", t.leaf_value, nl),
+             ("split_feature", t.split_feature, ni),
+             ("threshold", t.threshold, ni),
+             ("decision_type", t.decision_type, ni),
+             ("left_child", t.left_child, ni),
+             ("right_child", t.right_child, ni))
+    for name, arr, want in wants:
+        if len(arr) != want:
+            raise LightGBMError(
+                f"truncated model text: tree {index} has {len(arr)} "
+                f"{name} entries but num_leaves={nl} needs {want}")
+
+
+def _tree_from_block_checked(block: Dict[str, str], index: int) -> Tree:
     nl = int(block.get("num_leaves", "1"))
+    if nl < 1:
+        raise LightGBMError(
+            f"truncated model text: tree {index} has num_leaves={nl}")
     num_cat = int(block.get("num_cat", "0"))
     thr = _parse_array(block.get("threshold", ""), float)
     t = Tree(
@@ -371,6 +410,7 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
                                 block["leaf_const"].split()])
                     if "leaf_const" in block else None),
     )
+    _check_tree_arrays(block, index, nl, t)
     if t.is_linear and "num_features" in block:
         nf = _parse_array(block.get("num_features", ""), int)
         feats_flat = _parse_array(block.get("leaf_features", ""), int)

@@ -26,6 +26,9 @@ from ..objectives import ObjectiveFunction
 from ..ops.grow import GrowParams, grow_tree
 from ..ops.split import leaf_output
 from ..ops.predict import StackedTrees, _walk_one_tree
+from ..robustness import chaos as _chaos
+from ..robustness.guards import (NanGuard, check_finite_init,
+                                 check_model_trees)
 from ..telemetry import (global_registry as _tel_registry,
                          global_tracer as _tel_tracer, memory_snapshot,
                          watched_jit)
@@ -206,6 +209,9 @@ class GBDT:
         # user-provided init_score offsets (kept separate from boost_from_average)
         base = train_data.get_init_score_padded(n, k)
         if base is not None:
+            # a single non-finite init score would poison every gradient of
+            # every iteration — same policy knob as the gradient guard
+            base = check_finite_init(base, "init_score", config.nan_guard)
             self.score = self.score + jnp.asarray(base, jnp.float32)
         self.score = self._shard_row_array(self.score)
 
@@ -315,6 +321,11 @@ class GBDT:
         self._saved_state: Optional[Tuple] = None
         self._grad_fn = None
         self._score_add_fn = None
+        # non-finite gradient guard (docs/ROBUSTNESS.md): a tripped check
+        # zeroes the iteration's gradients so it grows an exact no-op tree
+        self._nan_guard = NanGuard(config.nan_guard,
+                                   objective.name if objective else "none")
+        self._nan_check_fn = None
         # telemetry: recent per-iteration wall times (straggler window)
         self._tel_iter_times: List[float] = []
 
@@ -801,6 +812,53 @@ class GBDT:
             mask[keep] = True
         return jnp.asarray(mask)
 
+    def _gh_finite(self, grad, hess):
+        """One cheap jitted all-finite check over the gradient/hessian
+        blocks (nan_guard; docs/ROBUSTNESS.md)."""
+        if self._nan_check_fn is None:
+            def _fn(g, h):
+                return jnp.isfinite(g).all() & jnp.isfinite(h).all()
+            self._nan_check_fn = watched_jit(_fn, name="nan_check",
+                                             owner=self)
+        return self._nan_check_fn(grad, hess)
+
+    def _guard_gh(self, grad, hess, *extras):
+        """nan_guard scrub: returns ``(ok_dev, grad, hess, *extras)`` with
+        every array select-zeroed when the all-finite check trips — an
+        all-zero gradient grows an exact single-leaf no-op tree, so the
+        poisoned iteration is skipped without perturbing any later
+        iteration's RNG streams.  Guard off: pass-through, ok_dev None.
+        When the flag is True the selects are exact identities, so guarded
+        and unguarded runs stay bit-identical."""
+        if not self._nan_guard.enabled:
+            return (None, grad, hess) + extras
+        ok = self._gh_finite(grad, hess)
+        out = tuple(jnp.where(ok, a, jnp.zeros_like(a)) if a is not None
+                    else None for a in (grad, hess) + extras)
+        return (ok,) + out
+
+    def _guard_objective_state(self, old_state, ok) -> None:
+        """Keep the objective's PREVIOUS per-iteration state when the guard
+        tripped: gradient evaluation already wrote back state computed from
+        the poisoned values (e.g. lambdarank position biases), and one NaN
+        there would re-poison every later iteration's gradients."""
+        if ok is None or self.objective is None:
+            return
+        for a, old in old_state.items():
+            new = getattr(self.objective, a, None)
+            if new is not None and old is not None and new is not old:
+                setattr(self.objective, a, jnp.where(ok, new, old))
+
+    def flush_nan_guard(self) -> None:
+        """Resolve any deferred nan_guard flags (called at end of train())."""
+        self._nan_guard.poll()
+
+    @property
+    def nan_iterations(self) -> int:
+        """Boosting iterations skipped by nan_guard so far."""
+        self._nan_guard.poll()
+        return self._nan_guard.hits
+
     def _boost(self) -> Tuple[jax.Array, jax.Array]:
         """Gradient computation (reference: GBDT::Boosting, gbdt.cpp:229)."""
         if self.objective is None:
@@ -1028,6 +1086,7 @@ class GBDT:
             return False
         return ((force == "1" or jax.default_backend() in ("tpu", "axon"))
                 and self.num_tree_per_iteration == 1
+                and not _chaos.has("nan_grad")   # chaos injects eagerly
                 and not c.linear_tree
                 and not self._voting
                 and self._cegb_used is None
@@ -1042,6 +1101,7 @@ class GBDT:
         if self._iter_fn is None:
             self._ensure_grad_meta()
             grow = self._grow_partial
+            guarded = self._nan_guard.enabled
             gather = None
             if self._use_leaf_gather_kernel:
                 from ..pallas.stream_kernel import leaf_gather
@@ -1051,6 +1111,22 @@ class GBDT:
                     gkey):
                 g, h, gq, hq, sc, new_state = self._gradient_graph(
                     score, bound, pad_mask, qkey)
+                ok = None
+                if guarded:
+                    # nan_guard inside the one-launch program: a tripped
+                    # check zeroes the growing inputs (exact no-op tree,
+                    # score delta 0) and keeps the objective's PREVIOUS
+                    # state (a poisoned pos_biases update would re-poison
+                    # every later iteration); the flag is read lazily at
+                    # the finished-flag polls so the fused path keeps its
+                    # async pipeline
+                    ok = jnp.isfinite(g).all() & jnp.isfinite(h).all()
+                    gq = jnp.where(ok, gq, jnp.zeros_like(gq))
+                    hq = jnp.where(ok, hq, jnp.zeros_like(hq))
+                    if sc is not None:
+                        sc = jnp.where(ok, sc, jnp.zeros_like(sc))
+                    new_state = {a: jnp.where(ok, v, bound[a])
+                                 for a, v in new_state.items()}
                 arrays, leaf_id = grow(bins, gq, hq, pad_mask, colm,
                                        key=gkey, packed=packed,
                                        cegb_used=None, gh_scales=sc)
@@ -1059,7 +1135,7 @@ class GBDT:
                     delta = gather(leaf_id, lv)
                 else:
                     delta = lv[leaf_id]
-                return score + delta, arrays, leaf_id, new_state
+                return score + delta, arrays, leaf_id, new_state, ok
 
             self._iter_fn = watched_jit(_fn, name="fused_iter", owner=self)
         qkey = jax.random.PRNGKey(
@@ -1071,13 +1147,13 @@ class GBDT:
         bound = {a: getattr(self.objective, a)
                  for a in self._grad_attr_names + self._grad_state_names}
         with self._grow_x64_ctx():
-            new_score, arrays, leaf_id, new_state = self._iter_fn(
+            new_score, arrays, leaf_id, new_state, ok = self._iter_fn(
                 self.score, bound, self._pad_mask, qkey, self.dd.bins,
                 self._feature_mask(), self._packed,
                 jnp.float32(self._shrinkage_rate()), gkey)
         for a, v in new_state.items():
             setattr(self.objective, a, v)
-        return new_score, arrays, leaf_id
+        return new_score, arrays, leaf_id, ok
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
@@ -1171,7 +1247,7 @@ class GBDT:
         if fast_path and self._can_fuse_iteration():
             with global_timer.scope("GBDT::FusedIter"), \
                     _tel_tracer.span("GBDT::FusedIter"):
-                new_score, arrays, leaf_id = self._iter_fused()
+                new_score, arrays, leaf_id, ok_dev = self._iter_fused()
             bias = 0.0
             if (self.iter_ == 0 or self._average_output) and \
                     self.init_scores[0] != 0.0:
@@ -1185,20 +1261,36 @@ class GBDT:
                 self._valid_scores[vi] = self._add_tree_arrays_to_score(
                     self._valid_scores[vi], arrays, vdd, 0,
                     self._shrinkage_rate())
-            self._finished_dev = arrays.num_leaves <= 1
+            fin = arrays.num_leaves <= 1
+            if ok_dev is not None:
+                # a nan-skipped iteration grows a trivial tree by design —
+                # it must not read as "no more splits possible"
+                fin = fin & ok_dev
+                self._nan_guard.note(ok_dev, self.iter_, defer=True)
+            self._finished_dev = fin
             self.iter_ += 1
             if self.iter_ % self._finished_check_every == 0:
+                self._nan_guard.poll()
                 if bool(self._finished_dev):
                     self._trim_trailing_trivial()
                     return True
             return False
         quant_done = False
+        ok_dev = None
+        old_state = ({a: getattr(self.objective, a, None)
+                      for a in self.objective.state_attrs()}
+                     if self.objective is not None else {})
         if fast_path:
             # no bagging: the in-bag mask IS the pad mask, and the gradient
             # chain (incl. quantization) runs as one fused program
             with global_timer.scope("GBDT::Boosting"), \
                     _tel_tracer.span("GBDT::Boosting"):
                 (graw, hraw, grad, hess, q_scales) = self._boost_padded()
+            if _chaos.has("nan_grad"):
+                grad = _chaos.inject_nan_grad(grad, self.iter_ + 1)
+            (ok_dev, grad, hess, graw, hraw, q_scales) = self._guard_gh(
+                grad, hess, graw, hraw, q_scales)
+            self._guard_objective_state(old_state, ok_dev)
             mask = self._pad_mask
             quant_done = True
         else:
@@ -1219,6 +1311,10 @@ class GBDT:
             else:
                 grad = grad * self._pad_mask
                 hess = hess * self._pad_mask
+            if _chaos.has("nan_grad"):
+                grad = _chaos.inject_nan_grad(grad, self.iter_ + 1)
+            (ok_dev, grad, hess) = self._guard_gh(grad, hess)
+            self._guard_objective_state(old_state, ok_dev)
 
         k = self.num_tree_per_iteration
         col_mask = self._feature_mask()
@@ -1406,14 +1502,23 @@ class GBDT:
             self._valid_scores[vi] = score
 
         flags = [a.num_leaves <= 1 for a in new_arrays]
-        self._finished_dev = (flags[0] if len(flags) == 1
-                              else jnp.all(jnp.stack(flags)))
+        fin = (flags[0] if len(flags) == 1
+               else jnp.all(jnp.stack(flags)))
+        if ok_dev is not None:
+            # a nan-skipped iteration grows trivial trees by design — it
+            # must not read as "no more splits possible"; the flag read is
+            # deferred to the finished-flag polls (an eager bool() here
+            # would cost a device sync per iteration on a tunneled TPU)
+            fin = fin & ok_dev
+            self._nan_guard.note(ok_dev, self.iter_, defer=True)
+        self._finished_dev = fin
         self.iter_ += 1
         # reading the finished flag is a device->host sync (~90 ms over a
         # tunneled TPU), so poll it only periodically there; the trailing
         # single-leaf trees accumulated between polls are dropped on stop so
         # num_trees()/model files match the reference's immediate stop
         if self.iter_ % self._finished_check_every == 0:
+            self._nan_guard.poll()
             if bool(self._finished_dev):
                 self._trim_trailing_trivial()
                 return True
@@ -1557,12 +1662,19 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def load_init_model(self, trees: List[Tree],
-                        num_tree_per_iteration: int) -> None:
+                        num_tree_per_iteration: int,
+                        skip_score_rebuild: bool = False) -> None:
         """Continued training: seed the engine with an existing model's trees
         and rebuild the training score with a device tree walk (reference:
         GBDT::ResetTrainingData + model-continuation init,
-        src/boosting/gbdt.cpp:259-263, src/boosting/boosting.cpp:42-90)."""
+        src/boosting/gbdt.cpp:259-263, src/boosting/boosting.cpp:42-90).
+        ``skip_score_rebuild``: a checkpoint resume restores the exact
+        saved score next, so the O(trees x rows) walk would be wasted."""
         k = self.num_tree_per_iteration
+        if self._nan_guard.enabled:
+            # the nan_guard contract extends to continued training: refuse
+            # to boost on top of a poisoned model (NaN leaf values / gains)
+            check_model_trees(trees, "init model")
         if num_tree_per_iteration != k:
             raise LightGBMError(
                 f"init_model has {num_tree_per_iteration} trees/iteration but "
@@ -1587,10 +1699,11 @@ class GBDT:
         base = self.train_data.get_init_score_padded(n, k)
         if base is not None:
             score = score + jnp.asarray(base, jnp.float32)
-        for it in range(self.iter_):
-            for kk in range(k):
-                score = self._add_tree_to_score(score, self.models[it * k + kk],
-                                                self.dd, kk)
+        if not skip_score_rebuild:
+            for it in range(self.iter_):
+                for kk in range(k):
+                    score = self._add_tree_to_score(
+                        score, self.models[it * k + kk], self.dd, kk)
         self.score = self._shard_row_array(score)
         # prevent re-folding the from-average bias into future first trees
         self.init_scores = [0.0] * k

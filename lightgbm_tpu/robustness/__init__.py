@@ -1,0 +1,35 @@
+"""Fault tolerance: crash-consistent checkpoints, non-finite guards, chaos.
+
+The reference survives production failure modes through its Network layer
+(socket retries, linkers_socket.cpp) and `snapshot_freq` model snapshots
+(gbdt.cpp:259-263).  This package is the TPU reproduction's equivalent
+reflex arc (docs/ROBUSTNESS.md):
+
+  * :mod:`.checkpoint` — atomic snapshot writes (tmp + ``os.replace``),
+    a JSON manifest with content checksums and a params hash, engine
+    state capture (score vector, host RNG streams, objective state) so
+    ``lgb.train(..., resume_from=...)`` continues **bit-identically** to
+    an uninterrupted run, and retention pruning (``snapshot_keep``);
+  * :mod:`.guards` — the ``nan_guard`` non-finite gradient/hessian guard
+    and finite checks for loaded init scores and model trees;
+  * :mod:`.heartbeat` — per-worker liveness files the supervising
+    launcher (parallel/cluster.py) watches for hang detection;
+  * :mod:`.chaos` — the deterministic fault-injection harness driven by
+    ``LGBTPU_CHAOS`` (kill a worker at iteration N, delay heartbeats,
+    truncate a snapshot, poison one gradient batch).  Every hook is a
+    no-op when the env var is unset.
+"""
+from . import chaos
+from .checkpoint import (latest_valid_snapshot, list_snapshots,
+                         load_checkpoint, validate_checkpoint,
+                         write_checkpoint)
+from .guards import NanGuard, check_finite_init, check_model_trees
+from .heartbeat import heartbeat_callback, read_heartbeat
+
+__all__ = [
+    "chaos",
+    "write_checkpoint", "load_checkpoint", "validate_checkpoint",
+    "list_snapshots", "latest_valid_snapshot",
+    "NanGuard", "check_finite_init", "check_model_trees",
+    "heartbeat_callback", "read_heartbeat",
+]

@@ -1,0 +1,196 @@
+"""Deterministic fault injection for the robustness test matrix.
+
+Faults are declared in the ``LGBTPU_CHAOS`` environment variable and fire
+at exact, reproducible points of the training loop — the same strategy the
+reference uses for its network tests (tests/distributed simulates worker
+loss with localhost process kills), generalized into one harness the unit
+tests and manual experiments share.
+
+Grammar (directives separated by ``;``, options by ``,``)::
+
+    LGBTPU_CHAOS="kill:iter=5,rank=1,once=/tmp/m"   # os._exit after iter 5
+    LGBTPU_CHAOS="nan_grad:iter=3,count=8"          # NaN one gradient batch
+    LGBTPU_CHAOS="truncate_snapshot"                # corrupt snapshot files
+    LGBTPU_CHAOS="hang:iter=3,rank=1,once=/tmp/m"   # stop heartbeating
+    LGBTPU_CHAOS="heartbeat_delay:seconds=2"        # slow every heartbeat
+
+Options:
+
+* ``iter=N``   — fire at boosting iteration N (1-based); omitted = every.
+* ``rank=R``   — only in the process with ``jax.process_index() == R``.
+* ``once=P``   — marker-file latch: fire only if P does not exist, and
+  create P first, so a relaunched/resumed cohort is not killed again.
+* ``seconds=S``/``count=N`` — directive-specific magnitudes.
+
+Every hook re-reads the env var (cheap dict lookup + cached parse), so
+tests can monkeypatch it per-case; with the variable unset every hook is
+an exact no-op.  Run ``python -m lightgbm_tpu.robustness.chaos`` to print
+the parsed directive table.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..utils.log import log_warning
+
+ENV_VAR = "LGBTPU_CHAOS"
+
+
+@dataclass
+class Directive:
+    name: str
+    iteration: Optional[int] = None
+    rank: Optional[int] = None
+    once: Optional[str] = None
+    seconds: Optional[float] = None
+    count: Optional[int] = None
+
+
+def _parse(text: str) -> List[Directive]:
+    out: List[Directive] = []
+    for raw in text.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        name, _, opts = raw.partition(":")
+        d = Directive(name=name.strip())
+        for tok in opts.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            key, _, val = tok.partition("=")
+            key = key.strip()
+            if key in ("iter", "iteration"):
+                d.iteration = int(val)
+            elif key == "rank":
+                d.rank = int(val)
+            elif key == "once":
+                d.once = val
+            elif key == "seconds":
+                d.seconds = float(val)
+            elif key == "count":
+                d.count = int(val)
+            else:
+                raise ValueError(
+                    f"{ENV_VAR}: unknown option {key!r} in directive {raw!r}")
+        out.append(d)
+    return out
+
+
+_cache_text: Optional[str] = None
+_cache: List[Directive] = []
+
+
+def directives() -> List[Directive]:
+    """Parsed directives for the CURRENT env value (re-read every call)."""
+    global _cache_text, _cache
+    text = os.environ.get(ENV_VAR, "")
+    if text != _cache_text:
+        _cache = _parse(text)
+        _cache_text = text
+    return _cache
+
+
+def active() -> bool:
+    return bool(directives())
+
+
+def has(name: str) -> bool:
+    return any(d.name == name for d in directives())
+
+
+def _rank_matches(d: Directive) -> bool:
+    if d.rank is None:
+        return True
+    import jax
+    return jax.process_index() == d.rank
+
+
+def _fire_once(d: Directive) -> bool:
+    """Marker-file latch: created BEFORE firing so even an os._exit cannot
+    re-arm the directive for the relaunched cohort."""
+    if d.once is None:
+        return True
+    if os.path.exists(d.once):
+        return False
+    try:
+        with open(d.once, "w") as fh:
+            fh.write(f"fired {d.name} at {time.time()}\n")
+    except OSError:
+        pass
+    return True
+
+
+def _matches(d: Directive, name: str, iteration: Optional[int]) -> bool:
+    if d.name != name:
+        return False
+    if d.iteration is not None and d.iteration != iteration:
+        return False
+    return _rank_matches(d)
+
+
+def maybe_kill(iteration: int) -> None:
+    """Simulate a hard crash/preemption right after ``iteration``: exits the
+    process with no cleanup (``os._exit``), like SIGKILL would."""
+    for d in directives():
+        if _matches(d, "kill", iteration) and _fire_once(d):
+            log_warning(f"chaos: killing process at iteration {iteration}")
+            os._exit(137)
+
+
+def inject_nan_grad(grad, iteration: int):
+    """Poison the first ``count`` gradient rows with NaN at the matching
+    iteration (1-based: pass ``iter_ + 1``); identity otherwise."""
+    for d in directives():
+        if _matches(d, "nan_grad", iteration) and _fire_once(d):
+            import jax.numpy as jnp
+            n = min(d.count or 8, grad.shape[0])
+            log_warning(f"chaos: injecting NaN into {n} gradient rows at "
+                        f"iteration {iteration}")
+            return grad.at[:n].set(jnp.nan)
+    return grad
+
+
+def maybe_truncate_snapshot(path: str, iteration: Optional[int] = None) -> None:
+    """Corrupt a just-written snapshot (cut the file in half) to exercise
+    the manifest-checksum rejection path at resume time."""
+    for d in directives():
+        if _matches(d, "truncate_snapshot", iteration) and _fire_once(d):
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(max(size // 2, 1))
+            log_warning(f"chaos: truncated snapshot {path} "
+                        f"({size} -> {max(size // 2, 1)} bytes)")
+
+
+def heartbeat_hook(iteration: int) -> None:
+    """Called by the worker heartbeat callback before each beat: ``hang``
+    stops beating (sleeps ~forever, the supervisor's hang detector must
+    reap the worker); ``heartbeat_delay`` just slows the beat down."""
+    for d in directives():
+        if _matches(d, "hang", iteration) and _fire_once(d):
+            log_warning(f"chaos: hanging worker at iteration {iteration}")
+            time.sleep(d.seconds or 3600.0)
+        elif _matches(d, "heartbeat_delay", iteration):
+            time.sleep(d.seconds or 1.0)
+
+
+def main() -> int:
+    ds = directives()
+    if not ds:
+        print(f"{ENV_VAR} is unset or empty: all chaos hooks are no-ops")
+        return 0
+    print(f"{ENV_VAR}={os.environ.get(ENV_VAR, '')!r}")
+    print(f"{'directive':<18}{'iter':<8}{'rank':<8}{'seconds':<10}"
+          f"{'count':<8}once")
+    for d in ds:
+        print(f"{d.name:<18}{str(d.iteration):<8}{str(d.rank):<8}"
+              f"{str(d.seconds):<10}{str(d.count):<8}{d.once}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

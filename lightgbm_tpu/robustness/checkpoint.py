@@ -1,0 +1,391 @@
+"""Crash-consistent checkpoint/resume (docs/ROBUSTNESS.md).
+
+The reference writes a model snapshot every ``snapshot_freq`` iterations
+(gbdt.cpp:259-263) but leaves resumption to the user via continued
+training.  Here a snapshot is a *checkpoint*: the model text plus the
+engine state a bit-identical continuation needs (score vector, host RNG
+streams, objective state), each written via tmp-file + ``os.replace`` and
+sealed by a JSON manifest with content checksums — the manifest is written
+LAST, so its presence certifies a complete checkpoint and a crash mid-write
+can never produce a snapshot that validates.
+
+Layout for ``output_model=M`` at iteration ``N``::
+
+    M.snapshot_iter_N                 model text (LightGBM v4 format)
+    M.snapshot_iter_N.state.npz       score + RNG/objective state
+    M.snapshot_iter_N.manifest.json   iteration, params hash, checksums
+
+``lgb.train(..., resume_from=M.snapshot_iter_N)`` (CLI: ``resume=``)
+validates the manifest, loads the trees as the init model, restores the
+state, and continues from iteration N byte-identically to a run that was
+never interrupted.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import resolve_aliases
+from ..utils.log import LightGBMError, log_debug, log_info
+from . import chaos
+from .guards import check_model_trees
+
+MANIFEST_SUFFIX = ".manifest.json"
+STATE_SUFFIX = ".state.npz"
+FORMAT_VERSION = 1
+
+# params with no bearing on the trained model: IO paths, orchestration, and
+# observability knobs may differ between the checkpointing run and the
+# resuming run (e.g. CLI vs API) without breaking bit-identity
+_VOLATILE_PARAMS = frozenset({
+    "config", "task", "data", "valid", "num_iterations", "verbosity",
+    "input_model", "output_model", "output_result", "saved_feature_importance_type",
+    "snapshot_freq", "snapshot_keep", "resume_from", "save_binary",
+    "num_machines", "machines", "machine_list_filename", "local_listen_port",
+    "time_out", "dist_retries", "dist_backoff",
+    "telemetry", "telemetry_out", "trace_out", "telemetry_recompile_threshold",
+    "telemetry_straggler_every", "telemetry_straggler_skew",
+})
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write via a same-directory tmp file + fsync + ``os.replace`` so a
+    crash/preemption mid-write never leaves a partial file at ``path``."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# params identity
+# ---------------------------------------------------------------------------
+
+def canonical_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Alias-resolved params minus IO/orchestration keys, JSON-normalized
+    (numpy scalars -> python, everything non-JSON stringified)."""
+    resolved = resolve_aliases(dict(params or {}))
+    kept = {k: v for k, v in resolved.items() if k not in _VOLATILE_PARAMS}
+    return json.loads(json.dumps(kept, sort_keys=True, default=str))
+
+
+def params_hash(params: Optional[Dict[str, Any]]) -> str:
+    return _sha256_bytes(
+        json.dumps(canonical_params(params), sort_keys=True).encode())
+
+
+# ---------------------------------------------------------------------------
+# engine state capture / restore
+# ---------------------------------------------------------------------------
+
+def _pack_rng(prefix: str, rng, out: Dict[str, np.ndarray]) -> None:
+    name, keys, pos, has_gauss, cached = rng.get_state(legacy=True)
+    if name != "MT19937":  # pragma: no cover - numpy only has one legacy gen
+        raise LightGBMError(f"cannot checkpoint RNG of type {name}")
+    out[f"{prefix}__keys"] = np.asarray(keys, np.uint32)
+    out[f"{prefix}__meta"] = np.asarray([pos, has_gauss], np.int64)
+    out[f"{prefix}__gauss"] = np.asarray([cached], np.float64)
+
+
+def _unpack_rng(prefix: str, rng, state: Dict[str, np.ndarray]) -> None:
+    if f"{prefix}__keys" not in state:
+        return
+    meta = state[f"{prefix}__meta"]
+    rng.set_state(("MT19937", np.asarray(state[f"{prefix}__keys"], np.uint32),
+                   int(meta[0]), int(meta[1]),
+                   float(state[f"{prefix}__gauss"][0])))
+
+
+def _full_score_host(engine) -> np.ndarray:
+    """The PADDED global score as host numpy.  Multi-process global arrays
+    allgather their per-rank shards in rank-major row order (the global
+    layout) — every rank ends up with the same full copy, so rank 0 can
+    write it and every rank can restore it."""
+    score = engine.score
+    if getattr(engine, "_dist_mode", False):
+        from jax.experimental import multihost_utils
+        shards = sorted(score.addressable_shards,
+                        key=lambda sh: sh.index[0].start or 0)
+        local = np.concatenate([np.asarray(sh.data) for sh in shards])
+        full = np.asarray(multihost_utils.process_allgather(local))
+        return full.reshape((-1,) + tuple(score.shape[1:]))
+    return np.asarray(score)
+
+
+def capture_state(booster) -> Dict[str, np.ndarray]:
+    """Everything beyond the trees that a bit-identical continuation needs.
+    Collective-safe: in multi-process runs every rank must call this at the
+    same point (the score capture allgathers)."""
+    engine = booster.engine
+    engine._flush_models()
+    state: Dict[str, np.ndarray] = {
+        "score": np.asarray(_full_score_host(engine), np.float32)}
+    if getattr(engine, "_rng", None) is not None:
+        _pack_rng("rng_feature", engine._rng, state)
+    if getattr(engine, "_drop_rng", None) is not None:   # DART
+        _pack_rng("rng_drop", engine._drop_rng, state)
+    obj = engine.objective
+    if obj is not None:
+        if getattr(obj, "_rng", None) is not None:       # rank_xendcg
+            _pack_rng("rng_objective", obj._rng, state)
+        for a in obj.state_attrs():
+            v = getattr(obj, a, None)
+            if v is not None:
+                state[f"obj_state__{a}"] = np.asarray(v)
+    return state
+
+
+def restore_state(booster, state: Dict[str, np.ndarray]) -> None:
+    """Inverse of :func:`capture_state` on a freshly seeded engine (after
+    ``load_init_model``): the restored float32 score replaces the tree-walk
+    reconstruction so the resumed run's gradients are bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    engine = booster.engine
+    score = np.asarray(state["score"], np.float32)
+    if tuple(score.shape) != tuple(engine.score.shape):
+        raise LightGBMError(
+            f"checkpoint score shape {tuple(score.shape)} does not match this "
+            f"run's {tuple(engine.score.shape)} — dataset, num_class, or "
+            "process topology changed since the snapshot was written")
+    if getattr(engine, "_dist_mode", False):
+        engine.score = jax.make_array_from_callback(
+            score.shape, engine.score.sharding, lambda idx: score[idx])
+    else:
+        engine.score = engine._shard_row_array(jnp.asarray(score))
+    if getattr(engine, "_rng", None) is not None:
+        _unpack_rng("rng_feature", engine._rng, state)
+    if getattr(engine, "_drop_rng", None) is not None:
+        _unpack_rng("rng_drop", engine._drop_rng, state)
+    obj = engine.objective
+    if obj is not None:
+        if getattr(obj, "_rng", None) is not None:
+            _unpack_rng("rng_objective", obj._rng, state)
+        dist = getattr(engine, "_dist_mode", False)
+        for a in obj.state_attrs():
+            key = f"obj_state__{a}"
+            if key in state:
+                v = np.asarray(state[key])
+                setattr(obj, a, v if dist else jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# write
+# ---------------------------------------------------------------------------
+
+def snapshot_path(output_model: str, iteration: int) -> str:
+    return f"{output_model}.snapshot_iter_{iteration}"
+
+
+def write_checkpoint(booster, output_model: str, iteration: int,
+                     keep: int = -1) -> str:
+    """Write the iteration-``N`` checkpoint for ``output_model`` and prune
+    to the ``keep`` newest (``keep <= 0`` keeps all).  Multi-process: every
+    rank participates in state capture (collective), rank 0 writes."""
+    import jax
+
+    path = snapshot_path(str(output_model), int(iteration))
+    model_str = booster.model_to_string()
+    state = capture_state(booster)
+    if jax.process_index() != 0:
+        return path
+    atomic_write_text(path, model_str)
+    buf = io.BytesIO()
+    np.savez(buf, **state)
+    atomic_write_bytes(path + STATE_SUFFIX, buf.getvalue())
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "iteration": int(iteration),
+        "num_trees": booster.num_trees(),
+        "num_tree_per_iteration": booster.num_model_per_iteration(),
+        "model_file": os.path.basename(path),
+        "model_sha256": _sha256_bytes(model_str.encode("utf-8")),
+        "state_file": os.path.basename(path + STATE_SUFFIX),
+        "state_sha256": _sha256_file(path + STATE_SUFFIX),
+        "params_hash": params_hash(getattr(booster, "params", {})),
+        "params": canonical_params(getattr(booster, "params", {})),
+        "num_processes": jax.process_count(),
+        "created_unix": time.time(),
+    }
+    atomic_write_text(path + MANIFEST_SUFFIX,
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    chaos.maybe_truncate_snapshot(path, int(iteration))
+    if keep and keep > 0:
+        prune_snapshots(str(output_model), keep)
+    return path
+
+
+def prune_snapshots(output_model: str, keep: int) -> None:
+    for it, path in list_snapshots(output_model)[:-keep]:
+        for p in (path, path + STATE_SUFFIX, path + MANIFEST_SUFFIX):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        log_debug(f"pruned snapshot {path} (snapshot_keep={keep})")
+
+
+def list_snapshots(output_model: str) -> List[Tuple[int, str]]:
+    """(iteration, path) for every on-disk snapshot, oldest first."""
+    pat = re.compile(re.escape(os.path.basename(output_model))
+                     + r"\.snapshot_iter_(\d+)$")
+    out = []
+    for p in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
+        m = pat.match(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# validate / load
+# ---------------------------------------------------------------------------
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    mpath = path + MANIFEST_SUFFIX
+    if not os.path.exists(mpath):
+        raise LightGBMError(
+            f"checkpoint {path!r} has no manifest ({mpath} missing) — either "
+            "the file is not a checkpoint or its write never completed")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except ValueError as e:
+        raise LightGBMError(f"checkpoint manifest {mpath} is not valid "
+                            f"JSON: {e}")
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise LightGBMError(
+            f"checkpoint {path!r} has manifest format_version="
+            f"{manifest.get('format_version')!r}; this build reads "
+            f"{FORMAT_VERSION}")
+    return manifest
+
+
+def validate_checkpoint(path: str,
+                        params: Optional[Dict[str, Any]] = None,
+                        expect_processes: Optional[int] = None
+                        ) -> Dict[str, Any]:
+    """Full validation chain: manifest present, checksums match, model text
+    parses completely, trees are finite, tree count matches, and (when
+    ``params`` is given) the model-relevant params match the manifest's.
+    ``expect_processes`` is the topology the RESUMING job will have —
+    defaults to this process's world size; a supervisor validating on
+    behalf of a worker cohort passes the cohort size."""
+    return _validate_and_read(path, params, expect_processes)[0]
+
+
+def _validate_and_read(path: str, params: Optional[Dict[str, Any]],
+                       expect_processes: Optional[int]):
+    """validate_checkpoint's body, returning the verified model text too so
+    resume parses the exact bytes that were checksummed (no second read)."""
+    import jax
+    from ..model_io import load_model_string
+
+    path = str(path)
+    manifest = read_manifest(path)
+    if not os.path.exists(path):
+        raise LightGBMError(f"checkpoint model file missing: {path}")
+    model_str = open(path, encoding="utf-8").read()
+    if _sha256_bytes(model_str.encode("utf-8")) != manifest["model_sha256"]:
+        raise LightGBMError(
+            f"checkpoint {path!r} failed its content checksum — the model "
+            "file is truncated or corrupt; resume from an older snapshot")
+    lm = load_model_string(model_str)   # raises on truncated tree blocks
+    if len(lm.trees) != int(manifest["num_trees"]):
+        raise LightGBMError(
+            f"checkpoint {path!r} holds {len(lm.trees)} trees but its "
+            f"manifest recorded {manifest['num_trees']}")
+    check_model_trees(lm.trees, what=f"checkpoint {path!r}")
+    spath = path + STATE_SUFFIX
+    if not os.path.exists(spath):
+        raise LightGBMError(f"checkpoint state file missing: {spath}")
+    if _sha256_file(spath) != manifest["state_sha256"]:
+        raise LightGBMError(
+            f"checkpoint state {spath!r} failed its content checksum")
+    want_procs = (int(expect_processes) if expect_processes is not None
+                  else jax.process_count())
+    if int(manifest.get("num_processes", 1)) != want_procs:
+        raise LightGBMError(
+            f"checkpoint {path!r} was written by "
+            f"{manifest.get('num_processes')} process(es) but this run has "
+            f"{want_procs} — resume needs the same topology for "
+            "bit-identical continuation")
+    if params is not None:
+        want = canonical_params(params)
+        have = manifest.get("params", {})
+        if want != have:
+            diff = sorted(set(want) ^ set(have)
+                          | {k for k in set(want) & set(have)
+                             if want[k] != have[k]})
+            raise LightGBMError(
+                f"checkpoint {path!r} was written with different training "
+                f"parameters (differing keys: {', '.join(diff) or '?'}); "
+                "resume with the original params or pass params=None to "
+                "skip the check")
+    return manifest, model_str
+
+
+def load_checkpoint(path: str,
+                    params: Optional[Dict[str, Any]] = None
+                    ) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
+    """Validate and load: returns (model text, manifest, state arrays)."""
+    manifest, model_str = _validate_and_read(path, params, None)
+    with np.load(str(path) + STATE_SUFFIX) as z:
+        state = {k: z[k] for k in z.files}
+    return model_str, manifest, state
+
+
+def latest_valid_snapshot(output_model: str,
+                          params: Optional[Dict[str, Any]] = None,
+                          expect_processes: Optional[int] = None
+                          ) -> Optional[str]:
+    """Newest snapshot of ``output_model`` that passes full validation;
+    invalid/corrupt ones are skipped with a log line."""
+    for it, path in reversed(list_snapshots(output_model)):
+        try:
+            validate_checkpoint(path, params=params,
+                                expect_processes=expect_processes)
+            return path
+        except LightGBMError as e:
+            log_info(f"skipping invalid snapshot {path}: {e}")
+    return None

@@ -1,0 +1,117 @@
+"""Non-finite guards (param ``nan_guard``, docs/ROBUSTNESS.md).
+
+A single NaN gradient silently poisons every subsequent tree: the leaf sums
+go NaN, the split scan picks garbage, and the score vector never recovers.
+The GBDT loop runs one cheap jitted all-finite check over the gradient and
+hessian blocks each iteration and, when it trips, zeroes them — an all-zero
+gradient grows an exact single-leaf no-op tree, so the poisoned iteration
+is *skipped* without perturbing any later iteration's RNG streams.  The
+same policy knob covers loaded init scores and the split gains / leaf
+values of models used to seed continued training.
+
+Modes: ``warn`` (default — log + skip + count), ``skip`` (silent skip),
+``raise`` (abort with :class:`LightGBMError`), ``none`` (guard off).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_warning
+
+VALID_MODES = ("warn", "skip", "raise", "none")
+
+
+def resolve_mode(mode: str) -> str:
+    m = str(mode or "warn").strip().lower()
+    if m not in VALID_MODES:
+        raise LightGBMError(
+            f"nan_guard={mode!r} is not one of {', '.join(VALID_MODES)}")
+    return m
+
+
+class NanGuard:
+    """Per-engine guard state: counts poisoned iterations and applies the
+    configured policy.  Device flags from the fused TPU path are resolved
+    lazily (``defer=True``) so the guard never forces an extra host sync
+    on the one-launch fast path; ``raise`` mode always reads eagerly."""
+
+    def __init__(self, mode: str, objective_name: str = ""):
+        self.mode = resolve_mode(mode)
+        self.enabled = self.mode != "none"
+        self.objective_name = objective_name or "none"
+        self.hits = 0
+        self._pending: List[Tuple[int, object]] = []
+
+    def note(self, ok_dev, iteration: int, defer: bool = False) -> None:
+        """Record this iteration's device-side all-finite flag."""
+        if not self.enabled or ok_dev is None:
+            return
+        if defer and self.mode != "raise":
+            self._pending.append((iteration, ok_dev))
+            if len(self._pending) >= 64:
+                self.poll()
+            return
+        if not bool(ok_dev):
+            self._record(iteration)
+
+    def poll(self) -> None:
+        """Resolve deferred flags (called at the finished-flag polls and at
+        the end of training)."""
+        pending, self._pending = self._pending, []
+        for iteration, ok_dev in pending:
+            if not bool(ok_dev):
+                self._record(iteration)
+
+    def _record(self, iteration: int) -> None:
+        self.hits += 1
+        from .. import telemetry as _tel
+        _tel.inc("train/nan_skipped")
+        msg = (f"non-finite gradients/hessians at iteration {iteration + 1} "
+               f"(objective={self.objective_name})")
+        if self.mode == "raise":
+            raise LightGBMError(f"nan_guard=raise: {msg}")
+        if self.mode == "warn":
+            log_warning(f"nan_guard: {msg}; skipping the poisoned iteration")
+
+
+def check_finite_init(arr: np.ndarray, what: str,
+                      mode: str) -> Optional[np.ndarray]:
+    """Guard a loaded init-score array: non-finite entries are zeroed
+    (``warn``/``skip``) or fatal (``raise``); ``none`` passes through."""
+    mode = resolve_mode(mode)
+    if mode == "none" or arr is None:
+        return arr
+    a = np.asarray(arr)
+    bad = ~np.isfinite(a)
+    nbad = int(bad.sum())
+    if nbad == 0:
+        return arr
+    if mode == "raise":
+        raise LightGBMError(
+            f"nan_guard=raise: {what} contains {nbad} non-finite value(s)")
+    if mode == "warn":
+        log_warning(f"nan_guard: {what} contains {nbad} non-finite value(s); "
+                    "replacing with 0")
+    out = a.copy()
+    out[bad] = 0.0
+    return out
+
+
+def check_model_trees(trees, what: str = "model") -> None:
+    """Reject models with poisoned trees before they seed continued
+    training or a resume: NaN/inf leaf values or NaN split gains mean the
+    source run was already corrupt and every further tree would inherit
+    it.  (Thresholds may legitimately be +/-inf — last-bin boundaries.)"""
+    for i, t in enumerate(trees):
+        lv = np.asarray(t.leaf_value, np.float64)
+        if not np.all(np.isfinite(lv)):
+            raise LightGBMError(
+                f"non-finite leaf values in {what} (tree {i}); refusing to "
+                "continue training from a poisoned model")
+        sg = np.asarray(t.split_gain, np.float64)
+        if sg.size and np.any(np.isnan(sg)):
+            raise LightGBMError(
+                f"non-finite split gains in {what} (tree {i}); refusing to "
+                "continue training from a poisoned model")

@@ -1,0 +1,50 @@
+"""Per-worker heartbeat files for the supervising launcher.
+
+Each distributed worker touches a small JSON file after every boosting
+iteration; the supervisor (parallel/cluster.py) watches the files' mtimes
+and declares a worker hung when its beat goes stale — the analog of the
+reference Network layer's socket timeouts (``time_out``), but observable
+from OUTSIDE the process, which is what a supervisor needs when a worker
+is wedged inside a collective.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import chaos
+
+
+def write_heartbeat(path: str, iteration: int) -> None:
+    """Atomically (tmp + ``os.replace``) refresh the heartbeat file; the
+    supervisor keys off the file mtime, the payload is for humans."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"iteration": iteration, "time": time.time(),
+                   "pid": os.getpid()}, fh)
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def heartbeat_callback(path: str, every: int = 1) -> Callable:
+    """Training callback beating ``path`` every ``every`` iterations.
+
+    No beat is written before the first iteration completes: the first
+    iteration includes the full XLA compile, so an early beat would start
+    the supervisor's stale-mtime clock mid-compile and defeat its
+    ``startup_grace`` (which governs exactly as long as no file exists)."""
+    def _callback(env) -> None:
+        if every > 0 and (env.iteration + 1) % every == 0:
+            chaos.heartbeat_hook(env.iteration + 1)
+            write_heartbeat(path, env.iteration + 1)
+    _callback.order = 50  # type: ignore[attr-defined]
+    return _callback

@@ -6,5 +6,9 @@ cd "$(dirname "$0")/.."
 # telemetry first: cheapest suite, and a broken observability layer makes
 # every later perf triage lie
 python -m pytest tests/test_telemetry.py -x -q
+# robustness fast tier next: checkpoint/resume bit-identity and the chaos
+# guard paths protect every longer suite below from wasted reruns (the
+# multi-process kill/retry/hang cases are in the slow tier)
+python -m pytest tests/test_robustness.py -x -q -m 'not slow'
 python -m pytest tests/ -x -q
 python -m pytest tests/ -x -q -m slow
