@@ -440,6 +440,11 @@ def main():
     s_per_tree_full = s_per_tree * scale
     vs_baseline = BASELINE_S_PER_TREE / s_per_tree_full
 
+    # quality gate evaluated HERE, on the exact model the s/tree headline
+    # measured — the BENCH_RESUME block below trains further iterations
+    # and must not get the chance to mask a quality regression
+    auc = auc_score(y_te, bst.predict(X_te, raw_score=True))
+
     resume_ok = True
     if os.environ.get("BENCH_RESUME", "") == "1":
         # checkpoint-write overhead at snapshot_freq=10 as % of iteration
@@ -477,7 +482,6 @@ def main():
             "vs_baseline": None,
         }), flush=True)
 
-    auc = auc_score(y_te, bst.predict(X_te, raw_score=True))
     if auc < AUC_GATE:
         print(json.dumps({
             "metric": "higgs_like_train_s_per_tree_10p5M_rows",
@@ -499,7 +503,124 @@ def main():
     return resume_ok
 
 
+def run_serve_bench():
+    """BENCH_SERVE=1: loopback serving throughput — sustained QPS and
+    client-side p50/p99 latency over concurrent mixed-size requests, with
+    a zero-recompiles-after-warmup gate (the telemetry watchdog counters
+    must not move during the timed window) and an exactness gate (served
+    scores bitwise equal Booster.predict)."""
+    import http.client
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ServingApp
+    from lightgbm_tpu.telemetry import recompile_counts
+
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 200_000))
+    iters = int(os.environ.get("BENCH_SERVE_MODEL_ITERS", 50))
+    secs = float(os.environ.get("BENCH_SERVE_SECS", 5.0))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    X, y = make_higgs_like(rows, N_FEATURES)
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "learning_rate": 0.1, "max_bin": 63, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=iters)
+    td = tempfile.mkdtemp(prefix="lgb_bench_serve_")
+    model_path = os.path.join(td, "model.txt")
+    bst.save_model(model_path)
+    app = ServingApp(model_path, port=0, max_batch=256, max_delay_ms=2.0,
+                     queue_size=1024).start()
+    ref = lgb.Booster(model_file=model_path)
+    sizes = [1, 4, 16, 64]
+    body_cache = {m: json.dumps({"rows": X[:m].tolist(),
+                                 "raw_score": True}) for m in sizes}
+
+    def post(conn, body):
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+
+    # ---- warmup: cover every bucket through the full HTTP path, then
+    # pin the watchdog counters
+    warm = http.client.HTTPConnection(app.host, app.port, timeout=30)
+    exact = True
+    for m in sizes:
+        st, obj = post(warm, body_cache[m])
+        exact &= (st == 200 and np.array_equal(
+            np.asarray(obj["predictions"]),
+            ref.predict(X[:m], raw_score=True)))
+    warm.close()
+    compiles0 = recompile_counts().get("serve_predict", 0)
+
+    stop = threading.Event()
+    lat_ms, errors = [], [0]
+    lock = threading.Lock()
+
+    def client(seed):
+        rs = np.random.RandomState(seed)
+        conn = http.client.HTTPConnection(app.host, app.port, timeout=30)
+        local = []
+        while not stop.is_set():
+            body = body_cache[sizes[rs.randint(len(sizes))]]
+            t0 = time.perf_counter()
+            try:
+                st, _ = post(conn, body)
+                if st != 200:
+                    with lock:
+                        errors[0] += 1
+                    continue
+            except (OSError, http.client.HTTPException, ValueError):
+                # any transport/parse failure must fail the gate, not
+                # silently kill this client thread
+                with lock:
+                    errors[0] += 1
+                break
+            local.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
+        with lock:
+            lat_ms.extend(local)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(secs)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    elapsed = time.time() - t0
+    app.shutdown(drain=True)
+    compiles1 = recompile_counts().get("serve_predict", 0)
+
+    qps = len(lat_ms) / max(elapsed, 1e-9)
+    p50 = float(np.percentile(lat_ms, 50)) if lat_ms else float("inf")
+    p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float("inf")
+    no_recompiles = compiles1 == compiles0
+    ok = no_recompiles and exact and errors[0] == 0 and len(lat_ms) > 0
+    print(json.dumps({
+        "metric": "serve_loopback_qps",
+        "value": round(qps, 1),
+        "unit": (f"req/s over {elapsed:.1f}s, {clients} clients, mixed "
+                 f"sizes {sizes}, {iters} trees "
+                 f"({'OK' if ok else 'FAIL'}: recompiles_after_warmup="
+                 f"{compiles1 - compiles0}, errors={errors[0]}, "
+                 f"exact={exact})"),
+        "vs_baseline": None,
+    }), flush=True)
+    print(json.dumps({
+        "metric": "serve_latency_ms",
+        "value": round(p50, 3),
+        "unit": f"p50 ms client-side (p99 {p99:.3f} ms)",
+        "vs_baseline": None,
+    }), flush=True)
+    return ok
+
+
 if __name__ == "__main__":
+    if os.environ.get("BENCH_SERVE", "") == "1":
+        sys.exit(0 if run_serve_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
     if task not in ("", "higgs", "ranking", "multiclass"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "

@@ -1348,12 +1348,17 @@ class Booster:
         the pre-packed trees in native code with no device dispatch (the
         output transform is the objective's NumPy twin)."""
         from .predict_fast import SingleRowFastPredictor
-        use, k, _, _ = self._resolve_tree_slice(start_iteration,
-                                                num_iteration)
+        use, k, start, end = self._resolve_tree_slice(start_iteration,
+                                                      num_iteration)
         avg = (1.0 / max(len(use) // max(k, 1), 1)
                if self._average_output() and len(use) else 1.0)
         conv = None if raw_score else self._convert_output_np_fn()
-        return SingleRowFastPredictor(use, k, self.num_feature(), avg, conv)
+        # the resolved window (best_iteration fallback applied) forwards to
+        # the predictor, which owns the slicing — one implementation
+        return SingleRowFastPredictor(self._all_trees(), k,
+                                      self.num_feature(), avg, conv,
+                                      start_iteration=start,
+                                      num_iteration=end - start)
 
     def _single_row_fast_cached(self, use, start_iteration, end_iteration, k):
         """Internal predict() fast path: averaging/conversion stay in the

@@ -223,9 +223,13 @@ def run_predict(params: Dict[str, Any]) -> None:
     pred2 = np.atleast_2d(np.asarray(pred))
     if pred2.shape[0] == 1 and np.asarray(pred).ndim == 1:
         pred2 = pred2.T
-    with open(out, "w") as fh:
-        for row in pred2:
-            fh.write("\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n")
+    # tmp + os.replace (the robustness checkpoint helper, streaming so a
+    # many-million-row output never materializes in RAM): a killed predict
+    # job never leaves a truncated result file behind
+    from .robustness.checkpoint import atomic_write_lines
+    atomic_write_lines(out, (
+        "\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n"
+        for row in pred2))
     log_info(f"Finished prediction; results saved to {out}")
 
 
@@ -295,6 +299,10 @@ def main(argv=None) -> int:
         run_save_binary(params)
     elif task == "convert_model":
         run_convert_model(params)
+    elif task == "serve":
+        # online inference server (docs/SERVING.md); blocks until SIGTERM
+        from .serving.server import run_server
+        return run_server(params)
     else:
         raise LightGBMError(f"unknown task {task!r}")
     return 0

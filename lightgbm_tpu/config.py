@@ -183,6 +183,15 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "nan_guard": ["nan_policy"],
     "dist_retries": [],
     "dist_backoff": [],
+    # --- online serving (docs/SERVING.md) ---
+    "serve_host": ["serving_host"],
+    "serve_port": ["serving_port"],
+    "serve_max_batch": ["serve_batch_size"],
+    "serve_max_delay_ms": ["serve_batch_delay_ms"],
+    "serve_queue_size": [],
+    "serve_buckets": ["serve_bucket_ladder"],
+    "serve_warmup": [],
+    "serve_heartbeat": ["serve_heartbeat_file"],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -454,6 +463,28 @@ class Config:
     dist_retries: int = 0
     # seconds before the first cohort relaunch (doubles each retry)
     dist_backoff: float = 2.0
+
+    # --- online serving (docs/SERVING.md) ---
+    # bind address of the JSON serving front end (python -m lightgbm_tpu.serve)
+    serve_host: str = "127.0.0.1"
+    # listen port; 0 picks an ephemeral port (printed at startup)
+    serve_port: int = 12600
+    # micro-batcher: max coalesced rows per device dispatch
+    serve_max_batch: int = 256
+    # micro-batcher: max milliseconds a request waits for batch-mates
+    serve_max_delay_ms: float = 2.0
+    # admission control: requests beyond this queue depth are rejected
+    # with a structured overload response instead of buffered unboundedly
+    serve_queue_size: int = 512
+    # explicit row-count bucket ladder, e.g. "8,32,128" ("" = powers of
+    # two from 8 up to serve_max_batch); batches pad to the next bucket so
+    # every post-warmup dispatch reuses an already-traced XLA program
+    serve_buckets: str = ""
+    # pre-trace every bucket at model load, before the version swap
+    serve_warmup: bool = True
+    # heartbeat file the batch worker touches after every dispatch
+    # (robustness liveness probe; "" = off)
+    serve_heartbeat: str = ""
 
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records

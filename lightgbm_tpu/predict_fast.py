@@ -21,14 +21,32 @@ import numpy as np
 
 
 class SingleRowFastPredictor:
-    """Pre-bound predictor; call with one raw feature row."""
+    """Pre-bound predictor; call with one raw feature row.
+
+    ``start_iteration``/``num_iteration`` slice the model at PRE-BIND
+    time (reference: the FastConfig carries the iteration window, so the
+    per-call walk never re-slices): ``trees`` is the full iteration-major
+    list and the window is cut here.  The ``best_iteration`` fallback for
+    ``num_iteration=None`` is Booster knowledge and stays in
+    ``Booster.predict_single_row_fast_init``."""
 
     def __init__(self, trees: List, num_class: int, num_features: int,
-                 average_factor: float = 1.0, convert_fn=None):
+                 average_factor: float = 1.0, convert_fn=None,
+                 start_iteration: int = 0,
+                 num_iteration: Optional[int] = None):
         self.num_class = int(num_class)
         self.num_features = int(num_features)
         self.average_factor = float(average_factor)
         self.convert_fn = convert_fn
+        k = max(self.num_class, 1)
+        if start_iteration or (num_iteration is not None
+                               and num_iteration > 0):
+            n_total = len(trees) // k
+            start = max(int(start_iteration), 0)
+            end = (min(start + int(num_iteration), n_total)
+                   if num_iteration is not None and num_iteration > 0
+                   else n_total)
+            trees = trees[start * k:end * k]
         self._trees = trees      # NumPy fallback path
         self._has_linear = any(getattr(t, "is_linear", False) for t in trees)
 
@@ -92,6 +110,14 @@ class SingleRowFastPredictor:
         """Raw scores (num_class,) for one row; no output transform.
         Thread-safe: per-call buffers, the packed model arrays are only
         read."""
+        row = np.asarray(row, np.float64).reshape(-1)
+        if row.shape[0] != self.num_features:
+            # the native walk indexes row[split_feature] unchecked — a
+            # short row would read past the buffer
+            from .basic import LightGBMError
+            raise LightGBMError(
+                f"single-row predict expects {self.num_features} features, "
+                f"got {row.shape[0]}")
         if self._lib is not None:
             rb = np.ascontiguousarray(row, np.float64)
             ob = np.zeros(self.num_class, np.float64)
@@ -115,13 +141,7 @@ class SingleRowFastPredictor:
         return score * self.average_factor
 
     def __call__(self, row, raw_score: bool = False):
-        row = np.asarray(row, np.float64).reshape(-1)
-        if len(row) != self.num_features:
-            from .basic import LightGBMError
-            raise LightGBMError(
-                f"single-row predict expects {self.num_features} features, "
-                f"got {len(row)}")
-        score = self.raw_predict(row)
+        score = self.raw_predict(row)   # validates the row length
         if not raw_score and self.convert_fn is not None:
             score = np.asarray(self.convert_fn(score))
         return score if self.num_class > 1 else float(score[0])

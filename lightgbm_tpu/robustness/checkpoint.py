@@ -53,6 +53,8 @@ _VOLATILE_PARAMS = frozenset({
     "time_out", "dist_retries", "dist_backoff",
     "telemetry", "telemetry_out", "trace_out", "telemetry_recompile_threshold",
     "telemetry_straggler_every", "telemetry_straggler_skew",
+    "serve_host", "serve_port", "serve_max_batch", "serve_max_delay_ms",
+    "serve_queue_size", "serve_buckets", "serve_warmup", "serve_heartbeat",
 })
 
 
@@ -82,6 +84,28 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
 
 def atomic_write_text(path: str, text: str) -> None:
     atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_lines(path: str, lines) -> None:
+    """Streaming variant: writes an iterable of text chunks straight to
+    the same-directory tmp file (constant memory — CLI predict outputs
+    can be GBs) before the fsync + ``os.replace``."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for chunk in lines:
+                fh.write(chunk)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _sha256_bytes(data: bytes) -> str:
@@ -232,7 +256,8 @@ def write_checkpoint(booster, output_model: str, iteration: int,
     atomic_write_text(path, model_str)
     buf = io.BytesIO()
     np.savez(buf, **state)
-    atomic_write_bytes(path + STATE_SUFFIX, buf.getvalue())
+    state_bytes = buf.getvalue()
+    atomic_write_bytes(path + STATE_SUFFIX, state_bytes)
     manifest = {
         "format_version": FORMAT_VERSION,
         "iteration": int(iteration),
@@ -241,7 +266,9 @@ def write_checkpoint(booster, output_model: str, iteration: int,
         "model_file": os.path.basename(path),
         "model_sha256": _sha256_bytes(model_str.encode("utf-8")),
         "state_file": os.path.basename(path + STATE_SUFFIX),
-        "state_sha256": _sha256_file(path + STATE_SUFFIX),
+        # hash the in-memory bytes: re-reading the multi-MB npz it just
+        # wrote would be a redundant full-file read on the training path
+        "state_sha256": _sha256_bytes(state_bytes),
         "params_hash": params_hash(getattr(booster, "params", {})),
         "params": canonical_params(getattr(booster, "params", {})),
         "num_processes": jax.process_count(),

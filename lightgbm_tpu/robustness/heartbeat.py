@@ -27,6 +27,16 @@ def write_heartbeat(path: str, iteration: int) -> None:
     os.replace(tmp, path)
 
 
+def heartbeat_age(path: str) -> Optional[float]:
+    """Seconds since the heartbeat file was last touched (mtime — the
+    field supervisors and the serving ``/health`` probe key off), or
+    ``None`` when no beat has been written yet."""
+    try:
+        return max(time.time() - os.path.getmtime(path), 0.0)
+    except OSError:
+        return None
+
+
 def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
     try:
         with open(path) as fh:

@@ -1,0 +1,33 @@
+"""Online serving launcher: ``python -m lightgbm_tpu.serve``.
+
+Same ``key=value`` grammar as the training CLI (config files compose the
+same way), e.g.::
+
+    python -m lightgbm_tpu.serve input_model=model.txt serve_port=12600 \\
+        serve_max_batch=256 serve_max_delay_ms=2
+
+Equivalent to ``python -m lightgbm_tpu task=serve ...``; see
+docs/SERVING.md for endpoints and tuning.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__)
+        return 1
+    from .cli import _coerce, parse_args
+    from .config import resolve_aliases
+    from .serving.server import run_server
+
+    params = _coerce(resolve_aliases(parse_args(list(argv))))
+    params.setdefault("task", "serve")
+    return run_server(params)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

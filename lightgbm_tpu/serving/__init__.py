@@ -1,0 +1,29 @@
+"""Online inference serving (docs/SERVING.md).
+
+Four layers on top of the trained-model stack:
+
+  * :mod:`.registry` — versioned, pre-bound models with validated atomic
+    hot-reload (sha256 manifest + model_io corruption checks + finite
+    guard) and drain-by-reference swaps;
+  * :mod:`.compiled` — the shape-bucketed compiled predictor: batches pad
+    to a fixed row-count ladder so every post-warmup dispatch reuses an
+    already-traced XLA program, while exact integer-key comparisons keep
+    scores bitwise identical to ``Booster.predict``;
+  * :mod:`.batcher` — dynamic micro-batching under
+    ``serve_max_batch``/``serve_max_delay_ms`` with admission control
+    (structured overload rejection) and a native single-row fast path;
+  * :mod:`.server` — the stdlib-HTTP JSON front end
+    (``/predict /health /reload /stats``) with graceful SIGTERM drain,
+    launched via ``python -m lightgbm_tpu.serve`` or CLI ``task=serve``.
+"""
+from .batcher import MicroBatcher, OverloadError, PredictResult
+from .compiled import CompiledPredictor, bucket_ladder
+from .registry import ModelRegistry, ServingModel
+from .server import ServingApp, run_server, serve_from_params
+
+__all__ = [
+    "CompiledPredictor", "bucket_ladder",
+    "ModelRegistry", "ServingModel",
+    "MicroBatcher", "OverloadError", "PredictResult",
+    "ServingApp", "run_server", "serve_from_params",
+]

@@ -1,0 +1,265 @@
+"""Dynamic micro-batching: coalesce requests into one device dispatch.
+
+Clipper-style adaptive batching (Crankshaw et al., NSDI 2017): a bounded
+queue feeds one worker thread that drains whatever arrived, keeps
+pulling until ``serve_max_batch`` rows are gathered or the oldest
+request's ``serve_max_delay_ms`` deadline expires, and runs ONE bucketed
+device call for the coalesced matrix.  Per-request tails (averaging +
+output transform) are applied to each request's row slice, so every
+response is bitwise identical to predicting that request alone.
+
+Two escape hatches keep tail latency honest:
+
+  * **singleton fast path** — ``submit(..., fast=True)`` executes a
+    one-row request synchronously on the caller thread through the
+    pre-bound :class:`SingleRowFastPredictor` native walk (no queue wait,
+    no device dispatch) — the latency-critical path of the reference's
+    ``LGBM_BoosterPredictForMatSingleRowFast``;
+  * **admission control** — a full queue rejects immediately with a
+    structured :class:`OverloadError` (HTTP 503 upstream) instead of
+    buffering unboundedly; shedding at the door keeps the p99 of
+    admitted requests bounded.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_debug, log_warning
+from .registry import ModelRegistry, ServingModel
+
+# value-histogram bounds for batch-size / queue-depth distributions
+DEPTH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class OverloadError(LightGBMError):
+    """Queue-full rejection carrying the structured overload payload."""
+
+    def __init__(self, queue_depth: int, queue_size: int):
+        self.queue_depth = int(queue_depth)
+        self.queue_size = int(queue_size)
+        super().__init__(
+            f"serving queue full ({self.queue_depth}/{self.queue_size} "
+            "requests); retry with backoff")
+
+    def payload(self) -> Dict[str, Any]:
+        return {"error": "overload", "queue_depth": self.queue_depth,
+                "queue_size": self.queue_size}
+
+
+@dataclass
+class PredictResult:
+    """What a resolved request future carries."""
+    values: np.ndarray       # converted (or raw) scores for this request
+    model_version: int       # the version that actually scored it
+    batched_rows: int        # total rows of the coalesced dispatch
+    queue_wait_s: float      # enqueue -> dispatch latency
+
+
+@dataclass
+class _Request:
+    rows: np.ndarray
+    raw_score: bool
+    future: Future = field(default_factory=Future)
+    t_enqueue: float = field(default_factory=time.perf_counter)
+
+
+class MicroBatcher:
+    """Bounded queue + one coalescing worker thread over a registry."""
+
+    def __init__(self, registry: ModelRegistry, *, max_batch: int = 256,
+                 max_delay_ms: float = 2.0, queue_size: int = 512,
+                 heartbeat_path: str = ""):
+        self.registry = registry
+        self.max_batch = max(int(max_batch), 1)
+        self.max_delay_s = max(float(max_delay_ms), 0.0) / 1e3
+        self.queue_size = max(int(queue_size), 1)
+        self.heartbeat_path = str(heartbeat_path or "")
+        self._q: "queue.Queue[_Request]" = queue.Queue(self.queue_size)
+        self._stop = threading.Event()
+        # serializes enqueue against stop(): _stop is SET under this lock
+        # and checked under it before every put, so no request can enter
+        # the queue after the drain decision — the worker only exits once
+        # _stop is set AND the queue is empty, so everything admitted
+        # before the flag is guaranteed to be served
+        self._submit_lock = threading.Lock()
+        self._drain = True
+        self._worker: Optional[threading.Thread] = None
+        self.batches = 0
+        self.served = 0
+        self.rejected = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._worker is None or not self._worker.is_alive():
+            self._stop.clear()
+            self._worker = threading.Thread(target=self._run,
+                                            name="lgbtpu-serve-batcher",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful stop: with ``drain`` the worker finishes everything
+        already queued before exiting (SIGTERM semantics); without it,
+        queued futures are cancelled."""
+        self._drain = bool(drain)
+        with self._submit_lock:
+            self._stop.set()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+        if not drain:
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                req.future.cancel()
+
+    @property
+    def worker_alive(self) -> bool:
+        return self._worker is not None and self._worker.is_alive()
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, rows, raw_score: bool = False,
+               fast: bool = False) -> "Future[PredictResult]":
+        """Enqueue one request; returns a Future resolving to
+        :class:`PredictResult`.  Raises :class:`OverloadError` at once
+        when the queue is full, :class:`LightGBMError` on shape errors."""
+        from .. import telemetry
+
+        model = self.registry.current()
+        X = model.validate_rows(rows)
+        if self._stop.is_set():
+            raise OverloadError(self._q.qsize(), self.queue_size)
+        if fast and X.shape[0] == 1:
+            # latency-critical singleton: pre-bound native walk, caller
+            # thread, zero queueing — still version-stamped
+            t0 = time.perf_counter()
+            values = model.predict(X, raw_score=raw_score)
+            telemetry.observe("serve/latency_s",
+                              time.perf_counter() - t0)
+            telemetry.inc("serve/requests_fast")
+            self.served += 1
+            fut: "Future[PredictResult]" = Future()
+            fut.set_result(PredictResult(values, model.version, 1, 0.0))
+            return fut
+        req = _Request(np.ascontiguousarray(X), bool(raw_score))
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise OverloadError(self._q.qsize(), self.queue_size)
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.rejected += 1
+                telemetry.inc("serve/rejected")
+                raise OverloadError(self._q.qsize(), self.queue_size)
+        telemetry.observe("serve/queue_depth", float(self._q.qsize()),
+                          bounds=DEPTH_BOUNDS)
+        return req.future
+
+    # -- worker ------------------------------------------------------------
+    def _collect(self) -> List[_Request]:
+        """One coalescing round: block for the first request, then gather
+        batch-mates until the row budget or the delay deadline."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        rows = first.rows.shape[0]
+        deadline = time.perf_counter() + self.max_delay_s
+        while rows < self.max_batch:
+            left = deadline - time.perf_counter()
+            try:
+                nxt = (self._q.get_nowait() if left <= 0
+                       else self._q.get(timeout=left))
+            except queue.Empty:
+                break
+            batch.append(nxt)
+            rows += nxt.rows.shape[0]
+            if left <= 0:
+                break
+        return batch
+
+    def _process(self, batch: List[_Request]) -> None:
+        from .. import telemetry
+
+        model = self.registry.current()   # pinned for the WHOLE batch
+        good = [r for r in batch
+                if r.rows.shape[1] == model.num_features]
+        for r in batch:
+            if r.rows.shape[1] != model.num_features:
+                # the model was hot-swapped to a different feature count
+                # between submit-time validation and dispatch
+                r.future.set_exception(LightGBMError(
+                    f"model v{model.version} expects "
+                    f"{model.num_features} features, request has "
+                    f"{r.rows.shape[1]}"))
+        if not good:
+            return
+        t0 = time.perf_counter()
+        X = (good[0].rows if len(good) == 1
+             else np.concatenate([r.rows for r in good], axis=0))
+        n = X.shape[0]
+        if n == 1 and len(good) == 1:
+            # a lone singleton skips the device: native single-row walk
+            values = model.predict(good[0].rows, raw_score=good[0].raw_score)
+            good[0].future.set_result(PredictResult(
+                values, model.version, 1,
+                t0 - good[0].t_enqueue))
+        else:
+            raw = model.raw_scores(X)
+            off = 0
+            for r in good:
+                m = r.rows.shape[0]
+                r.future.set_result(PredictResult(
+                    model.finish(raw[off:off + m], r.raw_score),
+                    model.version, n, t0 - r.t_enqueue))
+                off += m
+        dt = time.perf_counter() - t0
+        self.batches += 1
+        self.served += len(good)
+        telemetry.inc("serve/requests", len(good))
+        telemetry.inc("serve/rows", n)
+        telemetry.inc("serve/batches")
+        telemetry.observe("serve/dispatch_s", dt)
+        telemetry.observe("serve/batch_rows", float(n),
+                          bounds=DEPTH_BOUNDS)
+        for r in good:
+            telemetry.observe("serve/latency_s",
+                              time.perf_counter() - r.t_enqueue)
+        if self.heartbeat_path:
+            from ..robustness.heartbeat import write_heartbeat
+            try:
+                write_heartbeat(self.heartbeat_path, self.batches)
+            except OSError as e:   # liveness file must never kill serving
+                log_debug(f"serve heartbeat write failed: {e}")
+
+    def _run(self) -> None:
+        while True:
+            if self._stop.is_set() and (not self._drain or self._q.empty()):
+                break
+            batch: List[_Request] = []
+            try:
+                batch = self._collect()
+                if batch:
+                    self._process(batch)
+            except BaseException as e:  # noqa: BLE001 — worker must survive
+                log_warning(f"serve batcher error: {type(e).__name__}: {e}")
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            e if isinstance(e, LightGBMError)
+                            else LightGBMError(f"serving failure: {e}"))
+        log_debug("serve batcher worker exited")

@@ -1,0 +1,298 @@
+"""Shape-bucketed compiled predictor: one traced XLA program per bucket.
+
+Serving traffic arrives in arbitrary batch sizes; tracing a fresh XLA
+program per size would turn every odd-shaped request into a multi-second
+compile stall (the exact failure mode the telemetry recompile watchdog
+exists to catch).  Incoming batches are therefore padded to a fixed
+ladder of row-count buckets (powers of two by default, capped at
+``serve_max_batch``) so after one warmup pass every dispatch hits an
+already-compiled program — Clipper-style (Crankshaw et al., NSDI 2017)
+"compile once per shape, amortize forever".
+
+Bit-identity with ``Booster.predict`` is non-negotiable for serving (a
+hot-reload A/B must never change scores), but the device is float32 and
+model thresholds are float64.  The walk therefore never compares floats
+on device: each float64 value ``v`` is mapped on the host to a MONOTONE
+64-bit integer key (sign-flip trick: ``bits ^ (bits < 0 ? ~0 : 1<<63)``,
+with -0.0 normalized to +0.0) carried as two uint32 lanes, and ``v <=
+threshold`` becomes an exact lexicographic integer compare.  The device
+returns leaf INDICES only; leaf values are gathered and accumulated on
+the host in float64 in the same tree order as the host batch path, so
+serving scores are bitwise equal to ``Booster.predict`` — asserted by
+tests/test_serving.py.
+
+Missing handling mirrors tree.py ``predict_raw`` exactly: NaN rows carry
+a host-computed mask; the ``zero_as_missing`` band ``|v| < 1e-35`` is an
+exact key-range test; categorical values use a host-truncated int32 and
+the model's category bitset words.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import LightGBMError
+
+# monotone keys of +/-1e-35 — the reference's kZeroThreshold band used by
+# zero-as-missing routing (tree.py predict_raw: np.abs(v) < 1e-35)
+def _key64(v: np.ndarray) -> np.ndarray:
+    """Float64 -> monotone uint64 key; total order matches <= on reals
+    (±0 collapse to +0 first so the two zeros compare equal)."""
+    v = np.ascontiguousarray(np.where(v == 0.0, 0.0, v), np.float64)
+    b = v.view(np.uint64)
+    return np.where(b >> np.uint64(63), ~b, b | np.uint64(1 << 63))
+
+
+def _split_key(key: np.ndarray):
+    return ((key >> np.uint64(32)).astype(np.uint32),
+            (key & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+_ZLO = _split_key(_key64(np.asarray([-1e-35])))   # ([hi], [lo]) of -1e-35
+_ZHI = _split_key(_key64(np.asarray([1e-35])))
+_ZLO = (int(_ZLO[0][0]), int(_ZLO[1][0]))
+_ZHI = (int(_ZHI[0][0]), int(_ZHI[1][0]))
+
+
+class PackedServingTrees(NamedTuple):
+    """Model arrays rectangularized to (T, M) for the jitted walk; passed
+    as traced ARGUMENTS (not closure constants) so a hot-reloaded model of
+    the same shape reuses the compiled program."""
+    split_feature: object   # (T, M) i32
+    thr_hi: object          # (T, M) u32 — monotone key lanes of threshold
+    thr_lo: object          # (T, M) u32
+    decision_type: object   # (T, M) i32 — LightGBM bits (cat/dleft/missing)
+    left_child: object      # (T, M) i32
+    right_child: object     # (T, M) i32
+    cat_ord: object         # (T, M) i32 — row into cat_words, -1 numeric
+    cat_words: object       # (C, W) u32 — per-cat-node bitset words
+
+
+def _walk_impl(pack: PackedServingTrees, keys_hi, keys_lo, nan_mask, iv,
+               max_depth: int):
+    """(T, n) leaf index per tree per row — integer ops only."""
+    import jax
+    import jax.numpy as jnp
+
+    n = keys_hi.shape[0]
+    W = pack.cat_words.shape[1]
+    rows = jnp.arange(n)
+
+    def lex_le(ahi, alo, bhi, blo):
+        return (ahi < bhi) | ((ahi == bhi) & (alo <= blo))
+
+    def lex_lt(ahi, alo, bhi, blo):
+        return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+    zlo_hi = jnp.uint32(_ZLO[0])
+    zlo_lo = jnp.uint32(_ZLO[1])
+    zhi_hi = jnp.uint32(_ZHI[0])
+    zhi_lo = jnp.uint32(_ZHI[1])
+
+    def one_tree(tf):
+        sf, thi, tlo, dt, lc, rc, co = tf
+
+        def step(_, node):
+            active = node >= 0
+            ni = jnp.maximum(node, 0)
+            f = sf[ni]
+            khi = keys_hi[rows, f]
+            klo = keys_lo[rows, f]
+            isn = nan_mask[rows, f]
+            cv = iv[rows, f]
+            d = dt[ni]
+            is_cat = (d & 1) != 0
+            def_left = (d & 2) != 0
+            zero_missing = ((d >> 2) & 3) == 1
+            le = lex_le(khi, klo, thi[ni], tlo[ni])
+            near_zero = (lex_lt(zlo_hi, zlo_lo, khi, klo)
+                         & lex_lt(khi, klo, zhi_hi, zhi_lo))
+            miss = isn | (zero_missing & near_zero)
+            word = cv >> 5
+            row_ix = co[ni]
+            cvalid = (cv >= 0) & (word < W) & (row_ix >= 0)
+            w = pack.cat_words[jnp.maximum(row_ix, 0),
+                               jnp.clip(word, 0, W - 1)]
+            bit = (w >> (cv & 31).astype(jnp.uint32)) & jnp.uint32(1)
+            gl_cat = cvalid & (bit == 1)
+            go_left = jnp.where(is_cat, gl_cat,
+                                jnp.where(miss, def_left, le))
+            nxt = jnp.where(go_left, lc[ni], rc[ni])
+            return jnp.where(active, nxt, node)
+
+        node = jax.lax.fori_loop(0, max_depth, step, jnp.zeros(n, jnp.int32))
+        # trivial/padded trees loop on node 0 forever: resolve to leaf 0,
+        # matching the host path's single-leaf output (tree.py:113)
+        return jnp.where(node < 0, ~node, 0)
+
+    return jax.lax.map(one_tree, tuple(pack[:7]))
+
+
+_serve_walk = None   # lazily-built watched_jit (import must stay jax-free)
+
+
+def _get_walk():
+    global _serve_walk
+    if _serve_walk is None:
+        from ..telemetry import watched_jit
+        # buckets legitimately re-specialize per ladder shape: count traces
+        # for the zero-recompiles-after-warmup gate without warning
+        _serve_walk = watched_jit(_walk_impl, name="serve_predict",
+                                  warn_after=0,
+                                  static_argnames=("max_depth",))
+    return _serve_walk
+
+
+def bucket_ladder(max_batch: int, spec: str = "",
+                  floor: int = 8) -> List[int]:
+    """Row-count buckets, ascending.  Default: powers of two from
+    ``floor`` up to (and including) the next power >= max_batch; an
+    explicit comma ``spec`` overrides the whole ladder."""
+    if spec and str(spec).strip():
+        try:
+            out = sorted({int(tok) for tok in str(spec).split(",")
+                          if str(tok).strip()})
+        except ValueError:
+            raise LightGBMError(f"serve_buckets={spec!r} must be a "
+                                "comma-separated list of integers")
+        if not out or out[0] < 1:
+            raise LightGBMError(f"serve_buckets={spec!r} must list "
+                                "positive row counts")
+        return out
+    cap = max(int(max_batch), floor)
+    out, b = [], floor
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(b)   # first power of two >= cap
+    return out
+
+
+class CompiledPredictor:
+    """Pre-packed model + bucket ladder; every call pads to a bucket and
+    dispatches one already-traced program, then finishes on the host."""
+
+    def __init__(self, trees: Sequence, num_class: int, num_features: int,
+                 max_batch: int = 256, buckets: Optional[Sequence[int]] = None):
+        for t in trees:
+            if getattr(t, "is_linear", False):
+                # linear leaves need raw-feature dot products in float64 —
+                # host path (registry falls back to Booster.predict)
+                raise LightGBMError(
+                    "linear trees are not supported by the compiled "
+                    "serving predictor")
+        self.num_class = int(num_class)
+        self.num_features = int(num_features)
+        self.buckets = (sorted(int(b) for b in buckets) if buckets
+                        else bucket_ladder(max_batch))
+        self._leaf_values = [np.asarray(t.leaf_value, np.float64)
+                             for t in trees]
+        nt = len(trees)
+        M = max(max((t.num_leaves - 1 for t in trees), default=0), 1)
+
+        sf = np.zeros((nt, M), np.int32)
+        thr = np.zeros((nt, M), np.float64)
+        dt = np.zeros((nt, M), np.int32)
+        lc = np.zeros((nt, M), np.int32)
+        rc = np.zeros((nt, M), np.int32)
+        co = np.full((nt, M), -1, np.int32)
+        cat_rows: List[np.ndarray] = []
+        from ..pallas.predict_kernel import tree_max_depth
+        maxd = 1
+        for ti, t in enumerate(trees):
+            ni = max(t.num_leaves - 1, 0)
+            if ni == 0:
+                continue
+            maxd = max(maxd, tree_max_depth(t))
+            sf[ti, :ni] = np.asarray(t.split_feature[:ni], np.int32)
+            thr[ti, :ni] = np.asarray(t.threshold[:ni], np.float64)
+            d = np.asarray(t.decision_type[:ni], np.uint8).astype(np.int32)
+            dt[ti, :ni] = d
+            lc[ti, :ni] = np.asarray(t.left_child[:ni], np.int32)
+            rc[ti, :ni] = np.asarray(t.right_child[:ni], np.int32)
+            for i in np.nonzero(d & 1)[0]:
+                k = int(t.threshold_bin[i])
+                s, e = int(t.cat_boundaries[k]), int(t.cat_boundaries[k + 1])
+                co[ti, i] = len(cat_rows)
+                cat_rows.append(np.asarray(t.cat_threshold[s:e], np.uint32))
+        self.max_depth = int(maxd)
+        W = max((len(r) for r in cat_rows), default=1)
+        cw = np.zeros((max(len(cat_rows), 1), W), np.uint32)
+        for ri, r in enumerate(cat_rows):
+            cw[ri, :len(r)] = r
+
+        import jax.numpy as jnp
+        thi, tlo = _split_key(_key64(thr))
+        self._pack = PackedServingTrees(
+            split_feature=jnp.asarray(sf), thr_hi=jnp.asarray(thi),
+            thr_lo=jnp.asarray(tlo), decision_type=jnp.asarray(dt),
+            left_child=jnp.asarray(lc), right_child=jnp.asarray(rc),
+            cat_ord=jnp.asarray(co), cat_words=jnp.asarray(cw))
+
+    # -- host-side row encoding -------------------------------------------
+    def _encode(self, X: np.ndarray):
+        X = np.ascontiguousarray(X, np.float64)
+        nan = np.isnan(X)
+        khi, klo = _split_key(_key64(X))
+        # categorical int: truncate-toward-zero like the host walk's
+        # astype(int64); NaN -> -1 (routes right), huge values clamp into
+        # the always-invalid range beyond any bitset
+        iv = np.where(nan, -1.0, X)
+        iv = np.clip(iv, -1.0, float(2 ** 31 - 1)).astype(np.int64)
+        return khi, klo, nan, iv.astype(np.int32)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def leaves(self, X: np.ndarray) -> np.ndarray:
+        """(T, n) leaf indices; internally chunks to the largest bucket
+        and pads each chunk, so any n works without a fresh trace."""
+        import jax.numpy as jnp
+        n = X.shape[0]
+        khi, klo, nan, iv = self._encode(X)
+        cap = self.buckets[-1]
+        walk = _get_walk()
+        outs = []
+        for s in range(0, n, cap) if n else []:
+            m = min(cap, n - s)
+            b = self.bucket_for(m)
+            pad = ((0, b - m), (0, 0))
+            out = walk(self._pack,
+                       jnp.asarray(np.pad(khi[s:s + m], pad)),
+                       jnp.asarray(np.pad(klo[s:s + m], pad)),
+                       jnp.asarray(np.pad(nan[s:s + m], pad)),
+                       jnp.asarray(np.pad(iv[s:s + m], pad)),
+                       max_depth=self.max_depth)
+            outs.append(np.asarray(out)[:, :m])
+        if not outs:
+            return np.zeros((len(self._leaf_values), 0), np.int32)
+        return np.concatenate(outs, axis=1)
+
+    def raw_scores(self, X: np.ndarray) -> np.ndarray:
+        """Pre-average raw scores, (n,) or (n, K) float64 — accumulated on
+        the host tree-by-tree in the exact order of the Booster.predict
+        host loop, so results are bitwise identical to it."""
+        n = X.shape[0]
+        k = self.num_class
+        leaves = self.leaves(X)
+        if k == 1:
+            score = np.zeros(n, np.float64)
+            for i, lv in enumerate(self._leaf_values):
+                score += lv[leaves[i]]
+            return score
+        score = np.zeros((n, k), np.float64)
+        for i, lv in enumerate(self._leaf_values):
+            score[:, i % k] += lv[leaves[i]]
+        return score
+
+    def warmup(self) -> int:
+        """Trace every bucket once (called by the registry BEFORE the
+        version swap, so live traffic never pays a compile). Returns the
+        number of buckets primed."""
+        for b in self.buckets:
+            self.leaves(np.zeros((b, self.num_features), np.float64))
+        return len(self.buckets)

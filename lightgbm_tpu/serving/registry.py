@@ -1,0 +1,243 @@
+"""Versioned model registry with validated, atomic hot-reload.
+
+Reference analog: the FastConfig pre-binding of c_api.h:1399-1428 —
+everything per-model (packed tree arrays, jitted bucket programs, the
+single-row native predictor, the output transform) is bound ONCE at load
+time so the request hot path does no setup work.
+
+Hot-reload discipline (the serving half of docs/ROBUSTNESS.md):
+
+  1. the candidate file is validated BEFORE anything is swapped — sha256
+     against the robustness manifest sidecar when one exists
+     (``<model>.manifest.json``, written by the checkpoint subsystem),
+     then the model_io truncation/corruption parse checks, then the
+     finite-tree guard;
+  2. the full serving state (packed arrays + warmed bucket traces) is
+     built off to the side;
+  3. the swap is a single reference rebind under a lock — in-flight
+     requests that already resolved the old :class:`ServingModel` finish
+     against it (drain-by-reference), new requests see the new version.
+
+A failed reload therefore never degrades serving: the old model keeps
+answering and the error surfaces to the caller (HTTP 409 on ``/reload``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..robustness.checkpoint import MANIFEST_SUFFIX
+from ..utils.log import LightGBMError, log_info, log_warning
+from .compiled import CompiledPredictor, bucket_ladder
+
+
+class ServingModel:
+    """One immutable, fully pre-bound model version."""
+
+    def __init__(self, path: str, model_str: str, sha256: str,
+                 max_batch: int = 256,
+                 buckets: Optional[List[int]] = None):
+        from ..basic import Booster
+        from ..predict_fast import SingleRowFastPredictor
+        from ..robustness.guards import check_model_trees
+
+        self.path = str(path)
+        self.sha256 = sha256
+        self.version = 0            # assigned by the registry at swap time
+        self.loaded_unix = time.time()
+        booster = Booster(model_str=model_str)   # raises on truncation
+        check_model_trees(booster._all_trees(),
+                          what=f"serving model {path!r}")
+        self._booster = booster
+        self._trees = booster._all_trees()
+        self.num_trees = len(self._trees)
+        self.num_class = booster.num_model_per_iteration()
+        self.num_features = booster.num_feature()
+        self._average = booster._average_output()
+        self._convert = booster._convert_output_np_fn()
+        # single-row hot path: native C walk, no device dispatch (factor 1
+        # + generic tail below == the Booster.predict n==1 path exactly)
+        self._fast = SingleRowFastPredictor(self._trees, self.num_class,
+                                            self.num_features)
+        try:
+            self._compiled: Optional[CompiledPredictor] = CompiledPredictor(
+                self._trees, self.num_class, self.num_features,
+                max_batch=max_batch, buckets=buckets)
+        except LightGBMError as e:
+            log_warning(f"serving model {path!r}: {e}; batches fall back "
+                        "to the host predictor")
+            self._compiled = None
+
+    # -- prediction (bitwise identical to Booster.predict) ----------------
+    def raw_scores(self, X: np.ndarray) -> np.ndarray:
+        """Pre-average raw scores for validated float64 rows."""
+        n = X.shape[0]
+        k = self.num_class
+        if n == 1:
+            raw = self._fast.raw_predict(X[0])
+            return raw[:1] if k == 1 else raw.reshape(1, k)
+        if self._compiled is not None:
+            return self._compiled.raw_scores(X)
+        # host fallback (linear trees): the exact Booster.predict loop
+        if k == 1:
+            score = np.zeros(n, np.float64)
+            for t in self._trees:
+                score += t.predict_raw(X)
+            return score
+        score = np.zeros((n, k), np.float64)
+        for i, t in enumerate(self._trees):
+            score[:, i % k] += t.predict_raw(X)
+        return score
+
+    def finish(self, score: np.ndarray, raw_score: bool) -> np.ndarray:
+        """The Booster.predict tail: averaging + output transform."""
+        if self._average and self.num_trees:
+            score = score / max(self.num_trees // max(self.num_class, 1), 1)
+        if raw_score:
+            return score
+        return np.asarray(self._convert(score))
+
+    def validate_rows(self, X) -> np.ndarray:
+        try:
+            X = np.ascontiguousarray(np.asarray(X, np.float64))
+        except (ValueError, TypeError) as e:
+            # ragged / non-numeric request payloads are client errors
+            # (HTTP 400), not server faults
+            raise LightGBMError(f"predict rows are not a numeric "
+                                f"matrix: {e}")
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.ndim != 2:
+            raise LightGBMError(f"predict rows must be 1-D or 2-D, "
+                                f"got ndim={X.ndim}")
+        if X.shape[1] != self.num_features:
+            raise LightGBMError(
+                f"The number of features in data ({X.shape[1]}) is not the "
+                f"same as it was in training data ({self.num_features})")
+        return X
+
+    def predict(self, data, raw_score: bool = False) -> np.ndarray:
+        X = self.validate_rows(data)
+        if X.shape[0] == 0:
+            k = self.num_class
+            return np.zeros((0,) if k == 1 else (0, k), np.float64)
+        return self.finish(self.raw_scores(X), raw_score)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "path": self.path,
+            "sha256": self.sha256,
+            "num_trees": self.num_trees,
+            "num_class": self.num_class,
+            "num_features": self.num_features,
+            "compiled": self._compiled is not None,
+            "buckets": list(self._compiled.buckets) if self._compiled else [],
+            "loaded_unix": self.loaded_unix,
+        }
+
+
+def _check_manifest(path: str, data: bytes) -> Optional[str]:
+    """Verify ``data`` against the robustness manifest sidecar when one
+    exists; returns the sha256 hex of ``data`` either way."""
+    sha = hashlib.sha256(data).hexdigest()
+    mpath = path + MANIFEST_SUFFIX
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except ValueError as e:
+            raise LightGBMError(
+                f"serving model manifest {mpath!r} is not valid JSON: {e}")
+        # "model_sha256" is the field write_checkpoint seals into the
+        # manifest (robustness/checkpoint.py)
+        want = manifest.get("model_sha256")
+        if want and want != sha:
+            raise LightGBMError(
+                f"serving model {path!r} failed its manifest sha256 check "
+                f"(manifest {want[:12]}..., file {sha[:12]}...) — the file "
+                "was modified or truncated after the manifest was sealed")
+    return sha
+
+
+class ModelRegistry:
+    """Holds the live :class:`ServingModel` plus monotone version numbers;
+    ``load`` is both first load and hot-reload."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 max_batch: int = 256, buckets_spec: str = "",
+                 warmup: bool = True):
+        self._lock = threading.Lock()
+        self._current: Optional[ServingModel] = None
+        self._version = 0
+        self._max_batch = int(max_batch)
+        self._buckets = (bucket_ladder(max_batch, buckets_spec)
+                         if buckets_spec else None)
+        self._warmup = bool(warmup)
+        self.reloads_ok = 0
+        self.reloads_failed = 0
+        if path:
+            self.load(path)
+
+    def load(self, path: str) -> ServingModel:
+        """Validate + build + warm a candidate, then atomically swap it
+        in.  Raises (keeping the old model) on any validation failure."""
+        from .. import telemetry
+
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            sha = _check_manifest(str(path), data)
+            model = ServingModel(str(path), data.decode("utf-8"), sha,
+                                 max_batch=self._max_batch,
+                                 buckets=self._buckets)
+            if self._warmup and model._compiled is not None:
+                model._compiled.warmup()
+        except (OSError, UnicodeDecodeError) as e:
+            self.reloads_failed += 1
+            telemetry.inc("serve/reload_failed")
+            raise LightGBMError(f"cannot load serving model {path!r}: {e}")
+        except LightGBMError:
+            self.reloads_failed += 1
+            telemetry.inc("serve/reload_failed")
+            raise
+        with self._lock:
+            self._version += 1
+            model.version = self._version
+            self._current = model
+        self.reloads_ok += 1
+        telemetry.inc("serve/reloads")
+        telemetry.instant("serve:reload", version=model.version,
+                          sha256=sha[:12])
+        log_info(f"serving model v{model.version} loaded from {path} "
+                 f"({model.num_trees} trees, sha256 {sha[:12]}, "
+                 f"{time.perf_counter() - t0:.2f}s incl. warmup)")
+        return model
+
+    def current(self) -> ServingModel:
+        with self._lock:
+            if self._current is None:
+                raise LightGBMError("model registry is empty — load a "
+                                    "model before serving")
+            return self._current
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            cur = self._current
+        out = {"reloads_ok": self.reloads_ok,
+               "reloads_failed": self.reloads_failed}
+        if cur is not None:
+            out["model"] = cur.describe()
+        return out

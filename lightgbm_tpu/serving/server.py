@@ -1,0 +1,295 @@
+"""JSON-over-HTTP serving front end (stdlib ``http.server`` only).
+
+Endpoints:
+
+  ``POST /predict``  body {"rows": [[...], ...]} or {"row": [...]},
+                     optional "raw_score" (bool) and "fast" (bool — run a
+                     single row synchronously on the native walk, no
+                     queueing); replies {"predictions", "model_version",
+                     "batched_rows", "latency_ms"}.  A full queue replies
+                     503 with the structured overload payload; shape
+                     errors reply 400.
+  ``GET  /health``   liveness: worker thread state, heartbeat age, queue
+                     depth, model version (503 when the worker died).
+  ``POST /reload``   {"path": optional} — validated atomic hot-swap; a
+                     rejected candidate replies 409 and the old version
+                     keeps serving.
+  ``GET  /stats``    latency/queue-depth percentiles from the telemetry
+                     registry, request counters, recompile watchdog
+                     counts, model + registry info.
+
+Shutdown: ``shutdown(drain=True)`` (wired to SIGTERM/SIGINT by
+``run_server``) stops accepting connections, lets the batcher drain
+everything already queued, then returns — a rolling restart loses zero
+admitted requests.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from concurrent.futures import CancelledError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_debug, log_info
+from .batcher import MicroBatcher, OverloadError
+from .registry import ModelRegistry
+
+_REQUEST_TIMEOUT_S = 30.0
+
+
+def _jsonable(values: np.ndarray):
+    v = np.asarray(values)
+    return v.tolist()
+
+
+class ServingApp:
+    """Registry + batcher + HTTP server, wired together."""
+
+    def __init__(self, model_path: str, *, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 256,
+                 max_delay_ms: float = 2.0, queue_size: int = 512,
+                 buckets_spec: str = "", warmup: bool = True,
+                 heartbeat_path: str = ""):
+        self.registry = ModelRegistry(model_path, max_batch=max_batch,
+                                      buckets_spec=buckets_spec,
+                                      warmup=warmup)
+        self.batcher = MicroBatcher(self.registry, max_batch=max_batch,
+                                    max_delay_ms=max_delay_ms,
+                                    queue_size=queue_size,
+                                    heartbeat_path=heartbeat_path)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.app = self          # handler back-pointer
+        self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self.t0 = time.time()
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "ServingApp":
+        """Non-blocking start (tests, embedding); ``run_server`` blocks."""
+        self.batcher.start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="lgbtpu-serve-http",
+                                        daemon=True)
+        self._thread.start()
+        log_info(f"serving on http://{self.host}:{self.port} "
+                 f"(model v{self.registry.version})")
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop accepting, drain the queue (unless ``drain=False``), stop
+        the worker.  Idempotent."""
+        self._draining = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.batcher.stop(drain=drain)
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(5.0)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):   # route access logs off stderr
+        log_debug("serve http: " + fmt % args)
+
+    @property
+    def app(self) -> ServingApp:
+        return self.server.app
+
+    def _send(self, code: int, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            obj = json.loads(raw.decode("utf-8") or "{}")
+        except ValueError as e:
+            raise LightGBMError(f"request body is not valid JSON: {e}")
+        if not isinstance(obj, dict):
+            raise LightGBMError("request body must be a JSON object")
+        return obj
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):   # noqa: N802 — http.server API
+        from .. import telemetry
+
+        if self.path.split("?")[0] == "/health":
+            self._send(*self._health())
+        elif self.path.split("?")[0] == "/stats":
+            with telemetry.span("serve/stats"):
+                self._send(200, self._stats())
+        else:
+            self._send(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):   # noqa: N802
+        from .. import telemetry
+
+        path = self.path.split("?")[0]
+        try:
+            # the body must be consumed on EVERY branch — HTTP/1.1
+            # keep-alive leaves unread bytes in rfile and the next request
+            # on the connection would parse mid-body
+            body = self._read_json()
+            if path == "/predict":
+                with telemetry.span("serve/predict"):
+                    code, obj = self._predict(body)
+            elif path == "/reload":
+                with telemetry.span("serve/reload"):
+                    code, obj = self._reload(body)
+            else:
+                code, obj = 404, {"error": f"unknown path {self.path!r}"}
+        except OverloadError as e:
+            code, obj = 503, e.payload()
+        except LightGBMError as e:
+            code, obj = 400, {"error": str(e)}
+        except CancelledError:
+            # shutdown(drain=False) cancelled the future mid-wait; on
+            # CPython >= 3.8 CancelledError is a BaseException, so the
+            # generic net below would miss it and reset the connection
+            code, obj = 503, {"error": "shutting down"}
+        except Exception as e:  # noqa: BLE001 — serving must answer
+            code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+        self._send(code, obj)
+
+    def _predict(self, body):
+        app = self.app
+        if app.draining:
+            return 503, {"error": "draining"}
+        rows = body.get("rows", body.get("row"))
+        if rows is None:
+            return 400, {"error": 'predict body needs "rows" (matrix) '
+                                  'or "row" (vector)'}
+        t0 = time.perf_counter()
+        fut = app.batcher.submit(rows,
+                                 raw_score=bool(body.get("raw_score", False)),
+                                 fast=bool(body.get("fast", False)))
+        res = fut.result(timeout=_REQUEST_TIMEOUT_S)
+        return 200, {
+            "predictions": _jsonable(res.values),
+            "model_version": res.model_version,
+            "batched_rows": res.batched_rows,
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+
+    def _reload(self, body):
+        app = self.app
+        path = str(body.get("path") or app.registry.current().path)
+        try:
+            model = app.registry.load(path)
+        except LightGBMError as e:
+            # the candidate was rejected; the old version keeps serving
+            return 409, {"error": str(e),
+                         "model_version": app.registry.version}
+        return 200, {"model_version": model.version,
+                     "num_trees": model.num_trees,
+                     "sha256": model.sha256}
+
+    def _health(self):
+        from ..robustness.heartbeat import heartbeat_age
+
+        app = self.app
+        alive = app.batcher.worker_alive
+        out: Dict[str, Any] = {
+            "status": ("draining" if app.draining
+                       else "ok" if alive else "dead"),
+            "model_version": app.registry.version,
+            "uptime_s": round(time.time() - app.t0, 3),
+            "queue_depth": app.batcher.queue_depth(),
+            "worker_alive": alive,
+        }
+        if app.batcher.heartbeat_path:
+            age = heartbeat_age(app.batcher.heartbeat_path)
+            if age is not None:
+                out["heartbeat_age_s"] = round(age, 3)
+        return (200 if alive else 503), out
+
+    def _stats(self) -> Dict[str, Any]:
+        from .. import telemetry
+
+        app = self.app
+        return {
+            "uptime_s": round(time.time() - app.t0, 3),
+            "registry": app.registry.stats(),
+            "queue_depth": app.batcher.queue_depth(),
+            "served": app.batcher.served,
+            "batches": app.batcher.batches,
+            "rejected": app.batcher.rejected,
+            "latency": telemetry.quantiles("serve/latency_s"),
+            "dispatch": telemetry.quantiles("serve/dispatch_s"),
+            "batch_rows": telemetry.quantiles("serve/batch_rows"),
+            "queue_depth_dist": telemetry.quantiles("serve/queue_depth"),
+            "recompiles": {k: v for k, v in
+                           telemetry.recompile_counts().items()
+                           if k.startswith("serve")},
+        }
+
+
+def serve_from_params(params: Dict[str, Any]) -> ServingApp:
+    """Build (not start) a ServingApp from resolved CLI/conf params."""
+    from ..config import Config
+
+    cfg = Config.from_params(params)
+    model_path = str(params.get("input_model", "") or "")
+    if not model_path:
+        raise LightGBMError("task=serve requires input_model=<model file>")
+    return ServingApp(
+        model_path,
+        host=cfg.serve_host, port=cfg.serve_port,
+        max_batch=cfg.serve_max_batch,
+        max_delay_ms=cfg.serve_max_delay_ms,
+        queue_size=cfg.serve_queue_size,
+        buckets_spec=cfg.serve_buckets,
+        warmup=cfg.serve_warmup,
+        heartbeat_path=cfg.serve_heartbeat)
+
+
+def run_server(params: Dict[str, Any]) -> int:
+    """Blocking CLI entry: serve until SIGTERM/SIGINT, then drain."""
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        # serving without its latency histograms is flying blind; the
+        # CLI turns the registry on (spans stay off unless trace_out set)
+        telemetry.configure(enabled=True,
+                            metrics_out=str(params.get("telemetry_out", ""))
+                            or None)
+    app = serve_from_params(params).start()
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        log_info(f"signal {signum}: draining serving queue")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        stop.wait()
+    finally:
+        app.shutdown(drain=True)
+        log_info(f"serving stopped after {app.batcher.served} requests "
+                 f"({app.batcher.rejected} shed)")
+    return 0

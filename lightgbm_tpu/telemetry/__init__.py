@@ -36,7 +36,7 @@ __all__ = [
     "global_tracer", "global_registry",
     "configure", "enabled", "enabled_source", "enable", "disable", "reset",
     "span", "instant", "counter_sample", "inc", "gauge", "observe",
-    "record", "export_trace", "flush", "summary",
+    "quantiles", "record", "export_trace", "flush", "summary",
     "watched_jit", "recompile_counts", "watchdog_summary",
     "set_recompile_threshold", "get_recompile_threshold", "reset_watchdog",
     "memory_snapshot", "device_memory_gb", "host_rss_gb",
@@ -106,6 +106,7 @@ counter_sample = global_tracer.counter
 inc = global_registry.inc
 gauge = global_registry.gauge
 observe = global_registry.observe
+quantiles = global_registry.quantiles
 record = global_registry.record
 
 

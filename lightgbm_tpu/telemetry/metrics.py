@@ -79,26 +79,59 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = float(value)
 
-    def observe(self, name: str, seconds: float) -> None:
-        """Add one sample to the named time histogram."""
+    def observe(self, name: str, seconds: float,
+                bounds: Optional[tuple] = None) -> None:
+        """Add one sample to the named histogram.  ``bounds`` overrides
+        the log-time bucket upper bounds for value-shaped distributions
+        (queue depths, batch sizes); only the FIRST observation's bounds
+        stick for a given name."""
         if not self.enabled:
             return
         with self._lock:
             h = self._hists.get(name)
             if h is None:
+                bb = tuple(bounds) if bounds is not None else _HIST_BOUNDS
                 h = self._hists[name] = {
                     "count": 0, "sum": 0.0, "min": float("inf"),
-                    "max": 0.0, "buckets": [0] * (len(_HIST_BOUNDS) + 1)}
+                    "max": 0.0, "bounds": bb,
+                    "buckets": [0] * (len(bb) + 1)}
             h["count"] += 1
             h["sum"] += seconds
             h["min"] = min(h["min"], seconds)
             h["max"] = max(h["max"], seconds)
-            for i, bound in enumerate(_HIST_BOUNDS):
+            for i, bound in enumerate(h["bounds"]):
                 if seconds <= bound:
                     h["buckets"][i] += 1
                     break
             else:
                 h["buckets"][-1] += 1
+
+    def quantiles(self, name: str, qs=(0.5, 0.95, 0.99)
+                  ) -> Dict[str, float]:
+        """Approximate quantiles from the named histogram's buckets
+        (linear interpolation inside the hit bucket, clamped to the
+        observed min/max) — {"p50": ..., "p95": ..., "p99": ...}."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None or not h["count"]:
+                return {}
+            buckets = list(h["buckets"])
+            bounds = list(h["bounds"])
+            total, vmin, vmax = h["count"], h["min"], h["max"]
+        out: Dict[str, float] = {}
+        for q in qs:
+            target = q * total
+            cum = 0.0
+            val = vmax
+            for i, c in enumerate(buckets):
+                if c and cum + c >= target:
+                    lo = bounds[i - 1] if i > 0 else 0.0
+                    hi = bounds[i] if i < len(bounds) else vmax
+                    val = lo + (target - cum) / c * (hi - lo)
+                    break
+                cum += c
+            out[f"p{int(q * 100)}"] = round(min(max(val, vmin), vmax), 6)
+        return out
 
     def record(self, obj: Dict[str, Any]) -> None:
         """Append one structured record and stream it to the JSONL sink."""

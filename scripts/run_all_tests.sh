@@ -10,5 +10,9 @@ python -m pytest tests/test_telemetry.py -x -q
 # guard paths protect every longer suite below from wasted reruns (the
 # multi-process kill/retry/hang cases are in the slow tier)
 python -m pytest tests/test_robustness.py -x -q -m 'not slow'
+# serving fast tier: the online path (bucketed compiled predictor,
+# micro-batcher, hot reload) is bit-identity-gated against predict, so a
+# regression here flags scoring breakage before the long suites run
+python -m pytest tests/test_serving.py -x -q -m 'not slow'
 python -m pytest tests/ -x -q
 python -m pytest tests/ -x -q -m slow

@@ -159,6 +159,24 @@ def test_cli_save_binary_then_train(tmp_path):
     assert t1 == t2
 
 
+def test_cli_predict_writes_atomically(tmp_path):
+    """task=predict goes through tmp + os.replace (the robustness
+    checkpoint helper): a killed job never leaves a truncated result, and
+    no tmp droppings survive a clean run."""
+    train_csv, X, y = _write_train(tmp_path)
+    model = str(tmp_path / "m.txt")
+    cli_main([f"data={train_csv}", "objective=binary", "num_leaves=7",
+              "num_iterations=3", f"output_model={model}", "verbosity=-1"])
+    out = tmp_path / "preds" / "result.tsv"   # dir is created by the helper
+    cli_main(["task=predict", f"data={train_csv}", f"input_model={model}",
+              f"output_result={out}", "verbosity=-1"])
+    got = np.loadtxt(out)
+    want = lgb.Booster(model_file=model).predict(X)
+    np.testing.assert_allclose(got, want, rtol=1e-15, atol=1e-18)
+    leftovers = [p.name for p in out.parent.iterdir() if p.name != out.name]
+    assert leftovers == []
+
+
 def test_cli_convert_model(tmp_path):
     """task=convert_model dumps the model as JSON."""
     import json

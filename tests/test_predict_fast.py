@@ -76,6 +76,54 @@ def test_single_row_wrong_feature_count():
         fast(X[0, :4])
 
 
+def test_raw_predict_validates_row_length():
+    """raw_predict is the serving hot path: a short row must raise, not
+    let the native walk read past the buffer."""
+    bst, X = _fit_model()
+    fast = bst.predict_single_row_fast_init(raw_score=True)
+    with pytest.raises(lgb.LightGBMError, match="expects 6 features"):
+        fast.raw_predict(X[0, :5])
+    with pytest.raises(lgb.LightGBMError, match="got 8"):
+        fast.raw_predict(np.zeros(8))
+
+
+def test_prebind_iteration_slicing():
+    """SingleRowFastPredictor honors start_iteration/num_iteration at
+    pre-bind time (the FastConfig carries the iteration window)."""
+    from lightgbm_tpu.predict_fast import SingleRowFastPredictor
+
+    bst, X = _fit_model()
+    trees = bst._all_trees()
+    for start, num in ((0, 2), (1, 2), (2, None), (1, 99)):
+        fp = SingleRowFastPredictor(trees, 1, bst.num_feature(),
+                                    start_iteration=start,
+                                    num_iteration=num)
+        want = bst.predict(X[:6], start_iteration=start,
+                           num_iteration=num, raw_score=True)
+        got = np.array([fp(X[i], raw_score=True) for i in range(6)])
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+    # booster entry point agrees with the predictor-level slicing
+    fast = bst.predict_single_row_fast_init(start_iteration=1,
+                                            num_iteration=3,
+                                            raw_score=True)
+    want = bst.predict(X[:4], start_iteration=1, num_iteration=3,
+                       raw_score=True)
+    np.testing.assert_allclose([fast(X[i]) for i in range(4)], want,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_prebind_multiclass_slicing():
+    from lightgbm_tpu.predict_fast import SingleRowFastPredictor
+
+    bst, X = _fit_model(objective="multiclass", num_class=3, cat=False)
+    fp = SingleRowFastPredictor(bst._all_trees(), 3, bst.num_feature(),
+                                start_iteration=1, num_iteration=2)
+    want = bst.predict(X[:5], start_iteration=1, num_iteration=2,
+                       raw_score=True)
+    got = np.stack([fp(X[i], raw_score=True) for i in range(5)])
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
 def test_single_row_latency_sub_ms():
     """The serving pin from the reference's FastPredict design: on a 5-tree
     model a pre-bound call must stay WELL under a millisecond (no device

@@ -1,0 +1,462 @@
+"""Online inference serving (docs/SERVING.md).
+
+The serving contract under test:
+
+  * the shape-bucketed compiled predictor is BITWISE identical to
+    ``Booster.predict`` (raw and transformed, binary and multiclass,
+    categorical + NaN + zero-as-missing rows), at every batch size;
+  * bucket padding and micro-batch coalescing never change outputs;
+  * hot-reload is atomic: under concurrent traffic zero requests drop
+    and every response matches the exact model version it reports;
+  * overload rejects with a structured payload instead of buffering;
+  * the loopback end-to-end flow sustains concurrent mixed-size traffic
+    with ZERO XLA recompiles after warmup (telemetry watchdog counters)
+    and survives a mid-traffic ``/reload``.
+"""
+import http.client
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry, OverloadError,
+                                  ServingApp, bucket_ladder)
+from lightgbm_tpu.telemetry import recompile_counts
+
+
+def _make_data(seed=7, n=800):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    X[:, 4] = rs.randint(0, 9, n)
+    X[rs.rand(n) < 0.15, 0] = np.nan
+    y = ((X[:, 1] > 0) ^ (X[:, 4] == 3)).astype(np.float64)
+    return X, y
+
+
+def _train_to_file(path, seed=3, num_boost_round=8, objective="binary",
+                   num_class=1):
+    X, y = _make_data()
+    if objective != "binary":
+        rs = np.random.RandomState(seed)
+        y = rs.randint(0, num_class, len(y)).astype(np.float64)
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "seed": seed}
+    if num_class > 1:
+        params["num_class"] = num_class
+    ds = lgb.Dataset(X, label=y, categorical_feature=[4])
+    bst = lgb.train(params, ds, num_boost_round=num_boost_round)
+    bst.save_model(str(path))
+    return X
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """(model_path_a, model_path_b, X, ref_a, ref_b) — two models of the
+    same shape plus reference boosters loaded from file."""
+    td = tmp_path_factory.mktemp("serving")
+    pa, pb = td / "model_a.txt", td / "model_b.txt"
+    X = _train_to_file(pa, seed=3)
+    _train_to_file(pb, seed=11)
+    return (str(pa), str(pb), X,
+            lgb.Booster(model_file=str(pa)), lgb.Booster(model_file=str(pb)))
+
+
+# ---------------------------------------------------------------------------
+# compiled predictor
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder():
+    assert bucket_ladder(256) == [8, 16, 32, 64, 128, 256]
+    assert bucket_ladder(100) == [8, 16, 32, 64, 128]
+    assert bucket_ladder(4) == [8]
+    assert bucket_ladder(999, "8,64,256") == [8, 64, 256]
+    with pytest.raises(lgb.LightGBMError):
+        bucket_ladder(256, "0,8")
+    with pytest.raises(lgb.LightGBMError, match="integers"):
+        bucket_ladder(256, "8,x")
+
+
+@pytest.mark.parametrize("raw", [True, False])
+def test_compiled_bit_identical_to_predict(served, raw):
+    pa, _, X, ref, _ = served
+    model = ModelRegistry(pa, max_batch=64).current()
+    for sz in (1, 2, 3, 7, 8, 9, 31, 64, 65, 200, 800):
+        got = model.predict(X[:sz], raw_score=raw)
+        want = ref.predict(X[:sz], raw_score=raw)
+        assert got.shape == want.shape
+        assert np.array_equal(got, want), \
+            f"size {sz}: max |diff| {np.abs(got - want).max()}"
+
+
+def test_compiled_multiclass_bit_identical(tmp_path):
+    mp = tmp_path / "mc.txt"
+    X = _train_to_file(mp, objective="multiclass", num_class=3)
+    ref = lgb.Booster(model_file=str(mp))
+    model = ModelRegistry(str(mp), max_batch=32).current()
+    for sz in (1, 5, 33, 200):
+        for raw in (True, False):
+            assert np.array_equal(model.predict(X[:sz], raw_score=raw),
+                                  ref.predict(X[:sz], raw_score=raw))
+
+
+def test_bucket_padding_never_changes_outputs(served):
+    pa, _, X, ref, _ = served
+    model = ModelRegistry(pa, max_batch=64).current()
+    full = model.predict(X[:200], raw_score=True)
+    # every sub-span lands in different buckets/padding, same values
+    for s, e in ((0, 5), (3, 20), (7, 71), (100, 200), (5, 6)):
+        assert np.array_equal(model.predict(X[s:e], raw_score=True),
+                              full[s:e])
+
+
+def test_zero_rows_and_feature_mismatch(served):
+    pa, _, X, _, _ = served
+    model = ModelRegistry(pa).current()
+    assert model.predict(np.zeros((0, 6))).shape == (0,)
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        model.predict(X[:3, :4])
+
+
+# ---------------------------------------------------------------------------
+# registry: validation + hot reload
+# ---------------------------------------------------------------------------
+
+def test_registry_rejects_truncated_model(served, tmp_path):
+    pa, _, _, _, _ = served
+    reg = ModelRegistry(pa)
+    v1 = reg.version
+    bad = tmp_path / "trunc.txt"
+    text = open(pa).read()
+    bad.write_text(text[:len(text) // 2])
+    with pytest.raises(lgb.LightGBMError, match="truncated"):
+        reg.load(str(bad))
+    # the old model keeps serving, version unchanged
+    assert reg.version == v1
+    assert reg.current().path == pa
+    assert reg.reloads_failed == 1
+
+
+def test_registry_manifest_sha256(served, tmp_path):
+    pa, _, X, ref, _ = served
+    data = open(pa, "rb").read()
+    good = tmp_path / "m.txt"
+    good.write_bytes(data)
+    import hashlib
+    manifest = {"model_sha256": hashlib.sha256(data).hexdigest()}
+    (tmp_path / "m.txt.manifest.json").write_text(json.dumps(manifest))
+    reg = ModelRegistry(str(good))       # valid manifest: loads
+    assert np.array_equal(reg.current().predict(X[:5]), ref.predict(X[:5]))
+    # now corrupt the payload under the sealed manifest
+    good.write_bytes(data + b"# tail\n")
+    with pytest.raises(lgb.LightGBMError, match="sha256"):
+        reg.load(str(good))
+    assert reg.current().sha256 == manifest["model_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalescing_bit_identical(served):
+    pa, _, X, ref, _ = served
+    reg = ModelRegistry(pa, max_batch=64)
+    b = MicroBatcher(reg, max_batch=64, max_delay_ms=25.0,
+                     queue_size=256).start()
+    try:
+        sizes = [1, 3, 1, 7, 2, 12, 1, 5, 9, 1, 4, 6]
+        offs = np.cumsum([0] + sizes)
+        futs = [b.submit(X[offs[i]:offs[i + 1]]) for i in range(len(sizes))]
+        results = [f.result(timeout=10) for f in futs]
+        for i, res in enumerate(results):
+            want = ref.predict(X[offs[i]:offs[i + 1]])
+            assert np.array_equal(res.values, want)
+        # the delay window actually coalesced somebody
+        assert any(r.batched_rows > sizes[i]
+                   for i, r in enumerate(results))
+        assert b.served == len(sizes)
+    finally:
+        b.stop()
+
+
+def test_batcher_singleton_fast_path(served):
+    pa, _, X, ref, _ = served
+    reg = ModelRegistry(pa)
+    b = MicroBatcher(reg).start()
+    try:
+        res = b.submit(X[0], fast=True).result(timeout=5)
+        assert np.array_equal(res.values, ref.predict(X[:1]))
+        assert res.batched_rows == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_overload_structured_rejection(served):
+    pa, _, X, _, _ = served
+    reg = ModelRegistry(pa)
+    b = MicroBatcher(reg, queue_size=2, max_delay_ms=1.0)   # worker NOT started
+    f1 = b.submit(X[:2])
+    f2 = b.submit(X[:2])
+    with pytest.raises(OverloadError) as ei:
+        b.submit(X[:2])
+    payload = ei.value.payload()
+    assert payload["error"] == "overload"
+    assert payload["queue_size"] == 2
+    assert payload["queue_depth"] == 2
+    assert b.rejected == 1
+    # admitted requests still complete once the worker runs (drain)
+    b.start()
+    assert f1.result(timeout=10) is not None
+    assert f2.result(timeout=10) is not None
+    b.stop()
+
+
+def test_batcher_stop_drains_queue(served):
+    pa, _, X, _, _ = served
+    reg = ModelRegistry(pa)
+    b = MicroBatcher(reg, max_delay_ms=1.0)    # worker not started yet
+    futs = [b.submit(X[i:i + 2]) for i in range(6)]
+    b.start()
+    b.stop(drain=True)
+    assert all(f.done() and f.exception() is None for f in futs)
+
+
+def test_hot_reload_under_concurrent_traffic(served):
+    """The swap drains by reference: zero dropped futures, and every
+    response is bitwise consistent with the version it reports."""
+    pa, pb, X, ref_a, ref_b = served
+    expected = {}   # version -> full-prediction oracle
+    reg = ModelRegistry(pa, max_batch=32)
+    expected[reg.version] = ref_a.predict(X[:420], raw_score=True)
+    b = MicroBatcher(reg, max_batch=32, max_delay_ms=1.0,
+                     queue_size=512).start()
+    stop = threading.Event()
+    out, errs = [], []
+
+    def client(seed):
+        rs = np.random.RandomState(seed)
+        while not stop.is_set():
+            s = rs.randint(0, 390)
+            m = int(rs.choice([1, 2, 5, 9]))
+            try:
+                f = b.submit(X[s:s + m], raw_score=True)
+                out.append((s, m, f.result(timeout=10)))
+            except OverloadError:
+                pass
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for path, oracle in ((pb, ref_b), (pa, ref_a)):
+            time.sleep(0.15)
+            model = reg.load(path)    # mid-traffic swap
+            expected[model.version] = oracle.predict(X[:420], raw_score=True)
+        time.sleep(0.15)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+        b.stop()
+    assert not errs, errs[:3]
+    assert len(out) > 20
+    seen_versions = {res.model_version for _, _, res in out}
+    assert len(seen_versions) >= 2          # traffic spanned the swap
+    for s, m, res in out:
+        want = expected[res.model_version][s:s + m]
+        assert np.array_equal(res.values, want), \
+            f"rows {s}:{s+m} mis-scored for v{res.model_version}"
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+def _post(host, port, path, obj, timeout=15):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(obj),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def _get(host, port, path, timeout=15):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def test_server_end_to_end_loopback(served, tmp_path):
+    """Acceptance: concurrent mixed-size requests sustain with zero XLA
+    recompiles after warmup, and a mid-traffic /reload completes with
+    zero dropped or mis-versioned responses."""
+    pa, pb, X, ref_a, ref_b = served
+    hb = tmp_path / "serve.heartbeat"
+    app = ServingApp(pa, port=0, max_batch=32, max_delay_ms=1.0,
+                     queue_size=512, heartbeat_path=str(hb)).start()
+    host, port = app.host, app.port
+    expected = {app.registry.version: ref_a.predict(X[:420], raw_score=True)}
+    try:
+        # ---- warmup traffic covers the whole ladder, then pin compiles
+        for m in (1, 5, 17, 32):
+            st, _ = _post(host, port, "/predict",
+                          {"rows": X[:m].tolist(), "raw_score": True})
+            assert st == 200
+        compiles_before = dict(recompile_counts())
+
+        stop = threading.Event()
+        responses, errs = [], []
+
+        def client(seed):
+            rs = np.random.RandomState(seed)
+            while not stop.is_set():
+                s = rs.randint(0, 390)
+                m = int(rs.choice([1, 2, 7, 16, 29]))
+                try:
+                    st, obj = _post(host, port, "/predict",
+                                    {"rows": X[s:s + m].tolist(),
+                                     "raw_score": True})
+                    responses.append((s, m, st, obj))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)
+        # ---- steady concurrent mixed-size traffic re-traced NOTHING
+        assert recompile_counts().get("serve_predict") == \
+            compiles_before.get("serve_predict"), "recompiles mid-traffic"
+        # ---- mid-traffic hot swap; the candidate warms its own buckets
+        # BEFORE the version swap, so any fresh traces land here, not in
+        # the serving phases
+        st, obj = _post(host, port, "/reload", {"path": pb})
+        assert st == 200, obj
+        expected[obj["model_version"]] = ref_b.predict(X[:420],
+                                                       raw_score=True)
+        compiles_post_reload = dict(recompile_counts())
+        time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errs, errs[:3]
+        assert len(responses) > 20
+        versions = set()
+        for s, m, st, obj in responses:
+            assert st == 200, obj            # zero dropped / zero overload
+            v = obj["model_version"]
+            versions.add(v)
+            want = expected[v][s:s + m]
+            assert np.array_equal(np.asarray(obj["predictions"]), want), \
+                f"rows {s}:{s+m} mis-versioned response (v{v})"
+        assert len(versions) >= 2            # traffic spanned the swap
+        # ---- post-reload steady traffic re-traced nothing either
+        compiles_after = dict(recompile_counts())
+        assert compiles_after.get("serve_predict") == \
+            compiles_post_reload.get("serve_predict"), \
+            f"recompiles after swap: {compiles_post_reload} -> {compiles_after}"
+
+        # ---- observability endpoints
+        st, h = _get(host, port, "/health")
+        assert st == 200 and h["status"] == "ok" and h["worker_alive"]
+        assert "heartbeat_age_s" in h       # worker beat the liveness file
+        st, stats = _get(host, port, "/stats")
+        assert st == 200
+        assert stats["served"] >= len(responses)
+        assert stats["rejected"] == 0
+        assert stats["registry"]["model"]["version"] == max(versions)
+        # ---- error surfaces
+        st, obj = _post(host, port, "/predict", {"rows": [[1.0, 2.0]]})
+        assert st == 400 and "features" in obj["error"]
+        st, obj = _post(host, port, "/predict", {})
+        assert st == 400
+        # ragged / non-numeric payloads are client errors, not 500s
+        st, obj = _post(host, port, "/predict", {"rows": [[1, 2], [3]]})
+        assert st == 400 and "numeric" in obj["error"]
+        st, obj = _post(host, port, "/predict", {"rows": [["a"] * 6]})
+        assert st == 400
+        st, obj = _post(host, port, "/reload", {"path": pa + ".nope"})
+        assert st == 409
+        st, obj = _get(host, port, "/nope")
+        assert st == 404
+    finally:
+        app.shutdown(drain=True)
+    assert not app.batcher.worker_alive
+
+
+def test_server_stats_percentiles_with_telemetry(served):
+    from lightgbm_tpu import telemetry
+    pa, _, X, _, _ = served
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        app = ServingApp(pa, port=0, max_batch=16, max_delay_ms=1.0).start()
+        try:
+            for m in (1, 4, 16, 9, 2):
+                st, _ = _post(app.host, app.port, "/predict",
+                              {"rows": X[:m].tolist()})
+                assert st == 200
+            st, stats = _get(app.host, app.port, "/stats")
+            assert st == 200
+            assert {"p50", "p95", "p99"} <= set(stats["latency"])
+            assert stats["latency"]["p50"] <= stats["latency"]["p99"]
+            assert {"p50", "p95", "p99"} <= set(stats["batch_rows"])
+        finally:
+            app.shutdown()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_server_keepalive_consumes_bodies(served):
+    """Every POST branch must drain the request body — HTTP/1.1
+    keep-alive would otherwise leave body bytes in the stream and desync
+    every later request on the same connection."""
+    pa, _, X, ref, _ = served
+    app = ServingApp(pa, port=0, max_batch=16, max_delay_ms=1.0).start()
+    conn = http.client.HTTPConnection(app.host, app.port, timeout=15)
+    try:
+        # 404 POST with a fat body, then a real predict on the SAME
+        # persistent connection
+        for path, code in (("/nope", 404), ("/predict", 200)):
+            conn.request("POST", path,
+                         json.dumps({"rows": X[:4].tolist()}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            obj = json.loads(r.read())
+            assert r.status == code, obj
+        assert np.array_equal(np.asarray(obj["predictions"]),
+                              ref.predict(X[:4]))
+    finally:
+        conn.close()
+        app.shutdown()
+
+
+def test_cli_serve_requires_model():
+    from lightgbm_tpu.serving.server import serve_from_params
+    with pytest.raises(lgb.LightGBMError, match="input_model"):
+        serve_from_params({"task": "serve"})
+
+
+def test_serve_module_usage_line():
+    import subprocess
+    import sys
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu.serve"],
+                       capture_output=True, text=True,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1
+    assert "serve" in (r.stdout + r.stderr)
