@@ -503,6 +503,151 @@ def main():
     return resume_ok
 
 
+def _multichip_child() -> bool:
+    """One measured training run inside a subprocess with a forced device
+    count (internal: spawned by run_multichip_bench)."""
+    n_dev = int(os.environ["BENCH_MC_DEV"])
+    mode = os.environ["BENCH_MC_MODE"]
+    rows = int(os.environ["BENCH_MC_ROWS"])
+    iters = int(os.environ["BENCH_MC_ITERS"])
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry import global_registry
+
+    if len(jax.devices()) < n_dev:
+        print(json.dumps({"mc_child": True, "error":
+                          f"need {n_dev} devices, have {len(jax.devices())}"}),
+              flush=True)
+        return False
+    X, y = make_higgs_like(rows, N_FEATURES)
+    n_test = min(200_000, max(rows // 10, 1))
+    X_tr, y_tr = X[:-n_test], y[:-n_test]
+    X_te, y_te = X[-n_test:], y[-n_test:]
+    params = {
+        "objective": "binary", "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1, "max_bin": 63, "verbosity": -1,
+        "use_quantized_grad": True, "num_grad_quant_bins": 64,
+        "hist_backend": "stream", "telemetry": True,
+    }
+    if n_dev > 1:
+        # mesh_shape pins the mesh to the first n devices, so the 1-device
+        # baseline and the full-mesh runs share one process environment
+        params.update({"tree_learner": "data",
+                       "mesh_shape": f"data:{n_dev}",
+                       "hist_comms": mode})
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
+    ds = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.Booster(params, ds)
+    bst.update()
+    bst.engine.score.block_until_ready()
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    bst.engine.score.block_until_ready()
+    s_per_tree = (time.time() - t0) / iters
+    auc = auc_score(y_te, bst.predict(X_te, raw_score=True))
+    snap = global_registry.snapshot()
+    print(json.dumps({
+        "mc_child": True, "devices": n_dev, "mode": mode,
+        "s_per_tree": round(s_per_tree, 6), "auc": round(float(auc), 5),
+        "bytes_per_round":
+            snap["gauges"].get("comms/hist_bytes_per_round", 0),
+    }), flush=True)
+    return True
+
+
+def run_multichip_bench() -> bool:
+    """BENCH_MULTICHIP=1: MEASURED data-parallel training — s/tree at 1 vs
+    D devices, scaling efficiency, and per-round histogram comms bytes for
+    both hist_comms modes (docs/DISTRIBUTED.md), AUC-gated like the main
+    HIGGS run.  Each device count runs in a subprocess so the platform can
+    be (re)configured; on hosts without D accelerators a D-device virtual
+    CPU platform is forced (measured numbers then characterize the comms
+    path, not accelerator scaling — the record says which)."""
+    import subprocess
+
+    D = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+    default_rows = min(N_ROWS, 2_000_000)
+    rows = int(os.environ.get("BENCH_MULTICHIP_ROWS", default_rows))
+    # same trees-trained protocol as the main HIGGS run, so the existing
+    # AUC gate applies unchanged
+    iters = int(os.environ.get("BENCH_MULTICHIP_ITERS", N_ITERS))
+
+    # probe the device count in a THROWAWAY subprocess: initializing jax in
+    # this parent would take the accelerator lock (libtpu is exclusive) and
+    # every measuring child below would then fall back to CPU
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        capture_output=True, text=True)
+    try:
+        visible = int(probe.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        visible = 0
+    forced_cpu = visible < D
+
+    def child(n_dev, mode):
+        env = dict(os.environ)
+        env.update({"_BENCH_MC_CHILD": "1", "BENCH_MC_DEV": str(n_dev),
+                    "BENCH_MC_MODE": mode, "BENCH_MC_ROWS": str(rows),
+                    "BENCH_MC_ITERS": str(iters)})
+        if forced_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = [f for f in env.get("XLA_FLAGS", "").split() if not
+                     f.startswith("--xla_force_host_platform_device_count")]
+            env["XLA_FLAGS"] = " ".join(
+                flags + [f"--xla_force_host_platform_device_count={D}"])
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        out = None
+        for line in r.stdout.splitlines():
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("mc_child"):
+                out = obj
+        if r.returncode != 0 or out is None or "error" in (out or {}):
+            sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+            raise RuntimeError(
+                f"multichip child (devices={n_dev}, mode={mode}) failed")
+        out["forced_cpu"] = forced_cpu
+        return out
+
+    r1 = child(1, "psum")
+    rp = child(D, "psum")
+    rr = child(D, "reduce_scatter")
+    speedup = r1["s_per_tree"] / max(rr["s_per_tree"], 1e-12)
+    eff = speedup / D
+    auc = min(rp["auc"], rr["auc"])
+    ok = auc >= AUC_GATE
+    plat = "forced-CPU virtual devices" if rr["forced_cpu"] else "accelerators"
+    record = {
+        "metric": f"multichip_data_parallel_s_per_tree_{D}dev_{rows}rows",
+        "value": round(rr["s_per_tree"], 4),
+        "unit": (f"s/tree at {D} devices ({plat}), "
+                 f"hist_comms=reduce_scatter (lower is better; 1-dev "
+                 f"{r1['s_per_tree']:.4f}, {D}-dev psum "
+                 f"{rp['s_per_tree']:.4f}; holdout AUC {auc:.4f} "
+                 f"{'>=' if ok else '< GATE '}{AUC_GATE})"),
+        # vs_baseline = speedup over the 1-device run (>1 means the mesh
+        # actually helps); scaling_efficiency = speedup / D
+        "vs_baseline": round(speedup, 3) if ok else 0.0,
+        "scaling_efficiency": round(eff, 3),
+        "bytes_per_round": {"psum": rp["bytes_per_round"],
+                            "reduce_scatter": rr["bytes_per_round"]},
+        "auc": {"psum": rp["auc"], "reduce_scatter": rr["auc"]},
+    }
+    print(json.dumps(record), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_MULTICHIP.json"), "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    return ok
+
+
 def run_serve_bench():
     """BENCH_SERVE=1: loopback serving throughput — sustained QPS and
     client-side p50/p99 latency over concurrent mixed-size requests, with
@@ -619,6 +764,10 @@ def run_serve_bench():
 
 
 if __name__ == "__main__":
+    if os.environ.get("_BENCH_MC_CHILD", "") == "1":
+        sys.exit(0 if _multichip_child() else 1)
+    if os.environ.get("BENCH_MULTICHIP", "") == "1":
+        sys.exit(0 if run_multichip_bench() else 1)
     if os.environ.get("BENCH_SERVE", "") == "1":
         sys.exit(0 if run_serve_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")

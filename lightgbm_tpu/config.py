@@ -178,6 +178,8 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
     "multiclass_batched": ["batched_multiclass"],
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
+    "hist_comms": ["histogram_comms"],        # psum | reduce_scatter
+    "hist_comms_dtype": ["histogram_comms_dtype"],  # f32 | bf16_pair
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
     # --- robustness (docs/ROBUSTNESS.md) ---
     "nan_guard": ["nan_policy"],
@@ -452,6 +454,23 @@ class Config:
     # choice for A/B experiments.
     multiclass_batched: bool = True
     mesh_shape: str = ""
+    # data-parallel histogram collective (docs/DISTRIBUTED.md): psum
+    # all-reduces the full histogram block to every device each round;
+    # reduce_scatter Reduce-Scatters feature-group slices so each device
+    # receives only its G/D slice, finds splits shard-locally and
+    # all-gathers only the tiny per-shard best-split records. Trees are
+    # BIT-IDENTICAL either way; LGBTPU_HIST_COMMS=psum|reduce_scatter
+    # forces the choice for A/B experiments. Applies to the row-sharded
+    # stream path (tree_learner=data); constraint features fall back to
+    # psum.
+    hist_comms: str = "psum"
+    # reduce_scatter wire dtype: f32, or bf16_pair — remote contributions
+    # ride the HIGH half of the f32->bf16 high/low split (the hist
+    # kernel's two-pass trick, pallas/hist_kernel._wsplit) at 2 bytes per
+    # element while each device's own slice contribution stays exact f32
+    # and the cross-device accumulation runs in f32. Halves the wire
+    # payload; opt-in (not bit-identical to psum).
+    hist_comms_dtype: str = "f32"
     tpu_dtype: str = "f32"
 
     # --- robustness (docs/ROBUSTNESS.md) ---

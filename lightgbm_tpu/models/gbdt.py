@@ -276,9 +276,7 @@ class GBDT:
             from ..parallel.voting import (grow_tree_voting,
                                            make_voting_splitter)
             gp = self._grow_params
-            if (gp.has_monotone or gp.has_interaction or gp.has_cegb
-                    or gp.extra_trees or gp.bynode_fraction < 1.0
-                    or gp.path_smooth > 0.0
+            if (not gp.plain_growth
                     or self._parse_forced_splits() is not None):
                 raise LightGBMError(
                     "tree_learner=voting does not support monotone/"
@@ -326,8 +324,19 @@ class GBDT:
         self._nan_guard = NanGuard(config.nan_guard,
                                    objective.name if objective else "none")
         self._nan_check_fn = None
-        # telemetry: recent per-iteration wall times (straggler window)
+        # telemetry: recent per-iteration wall times + barrier waits
+        # (straggler window; the wait column splits a slow link from a
+        # slow device in the skew report)
         self._tel_iter_times: List[float] = []
+        self._tel_comms_waits: List[float] = []
+        self._comms_model_cache: Optional[Dict[str, Any]] = None
+        cmdl = self._comms_model()
+        if cmdl is not None:
+            log_info(
+                f"data-parallel comms: hist_comms={cmdl['mode']} "
+                f"(dtype={cmdl['dtype']}) over {cmdl['devices']} devices, "
+                f"~{cmdl['per_round_bytes'] / 2 ** 20:.2f} MB histogram "
+                "payload delivered per device per growth round")
 
     # ------------------------------------------------------------------
     @property
@@ -368,6 +377,49 @@ class GBDT:
         spec = self._row_sharding.spec
         return jax.device_put(
             a, NamedSharding(self._row_sharding.mesh, P(spec[0], None)))
+
+    # ------------------------------------------------------------------
+    def _comms_model(self) -> Optional[Dict[str, Any]]:
+        """Analytic per-round/iteration histogram comms payload for the
+        data-parallel mesh path (docs/DISTRIBUTED.md): bytes of reduced
+        histogram payload DELIVERED to each device per growth round — the
+        full block under hist_comms=psum, the G/D group slice (plus the
+        tiny all_gathered best-split records) under reduce_scatter.  The
+        per-iteration figure assumes full growth at the round budget
+        (rounds = ceil((L-1)/S) + 1 incl. the root pass) and scales with
+        trees per iteration; the psum:reduce_scatter RATIO is exact since
+        both modes grow identical trees."""
+        if self._comms_model_cache is not None:
+            return self._comms_model_cache
+        if (self.mesh is None or not self._mesh_stream
+                or getattr(self, "_voting", False)):
+            return None
+        from ..parallel.comms import hist_comms_bytes_per_round
+        gp = self._grow_params
+        # the collective shards over the ROW axis only (comms.build_shard_plan
+        # uses mesh.shape[row_axis]); on multi-axis meshes the other axes do
+        # not divide the histogram payload
+        d = (int(self.mesh.shape[self._row_axis])
+             if self._row_axis is not None
+             else int(np.prod(self.mesh.devices.shape)))
+        S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+        # int32 quantized hists stay on the exact psum_scatter wire — the
+        # bf16_pair width never applies to them (comms.reduce_hist)
+        cdtype = "f32" if gp.int_hist else gp.hist_comms_dtype
+        # batched multiclass reduces ONE K-channel block per round; the
+        # per-class scan reduces K single-class blocks — same bytes per
+        # iteration, different per-round figure
+        k = self.num_tree_per_iteration
+        kb = k if (k > 1 and self._use_batched_multiclass()) else 1
+        per_round = hist_comms_bytes_per_round(
+            S, self.dd.num_groups, self.dd.max_bins, d, gp.hist_comms,
+            cdtype, num_class=kb)
+        rounds = -(-(gp.num_leaves - 1) // S) + 1
+        self._comms_model_cache = {
+            "mode": gp.hist_comms, "dtype": cdtype,
+            "devices": d, "per_round_bytes": per_round,
+            "per_iter_bytes": per_round * rounds * (k // kb)}
+        return self._comms_model_cache
 
     # ------------------------------------------------------------------
     def _mesh_shards_rows_only(self) -> bool:
@@ -515,7 +567,7 @@ class GBDT:
 
     def _make_grow_params(self) -> GrowParams:
         c = self.config
-        return GrowParams(
+        gp = GrowParams(
             num_leaves=max(c.num_leaves, 2),
             max_depth=c.max_depth,
             max_splits_per_round=self._resolved_max_splits(),
@@ -563,6 +615,39 @@ class GBDT:
             cegb_tradeoff=c.cegb_tradeoff,
             cegb_penalty_split=c.cegb_penalty_split,
         )
+        mode, cdtype = self._resolve_hist_comms(gp)
+        return gp._replace(hist_comms=mode, hist_comms_dtype=cdtype)
+
+    def _resolve_hist_comms(self, gp: GrowParams) -> Tuple[str, str]:
+        """Data-parallel histogram collective (docs/DISTRIBUTED.md).
+
+        ``LGBTPU_HIST_COMMS=psum|reduce_scatter`` overrides the param (A/B
+        experiments — trees are bit-identical either way).  reduce_scatter
+        engages only on the row-sharded stream path with the plain feature
+        set; constraint features / forced splits fall back to psum."""
+        import os as _os
+        c = self.config
+        from ..parallel.comms import HIST_COMMS_DTYPES, HIST_COMMS_MODES
+        mode = _os.environ.get("LGBTPU_HIST_COMMS", "") or c.hist_comms
+        cdtype = c.hist_comms_dtype
+        if mode not in HIST_COMMS_MODES:
+            raise LightGBMError(
+                f"unknown hist_comms={mode!r}; one of {HIST_COMMS_MODES}")
+        if cdtype not in HIST_COMMS_DTYPES:
+            raise LightGBMError(
+                f"unknown hist_comms_dtype={cdtype!r}; one of "
+                f"{HIST_COMMS_DTYPES}")
+        if mode == "reduce_scatter":
+            if not self._mesh_stream:
+                mode = "psum"   # serial / non-stream meshes: GSPMD decides
+            elif (not gp.plain_growth
+                    or self._parse_forced_splits() is not None):
+                log_info(
+                    "hist_comms=reduce_scatter supports the plain feature "
+                    "set only; falling back to psum (constraint features / "
+                    "forced splits active)")
+                mode = "psum"
+        return mode, cdtype
 
     def _cegb_lazy_pen_array(self):
         v = self.config.cegb_penalty_feature_lazy
@@ -976,10 +1061,8 @@ class GBDT:
         cached = getattr(self, "_mc_batched_static", None)
         if cached is None:
             gp = self._grow_params
-            ok = not (gp.has_monotone or gp.has_interaction or gp.has_cegb
-                      or gp.extra_trees or gp.bynode_fraction < 1.0
-                      or gp.path_smooth > 0.0 or self._needs_grow_key
-                      or self._parse_forced_splits() is not None)
+            ok = (gp.plain_growth and not self._needs_grow_key
+                  and self._parse_forced_splits() is None)
             if ok and gp.hist_backend == "stream":
                 # the widened (m_rows, 2*S*K) histogram block stays VMEM-
                 # resident across the whole kernel grid; past ~12 MB the
@@ -1211,6 +1294,34 @@ class GBDT:
             "trees": self.iter_ * k, "wall_s": round(wall, 6),
             "phases": phases, "num_leaves": num_leaves,
             "finished": bool(finished), **memory_snapshot()}
+        # ---- comms: analytic histogram payload + measured barrier wait ----
+        cm = self._comms_model()
+        if cm is not None:
+            rec["comms_mode"] = cm["mode"]
+            rec["comms_bytes"] = cm["per_iter_bytes"]
+            _tel_registry.inc("comms/hist_bytes", cm["per_iter_bytes"])
+            _tel_registry.gauge("comms/hist_bytes_per_round",
+                                cm["per_round_bytes"])
+        comms_wait = None
+        if jax.process_count() > 1:
+            # hosts that finish the local step early wait here for the
+            # stragglers — the barrier time is the iteration's comms/skew
+            # wait, separable from local compute (wall_s measured above)
+            b0 = time.perf_counter()
+            try:
+                from jax.experimental import multihost_utils
+                with _tel_tracer.span("GBDT::CommsBarrier"):
+                    multihost_utils.sync_global_devices(
+                        f"lgbtpu_iter_{self.iter_}")
+                comms_wait = time.perf_counter() - b0
+            except Exception:
+                comms_wait = None
+        if comms_wait is not None:
+            rec["comms_wait_s"] = round(comms_wait, 6)
+            rec["compute_s"] = round(wall, 6)
+        self._tel_comms_waits.append(comms_wait or 0.0)
+        if len(self._tel_comms_waits) > 1024:
+            del self._tel_comms_waits[:512]
         _tel_registry.record(rec)
         _tel_registry.inc("train/iterations")
         _tel_registry.observe("train/iteration", wall)
@@ -1230,7 +1341,8 @@ class GBDT:
             from ..parallel.straggler import straggler_report
             straggler_report(
                 self._tel_iter_times[-K:],
-                warn_skew=self.config.telemetry_straggler_skew)
+                warn_skew=self.config.telemetry_straggler_skew,
+                comms_waits=self._tel_comms_waits[-K:])
 
     def _train_one_iter_impl(self, grad: Optional[jax.Array] = None,
                              hess: Optional[jax.Array] = None) -> bool:

@@ -87,6 +87,23 @@ class GrowParams(NamedTuple):
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # data-parallel histogram collective (docs/DISTRIBUTED.md): "psum"
+    # all-reduces the full histogram block each round; "reduce_scatter"
+    # Reduce-Scatters feature-group slices, finds splits shard-locally and
+    # all_gathers only the tiny best-split records (the reference's
+    # data_parallel_tree_learner.cpp:285-299 pattern). Trees bit-identical.
+    hist_comms: str = "psum"
+    hist_comms_dtype: str = "f32"   # f32 | bf16_pair (compressed wire)
+
+    @property
+    def plain_growth(self) -> bool:
+        """No non-plain growth feature active — the single predicate the
+        voting learner, hist_comms=reduce_scatter, and batched multiclass
+        growth all gate on (forced splits are per-run state the caller
+        checks separately)."""
+        return not (self.has_monotone or self.has_interaction
+                    or self.has_cegb or self.extra_trees
+                    or self.bynode_fraction < 1.0 or self.path_smooth > 0.0)
 
 
 class RoutingLayout(NamedTuple):
@@ -416,6 +433,25 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     use_stream = params.hist_backend == "stream"
     bins_packed = None
     Bpad = -(-Bmax // 8) * 8
+    # reduce_scatter comms (docs/DISTRIBUTED.md): the histogram block is
+    # Reduce-Scattered over the feature-group axis instead of psum'd whole,
+    # split finding runs shard-locally on each device's G/D slice, and only
+    # the per-shard best-split records are all_gathered — the reference's
+    # data_parallel_tree_learner.cpp:285-299 comms pattern, bit-identical
+    # to the psum path (A/B via hist_comms / LGBTPU_HIST_COMMS)
+    use_rs = (mesh is not None and use_stream
+              and params.hist_comms == "reduce_scatter")
+    G_h = G   # histogram-state group count (mesh-padded in rs mode)
+    if use_rs:
+        if not params.plain_growth or forced or params.hist_double:
+            raise ValueError(
+                "hist_comms=reduce_scatter supports the plain feature set "
+                "only; the engine falls back to hist_comms=psum for "
+                "constraint features and forced splits")
+        from ..parallel.comms import make_rs_context, reduce_hist
+        plan, rs_split, rs_bitset = make_rs_context(
+            mesh, row_axis, layout, routing, G, Bmax, params)
+        G_h = plan.g_pad
     if use_stream:
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
@@ -460,16 +496,28 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         with_hist=with_hist,
                         bin_buckets=params.bin_buckets)
                     if with_hist:
-                        h = jax.lax.psum(h, row_axis)
-                    # route-only rounds return all-zero hists on every
+                        if use_rs:
+                            h = reduce_hist(h, row_axis, 1, plan,
+                                            params.hist_comms_dtype)
+                        else:
+                            with jax.named_scope("hist_psum"):
+                                h = jax.lax.psum(h, row_axis)
+                    elif use_rs:
+                        # route-only rounds: slice-shaped zeros keep the
+                        # sharded out_spec consistent (hist never read)
+                        h = jnp.zeros(h.shape[:1] + (plan.gs,) + h.shape[2:],
+                                      h.dtype)
+                    # route-only psum rounds return all-zero hists on every
                     # device — already replicated, no collective needed
                     return nl, h, jax.lax.psum(c, row_axis)
 
+                hspec = (P(None, row_axis, None, None) if use_rs
+                         else P(None, None, None, None))
                 wrapped = shard_map_rows(
                     _local, mesh,
                     (P(None, row_axis), P(None, row_axis),
                      P(None, row_axis), P(None, None), P(None, None)),
-                    (P(None, row_axis), P(None, None, None, None), P(None)))
+                    (P(None, row_axis), hspec, P(None)))
                 return wrapped(bT, lid_row, wT, tb, bi)
         else:
             def _rh(bT, lid_row, wT, tb, bi, num_slots, with_hist=True):
@@ -514,20 +562,25 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                   else jnp.zeros(F, bool)) if use_cegb else None
     root_lazy = (lazy_unused_counts(cegb_lazy, jnp.zeros(N, i32), 1)
                  if use_lazy else None)
-    root_split = find_splits(
-        root_hist, root_g[None], root_h[None], root_c[None], col_mask=root_mask,
-        cegb_penalty=(cegb_pen(root_c[None], cegb_used0, root_lazy)
-                      if use_cegb else None),
-        out_lo=(-BIG[None]) if use_output else None,
-        out_hi=(BIG[None]) if use_output else None,
-        slot_depth=jnp.zeros(1, i32) if use_mono else None,
-        parent_out=root_out[None] if use_output else None,
-        extra_key=jax.random.fold_in(key, 1) if use_extra else None,
-        adv_bounds=((jnp.full((1, F, Bmax), -BIG, f32),
-                     jnp.full((1, F, Bmax), BIG, f32))
-                    if use_amono else None))
+    if use_rs:
+        root_split = rs_split(root_hist, root_g[None], root_h[None],
+                              root_c[None], col_mask)
+    else:
+        root_split = find_splits(
+            root_hist, root_g[None], root_h[None], root_c[None],
+            col_mask=root_mask,
+            cegb_penalty=(cegb_pen(root_c[None], cegb_used0, root_lazy)
+                          if use_cegb else None),
+            out_lo=(-BIG[None]) if use_output else None,
+            out_hi=(BIG[None]) if use_output else None,
+            slot_depth=jnp.zeros(1, i32) if use_mono else None,
+            parent_out=root_out[None] if use_output else None,
+            extra_key=jax.random.fold_in(key, 1) if use_extra else None,
+            adv_bounds=((jnp.full((1, F, Bmax), -BIG, f32),
+                         jnp.full((1, F, Bmax), BIG, f32))
+                        if use_amono else None))
 
-    hist = jnp.zeros((L, G, Bmax, 2), hdt).at[0].set(root_hist[0])
+    hist = jnp.zeros((L, G_h, Bmax, 2), hdt).at[0].set(root_hist[0])
     state = _GrowState(
         leaf_id=leaf_id,
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
@@ -666,7 +719,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
             # ---- categorical bitsets for the chosen splits ----
             parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 2)
-            if params.has_categorical:
+            if params.has_categorical and use_rs:
+                # owner-shard recompute + tiny masked psum (the histogram
+                # slice never leaves its device)
+                bitset = rs_bitset(parent_hist, feat, thr, dirf, pg, ph, pc)
+            elif params.has_categorical:
                 hf = gather_feature_histograms(parent_hist, layout, pg, ph)
                 hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 2)
                 bitset = categorical_left_bitset(
@@ -1152,7 +1209,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                    else jnp.zeros((rows2, F), bool),
                                    rkey, rows=rows2)
             with jax.named_scope("find_splits"):
-                res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
+                if use_rs:
+                    # shard-local scan on each device's group slice + tiny
+                    # best-record all_gather (bit-identical to the full scan)
+                    res = rs_split(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
+                                   st2.cnt[ids2], st.col_mask)
+                else:
+                    res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
                               st2.cnt[ids2],
                               col_mask=cmask2,
                               adv_bounds=((st2.adv_vmin[ids2],
@@ -1375,6 +1438,17 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     use_stream = params.hist_backend == "stream"
     bins_packed = None
     Bpad = -(-Bmax // 8) * 8
+    # reduce_scatter comms for the widened K-class block: identical design
+    # to grow_tree's (see there), scattering over the group axis of the
+    # (K, S, G, Bmax, 2) block and scanning K*2S slots shard-locally
+    use_rs = (mesh is not None and use_stream
+              and params.hist_comms == "reduce_scatter")
+    G_h = G
+    if use_rs:
+        from ..parallel.comms import make_rs_context, reduce_hist
+        plan, rs_split, rs_bitset = make_rs_context(
+            mesh, row_axis, layout, routing, G, Bmax, params)
+        G_h = plan.g_pad
     if use_stream:
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
@@ -1415,15 +1489,24 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         with_hist=with_hist, bin_buckets=params.bin_buckets,
                         num_class=K)
                     if with_hist:
-                        h = jax.lax.psum(h, row_axis)
+                        if use_rs:
+                            h = reduce_hist(h, row_axis, 2, plan,
+                                            params.hist_comms_dtype)
+                        else:
+                            with jax.named_scope("hist_psum"):
+                                h = jax.lax.psum(h, row_axis)
+                    elif use_rs:
+                        h = jnp.zeros(h.shape[:2] + (plan.gs,) + h.shape[3:],
+                                      h.dtype)
                     return nl, h, jax.lax.psum(c, row_axis)
 
+                hspec = (P(None, None, row_axis, None, None) if use_rs
+                         else P(None, None, None, None, None))
                 wrapped = shard_map_rows(
                     _local, mesh,
                     (P(None, row_axis), P(None, row_axis),
                      P(None, row_axis), P(None, None), P(None, None)),
-                    (P(None, row_axis), P(None, None, None, None, None),
-                     P(None, None)))
+                    (P(None, row_axis), hspec, P(None, None)))
                 return wrapped(bT, lid, wT, tb, bi)
         else:
             def _rh(bT, lid, wT, tb, bi, num_slots, with_hist=True):
@@ -1459,11 +1542,15 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     root_h = jnp.sum(hess, axis=1, dtype=hdt)
     root_c = jnp.broadcast_to(jnp.sum(cnt_w, dtype=hdt), (K,))
     cm_root = jnp.broadcast_to(col_mask[None, :], (K, F))
-    root_split = find_splits(root_hist.reshape(K, G, Bmax, 2),
-                             root_g, root_h, root_c, col_mask=cm_root)
+    if use_rs:
+        root_split = rs_split(root_hist.reshape(K, G_h, Bmax, 2),
+                              root_g, root_h, root_c, col_mask)
+    else:
+        root_split = find_splits(root_hist.reshape(K, G_h, Bmax, 2),
+                                 root_g, root_h, root_c, col_mask=cm_root)
 
-    hist = jnp.zeros((K, L, G, Bmax, 2), hdt).at[:, 0].set(
-        root_hist.reshape(K, G, Bmax, 2))
+    hist = jnp.zeros((K, L, G_h, Bmax, 2), hdt).at[:, 0].set(
+        root_hist.reshape(K, G_h, Bmax, 2))
     state = _GrowStateK(
         leaf_id=leaf_id,
         split_feature=jnp.zeros((K, L), i32),
@@ -1561,7 +1648,13 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
             # ---- categorical bitsets (rows are class x slot) ----
             parent_hist = st.hist[kI[:, None], pair_old]     # (K, S, G, B, 2)
-            if params.has_categorical:
+            if params.has_categorical and use_rs:
+                bitset = rs_bitset(
+                    parent_hist.reshape(K * S, G_h, Bmax, 2),
+                    feat.reshape(-1), thr.reshape(-1), dirf.reshape(-1),
+                    pg.reshape(-1), ph.reshape(-1), pc.reshape(-1)
+                ).reshape(K, S, Bmax)
+            elif params.has_categorical:
                 hf = gather_feature_histograms(
                     parent_hist.reshape(K * S, G, Bmax, 2), layout,
                     pg.reshape(-1), ph.reshape(-1))
@@ -1736,11 +1829,17 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist2 = new_hist[k2, ids2]
             cm2 = jnp.broadcast_to(col_mask[None, :], (K * 2 * S, F))
             with jax.named_scope("find_splits_k"):
-                res = find_splits(hist2.reshape(K * 2 * S, G, Bmax, 2),
-                                  ta(st2.sum_g, ids2).reshape(-1),
-                                  ta(st2.sum_h, ids2).reshape(-1),
-                                  ta(st2.cnt, ids2).reshape(-1),
-                                  col_mask=cm2)
+                if use_rs:
+                    res = rs_split(hist2.reshape(K * 2 * S, G_h, Bmax, 2),
+                                   ta(st2.sum_g, ids2).reshape(-1),
+                                   ta(st2.sum_h, ids2).reshape(-1),
+                                   ta(st2.cnt, ids2).reshape(-1), col_mask)
+                else:
+                    res = find_splits(hist2.reshape(K * 2 * S, G_h, Bmax, 2),
+                                      ta(st2.sum_g, ids2).reshape(-1),
+                                      ta(st2.sum_h, ids2).reshape(-1),
+                                      ta(st2.cnt, ids2).reshape(-1),
+                                      col_mask=cm2)
             ids2_m = jnp.where(valid2, ids2, drop)
 
             def rs(a):

@@ -118,13 +118,43 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
     # rebuilding the one-hot).
     l_iota = jax.lax.broadcasted_iota(i32, (L, T), 0)
     bins32 = bins_ref[...].astype(i32) if u8_layout else None  # (G_pad, T)
+    # FOLDED multiclass route gather (docs/PERF.md lever): the K per-class
+    # (NUM_TAB, L) @ (L, T) table dots merge into ONE block-diagonal
+    # (K*NUM_TAB, K*L) @ (K*L, T) dot — class k's leaf one-hot occupies
+    # rows [k*L, (k+1)*L) and the LHS zero-masks tabs outside its column
+    # band, so every output element still sums exactly one 1.0 * value
+    # product (bit-exact; zero products add exact zeros).  Gated on the
+    # operands fitting VMEM; the per-class loop remains the fallback.
+    fold_routes = (K > 1 and K * L * T * 2 <= 8 * 2 ** 20
+                   and NUM_TAB * K * K * L * 4 <= 4 * 2 ** 20)
+    if fold_routes:
+        kl_iota = jax.lax.broadcasted_iota(i32, (K * L, T), 0)
+        lid_all = jnp.concatenate(
+            [jnp.broadcast_to(leaf_ref[k:k + 1, :] + k * L, (L, T))
+             for k in range(K)], axis=0)
+        oh_all = (kl_iota == lid_all).astype(bf16)           # (K*L, T)
+        col_iota = jax.lax.broadcasted_iota(i32, (NUM_TAB, K * L), 1)
+        bd = jnp.concatenate(
+            [jnp.where((col_iota >= k * L) & (col_iota < (k + 1) * L),
+                       tabs_ref[...], 0.0) for k in range(K)], axis=0)
+        vals_all = jax.lax.dot_general(
+            bd, oh_all, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                      # (K*NUM_TAB, T)
     slots = []                                               # per-class (1,T)
     for k in range(K):  # static unroll
         lid = leaf_ref[k:k + 1, :]                           # (1, T) i32
-        leaf_oh = (l_iota == lid).astype(bf16)               # (L, T)
-        vals = jax.lax.dot_general(
-            tabs_ref[:, k * L:(k + 1) * L], leaf_oh, (((1,), (0,)), ((), ())),
-            preferred_element_type=f32)                      # (NUM_TAB, T)
+        if fold_routes:
+            # NUM_TAB row slices stay sublane-aligned (24 = 3 x 8); the
+            # categorical-bits dot below rebuilds its per-class one-hot
+            # instead of slicing oh_all at the unaligned k*L offset
+            leaf_oh = None
+            vals = vals_all[k * NUM_TAB:(k + 1) * NUM_TAB, :]
+        else:
+            leaf_oh = (l_iota == lid).astype(bf16)           # (L, T)
+            vals = jax.lax.dot_general(
+                tabs_ref[:, k * L:(k + 1) * L], leaf_oh,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=f32)                  # (NUM_TAB, T)
         # flags stay i32 (0/1) throughout — Mosaic cannot handle i1 vectors
         # as select OPERANDS (i8<->i1 truncation); predicates are fresh
         # comparisons
@@ -177,6 +207,8 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
         go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
         if has_cat:
             # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, pick fb
+            if leaf_oh is None:
+                leaf_oh = (l_iota == lid).astype(bf16)       # (L, T)
             br = jax.lax.dot_general(
                 bits_ref[:, k * L:(k + 1) * L].astype(bf16), leaf_oh,
                 (((1,), (0,)), ((), ())),

@@ -37,15 +37,39 @@ FEATURE_AXIS = "feature"
 
 
 def parse_mesh_shape(spec: str) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
-    """Parse "data:4,feature:2" into axis names/sizes."""
+    """Parse "data:4,feature:2" into axis names/sizes.
+
+    Malformed specs raise LightGBMError naming the offending part instead
+    of leaking a bare ValueError (e.g. "data:") or silently building a
+    mesh with duplicate/empty axis names or non-positive sizes."""
     names, sizes = [], []
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
-        name, _, size = part.partition(":")
-        names.append(name.strip())
-        sizes.append(int(size))
+        name, sep, size = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise LightGBMError(
+                f"mesh_shape part {part!r} must be '<axis>:<size>' "
+                f"(full spec: {spec!r})")
+        try:
+            n = int(size)
+        except ValueError:
+            raise LightGBMError(
+                f"mesh_shape part {part!r} has a non-integer size "
+                f"{size.strip()!r} (full spec: {spec!r})") from None
+        if n <= 0:
+            raise LightGBMError(
+                f"mesh_shape part {part!r} has non-positive size {n} "
+                f"(full spec: {spec!r})")
+        if name in names:
+            raise LightGBMError(
+                f"mesh_shape {spec!r} repeats axis name {name!r}")
+        names.append(name)
+        sizes.append(n)
+    if not names:
+        raise LightGBMError(f"mesh_shape {spec!r} names no axes")
     return tuple(names), tuple(sizes)
 
 

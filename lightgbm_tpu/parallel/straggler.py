@@ -1,17 +1,29 @@
 """Multi-host straggler detection for data-parallel training.
 
 A data-parallel step runs at the pace of the slowest host — one throttled
-VM, one overloaded NIC, and the whole pod waits in the histogram psum.
-The reference's socket network makes this visible as wait time inside
-Allreduce; under jax.distributed it is invisible unless measured.
+VM, one overloaded NIC, and the whole pod waits in the histogram
+collective.  The reference's socket network makes this visible as wait
+time inside Allreduce; under jax.distributed it is invisible unless
+measured.
 
 Every K iterations (param ``telemetry_straggler_every``) each host
-contributes its recent per-iteration wall-time stats to a
+contributes its recent per-iteration wall-time stats — and, since the
+comms overhaul, its per-iteration BARRIER WAIT (the time it idled at the
+post-iteration sync while stragglers caught up) — to a
 ``process_allgather``, and process 0 logs a skew report (max/median of
-the per-host means). A skew above ``telemetry_straggler_skew`` warns
-with the offending host's process index. All hosts must reach the
-check at the same iteration — the call sites key it off the iteration
-counter, which is replicated by construction.
+the per-host means).  The two columns separate the failure modes the
+merged number conflated:
+
+  * **slow device**: one host's local compute mean is far above the
+    median, and every OTHER host shows a large barrier wait (they finish
+    early and idle);
+  * **slow link**: compute means are level but barrier waits are large
+    everywhere — time is going into the collectives themselves.
+
+A skew above ``telemetry_straggler_skew`` warns with the offending
+host's process index and the bottleneck classification.  All hosts must
+reach the check at the same iteration — the call sites key it off the
+iteration counter, which is replicated by construction.
 """
 from __future__ import annotations
 
@@ -24,22 +36,35 @@ from ..utils.log import log_info, log_warning
 
 def straggler_report(iter_times: Sequence[float],
                      warn_skew: float = 1.25,
+                     comms_waits: Optional[Sequence[float]] = None,
                      _all_host_stats: Optional[np.ndarray] = None
                      ) -> Optional[Dict[str, Any]]:
     """Aggregate per-host iteration times; returns the report dict.
 
-    ``iter_times`` — this host's recent per-iteration wall times (s).
+    ``iter_times`` — this host's recent per-iteration wall times (s) of
+    the LOCAL step (compute + in-program collectives).
+    ``comms_waits`` — matching per-iteration barrier waits (s); the comms
+    phase split the telemetry iteration records carry (``comms_wait_s``).
     ``_all_host_stats`` — test hook: pre-gathered (H, 3) [n, mean, max]
-    rows standing in for the collective."""
+    or (H, 4) [n, mean, max, comms_mean] rows standing in for the
+    collective."""
     if not len(iter_times) and _all_host_stats is None:
         return None
     import jax
 
     t = np.asarray(iter_times, np.float64)
+    w = np.asarray(comms_waits if comms_waits is not None else [],
+                   np.float64)
     local = np.array([len(t), float(t.mean()) if len(t) else 0.0,
-                      float(t.max()) if len(t) else 0.0], np.float64)
+                      float(t.max()) if len(t) else 0.0,
+                      float(w.mean()) if len(w) else 0.0], np.float64)
     if _all_host_stats is not None:
-        stats = np.asarray(_all_host_stats, np.float64).reshape(-1, 3)
+        stats = np.asarray(_all_host_stats, np.float64)
+        if stats.ndim == 1:
+            stats = stats.reshape(1, -1)
+        if stats.shape[1] == 3:          # legacy 3-column test rows
+            stats = np.concatenate(
+                [stats, np.zeros((stats.shape[0], 1))], axis=1)
         pidx = 0
     elif jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -50,10 +75,23 @@ def straggler_report(iter_times: Sequence[float],
         pidx = 0
 
     means = stats[:, 1]
+    waits = stats[:, 3]
     median = float(np.median(means))
     slowest = int(np.argmax(means))
     worst = float(means[slowest])
     skew = worst / median if median > 0 else 1.0
+    wait_median = float(np.median(waits))
+    wait_frac = wait_median / median if median > 0 else 0.0
+    # bottleneck classification (docs/DISTRIBUTED.md): a slow DEVICE shows
+    # one host's compute far above the median (the others idle at the
+    # barrier); a slow LINK shows level compute with everyone's barrier
+    # wait high — the time is inside the collectives
+    if skew >= warn_skew:
+        bottleneck = "device"
+    elif wait_frac >= (warn_skew - 1.0):
+        bottleneck = "link"
+    else:
+        bottleneck = "balanced"
     report: Dict[str, Any] = {
         "event": "straggler_report",
         "hosts": int(stats.shape[0]),
@@ -63,21 +101,34 @@ def straggler_report(iter_times: Sequence[float],
         "max_host_max_s": round(float(stats[:, 2].max()), 6),
         "slowest_host": slowest,
         "skew": round(skew, 4),
+        "median_comms_wait_s": round(wait_median, 6),
+        "max_comms_wait_s": round(float(waits.max()), 6),
+        "comms_wait_frac": round(wait_frac, 4),
+        "bottleneck": bottleneck,
     }
     from ..telemetry import global_registry, global_tracer
     global_registry.record(report)
     global_registry.gauge("straggler/skew", skew)
+    global_registry.gauge("straggler/comms_wait_frac", wait_frac)
     global_tracer.counter("straggler_skew", skew=skew)
     if pidx == 0 and stats.shape[0] > 1:
-        if skew >= warn_skew:
+        if bottleneck == "device":
             log_warning(
                 f"telemetry: straggler detected — host {slowest} averages "
-                f"{worst * 1e3:.1f} ms/iter vs the {median * 1e3:.1f} ms "
-                f"median across {stats.shape[0]} hosts "
-                f"(skew {skew:.2f}x >= {warn_skew:.2f}x)")
+                f"{worst * 1e3:.1f} ms/iter compute vs the "
+                f"{median * 1e3:.1f} ms median across {stats.shape[0]} "
+                f"hosts (skew {skew:.2f}x >= {warn_skew:.2f}x; slow "
+                "DEVICE — the other hosts idle at the barrier)")
+        elif bottleneck == "link":
+            log_warning(
+                f"telemetry: comms-bound — hosts spend a median "
+                f"{wait_median * 1e3:.1f} ms/iter waiting at the barrier "
+                f"({wait_frac:.0%} of the {median * 1e3:.1f} ms compute "
+                "median) with level compute across hosts (slow LINK)")
         else:
             log_info(
                 f"telemetry: {stats.shape[0]} hosts, median "
                 f"{median * 1e3:.1f} ms/iter, max {worst * 1e3:.1f} ms "
-                f"(host {slowest}, skew {skew:.2f}x)")
+                f"(host {slowest}, skew {skew:.2f}x, comms wait "
+                f"{wait_median * 1e3:.1f} ms)")
     return report

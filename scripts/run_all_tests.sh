@@ -14,5 +14,15 @@ python -m pytest tests/test_robustness.py -x -q -m 'not slow'
 # micro-batcher, hot reload) is bit-identity-gated against predict, so a
 # regression here flags scoring breakage before the long suites run
 python -m pytest tests/test_serving.py -x -q -m 'not slow'
+# distributed fast tier on a 4-device CPU mesh: the reduce-scatter comms
+# path (psum vs reduce_scatter bit-identity, comms-bytes counters,
+# straggler split) runs on every CPU verify at a second device count —
+# conftest keeps a pre-set device-count flag, so this exercises D=4 while
+# the full suites below run the default 8
+# keep any caller-provided XLA flags, overriding only the device count
+XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
+    | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
+--xla_force_host_platform_device_count=4" \
+    python -m pytest tests/test_distributed_fast.py -x -q
 python -m pytest tests/ -x -q
 python -m pytest tests/ -x -q -m slow

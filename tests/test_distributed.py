@@ -118,6 +118,23 @@ def test_data_parallel_stream_bit_identical(name, params, data_kw, ds_kw):
 
 @needs_mesh
 @pytest.mark.parametrize("name,params,data_kw,ds_kw", _datasets())
+def test_data_parallel_reduce_scatter_bit_identical(name, params, data_kw,
+                                                    ds_kw):
+    """hist_comms=reduce_scatter (Reduce-Scattered histogram slices +
+    shard-local split finding, docs/DISTRIBUTED.md) must reproduce the
+    psum mesh path BYTE-FOR-BYTE on every training layout — psum_scatter
+    slices equal the psum result restricted to the slice, and the
+    shard-local scans reproduce the global scan's tie-breaks exactly."""
+    dp = _train(params, data_kw, ds_kw, "data", "stream")
+    p = dict(params, hist_comms="reduce_scatter")
+    dr = _train(p, data_kw, ds_kw, "data", "stream")
+    assert dr.engine._grow_params.hist_comms == "reduce_scatter"
+    _assert_models_equal(dp.model_to_string(), dr.model_to_string(),
+                         exact=True)
+
+
+@needs_mesh
+@pytest.mark.parametrize("name,params,data_kw,ds_kw", _datasets())
 def test_feature_parallel_bit_identical(name, params, data_kw, ds_kw):
     """tree_learner=feature == serial (reference:
     feature_parallel_tree_learner.cpp — Allreduce of the best split)."""

@@ -1,0 +1,239 @@
+"""Fast-tier data-parallel comms tests (CPU mesh, every verify run).
+
+The slow tier (test_distributed.py) proves mesh learners match SERIAL
+training; this fast tier covers the comms overhaul inside the mesh path:
+psum vs reduce_scatter histogram collectives must grow BYTE-IDENTICAL
+models (the A/B switch `hist_comms` / env `LGBTPU_HIST_COMMS`,
+docs/DISTRIBUTED.md), the telemetry comms-bytes counter must show the
+~(D-1)/D payload drop, and the straggler report must split comms wait
+from compute.  Runs on the conftest 8-device CPU mesh and on the 4-device
+tier run_all_tests.sh adds (XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import global_registry
+from lightgbm_tpu.utils.log import LightGBMError
+
+from conftest import make_synthetic_binary, make_synthetic_multiclass
+
+N_DEV = len(jax.devices())
+needs_mesh = pytest.mark.skipif(N_DEV < 4, reason="needs a >=4-device mesh")
+
+
+def _train(params, X, y, mode, rounds=4, **ds_kw):
+    p = dict(params, verbosity=-1, tree_learner="data",
+             hist_backend="stream", hist_comms=mode)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, **ds_kw),
+                    num_boost_round=rounds)
+    assert bst.engine._mesh_stream
+    assert bst.engine._grow_params.hist_comms == mode
+    return bst
+
+
+def _strip_params(model_str: str) -> str:
+    """Model text minus the parameters block (hist_comms differs by design;
+    every tree byte must still match)."""
+    return model_str.split("\nparameters:")[0]
+
+
+def _models_equal(params, X, y, rounds=4, **ds_kw):
+    a = _train(params, X, y, "psum", rounds, **ds_kw)
+    b = _train(params, X, y, "reduce_scatter", rounds, **ds_kw)
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+    return b
+
+
+# ---------------------------------------------------------------------------
+# psum vs reduce_scatter bit-identity (the A/B switch)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_reduce_scatter_bit_identical_binary():
+    X, y = make_synthetic_binary(n=2000, f=8)
+    _models_equal({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5}, X, y)
+
+
+@needs_mesh
+def test_reduce_scatter_bit_identical_multiclass_batched():
+    """Lockstep K-class growth (grow_tree_k) on the mesh: the widened
+    (K, S, G, B, 2) block reduce-scatters over its group axis and the
+    K*2S-slot scan runs shard-locally — trees byte-equal to the psum
+    path (and the batched path must actually engage)."""
+    X, y = make_synthetic_multiclass(n=2000, f=8, k=3)
+    bst = _models_equal({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 11, "min_data_in_leaf": 5}, X, y,
+                        rounds=3)
+    assert bst.engine._mc_batched_last
+
+
+@needs_mesh
+def test_reduce_scatter_bit_identical_bagging():
+    X, y = make_synthetic_binary(n=2000, f=8)
+    _models_equal({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5, "bagging_fraction": 0.7,
+                   "bagging_freq": 1, "feature_fraction": 0.8, "seed": 3},
+                  X, y)
+
+
+@needs_mesh
+def test_reduce_scatter_env_override():
+    """LGBTPU_HIST_COMMS forces the mode over the param (A/B harness)."""
+    X, y = make_synthetic_binary(n=1500, f=6)
+    os.environ["LGBTPU_HIST_COMMS"] = "reduce_scatter"
+    try:
+        p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+             "tree_learner": "data", "hist_backend": "stream",
+             "hist_comms": "psum"}
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+        assert bst.engine._grow_params.hist_comms == "reduce_scatter"
+    finally:
+        del os.environ["LGBTPU_HIST_COMMS"]
+
+
+@needs_mesh
+def test_reduce_scatter_constraint_fallback():
+    """Constraint features fall back to psum (logged, still trains)."""
+    X, y = make_synthetic_binary(n=1500, f=6)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "tree_learner": "data", "hist_backend": "stream",
+         "hist_comms": "reduce_scatter",
+         "monotone_constraints": [1] + [0] * 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.engine._grow_params.hist_comms == "psum"
+
+
+def test_hist_comms_validation():
+    X, y = make_synthetic_binary(n=500, f=4)
+    with pytest.raises(LightGBMError, match="hist_comms"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "hist_comms": "allreduce"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(LightGBMError, match="hist_comms_dtype"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "hist_comms_dtype": "fp8"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+@needs_mesh
+def test_bf16_pair_compressed_comms_trains():
+    """Opt-in compressed wire payload: not bit-identical to psum, but the
+    model must stay accurate (the quantized-training tolerance claim)."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5, "tree_learner": "data",
+         "hist_backend": "stream", "hist_comms": "reduce_scatter",
+         "hist_comms_dtype": "bf16_pair"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert bst.engine._grow_params.hist_comms_dtype == "bf16_pair"
+    acc = np.mean((np.asarray(bst.predict(X)) > 0.5) == y)
+    ref = lgb.train(dict(p, hist_comms_dtype="f32"),
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    acc_ref = np.mean((np.asarray(ref.predict(X)) > 0.5) == y)
+    # quantize-once wire compression must not cost meaningful quality
+    assert acc >= acc_ref - 0.02
+
+
+# ---------------------------------------------------------------------------
+# telemetry: comms-bytes counter + straggler comms/compute split
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_comms_bytes_counter_drop():
+    """The per-round histogram payload drops ~(D-1)/D in reduce_scatter
+    mode (delivered-payload convention, docs/DISTRIBUTED.md): full block
+    vs one G/D group slice — exactly G / ceil(G/D) minus the tiny
+    best-record payload (= D when D divides the group count, as with
+    these 8 unbundled features on the 4/8-device meshes)."""
+    X, y = make_synthetic_binary(n=1500, f=8)
+
+    def per_round(mode):
+        global_registry.reset()
+        p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+             "tree_learner": "data", "hist_backend": "stream",
+             "hist_comms": mode, "telemetry": True}
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+        snap = global_registry.snapshot()
+        assert snap["counters"]["comms/hist_bytes"] > 0
+        recs = [r for r in global_registry.records
+                if r.get("event") == "iteration"]
+        assert recs[-1]["comms_mode"] == mode
+        assert recs[-1]["comms_bytes"] > 0
+        return snap["gauges"]["comms/hist_bytes_per_round"], bst
+
+    b_psum, bst = per_round("psum")
+    b_rs, _ = per_round("reduce_scatter")
+    g = bst.engine.dd.num_groups
+    expected = g / -(-g // N_DEV)      # delivered drop: full G vs G/D slice
+    ratio = b_psum / b_rs
+    assert ratio > 0.8 * expected
+    assert ratio <= expected + 1e-6
+
+
+def test_straggler_report_splits_comms_from_compute():
+    from lightgbm_tpu.parallel.straggler import straggler_report
+
+    # slow DEVICE: host 2's compute mean is 2x the median, others idle at
+    # the barrier — classification must blame the device
+    stats = np.array([[50, 0.10, 0.12, 0.05],
+                      [50, 0.10, 0.11, 0.05],
+                      [50, 0.20, 0.25, 0.00],
+                      [50, 0.10, 0.12, 0.05]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "device"
+    assert rep["slowest_host"] == 2
+    assert rep["median_comms_wait_s"] == 0.05
+
+    # slow LINK: level compute, everyone waits at the barrier
+    stats = np.array([[50, 0.10, 0.11, 0.08],
+                      [50, 0.10, 0.11, 0.09],
+                      [50, 0.11, 0.12, 0.08],
+                      [50, 0.10, 0.11, 0.08]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "link"
+
+    # balanced: neither skew nor wait
+    stats = np.array([[50, 0.10, 0.11, 0.001],
+                      [50, 0.10, 0.11, 0.001]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "balanced"
+
+    # legacy 3-column test rows still work (comms columns default to 0)
+    stats = np.array([[50, 0.10, 0.11], [50, 0.30, 0.35]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "device"
+    assert rep["median_comms_wait_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# parse_mesh_shape validation (parallel/mesh.py)
+# ---------------------------------------------------------------------------
+
+def test_parse_mesh_shape_valid():
+    from lightgbm_tpu.parallel.mesh import parse_mesh_shape
+    assert parse_mesh_shape("data:4") == (("data",), (4,))
+    assert parse_mesh_shape(" data:4 , feature:2 ") == \
+        (("data", "feature"), (4, 2))
+
+
+@pytest.mark.parametrize("spec", [
+    "data:",          # empty size (used to raise a bare ValueError)
+    "data:x",         # non-integer size
+    "data:0",         # non-positive size
+    "data:-2",
+    "data:4,data:2",  # duplicate axis name
+    "data",           # no separator
+    ":4",             # empty axis name
+    ",",              # no axes at all
+])
+def test_parse_mesh_shape_invalid(spec):
+    from lightgbm_tpu.parallel.mesh import parse_mesh_shape
+    with pytest.raises(LightGBMError):
+        parse_mesh_shape(spec)
