@@ -641,8 +641,9 @@ def run_multichip_bench() -> bool:
         "auc": {"psum": rp["auc"], "reduce_scatter": rr["auc"]},
     }
     print(json.dumps(record), flush=True)
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_MULTICHIP.json"), "w") as fh:
+    from lightgbm_tpu.robustness.checkpoint import atomic_open
+    with atomic_open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "BENCH_MULTICHIP.json"), "w") as fh:
         json.dump(record, fh, indent=2)
         fh.write("\n")
     return ok

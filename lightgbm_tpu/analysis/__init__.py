@@ -1,0 +1,26 @@
+"""lgbtlint: codebase-aware static analysis for JAX/TPU discipline.
+
+The repo's load-bearing invariants — every jitted entry point rides
+``watched_jit``, collective axis names are bound by the enclosing mesh,
+model/checkpoint/result files are written tmp+``os.replace``-atomically,
+serving state is mutated under its lock, training stays deterministic —
+were enforced only by convention.  The reference enforces its analogs
+with ASan/UBSan/TSan CI lanes and compile-time checks; this package is
+the Python-side equivalent: an AST rule engine (``engine.py``) plus
+seven codebase-specific rules (``rules/``), run repo-clean as the first
+stage of ``scripts/run_all_tests.sh``.
+
+Usage::
+
+    python -m lightgbm_tpu.analysis              # gate: exit 1 on findings
+    python -m lightgbm_tpu.analysis --json       # machine-readable output
+    python -m lightgbm_tpu.analysis --changed-only
+    python -m lightgbm_tpu.analysis --update-baseline
+
+Rule catalog + suppression workflow: docs/ANALYSIS.md.
+"""
+from .engine import (Finding, Module, apply_baseline, default_files,
+                     load_baseline, main, render_baseline, run_analysis)
+
+__all__ = ["Finding", "Module", "apply_baseline", "default_files",
+           "load_baseline", "main", "render_baseline", "run_analysis"]

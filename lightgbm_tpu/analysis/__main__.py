@@ -1,0 +1,7 @@
+"""``python -m lightgbm_tpu.analysis`` — the lgbtlint CLI (engine.main)."""
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Rule catalog (docs/ANALYSIS.md has the rationale per rule).
+
+A rule subclasses :class:`Rule` and implements ``check_module`` (per-file
+AST pass over an ``engine.Module``) and/or ``check_repo`` (whole-repo
+invariants).  ``all_rules()`` is the registry the engine and the CLI run.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+
+class Rule:
+    rule_id = "LGB000"
+    title = "base rule"
+    hint = ""
+
+    def check_module(self, module) -> Iterable:
+        return ()
+
+    def check_repo(self, root, modules: Sequence,
+                   changed: Optional[List[str]] = None) -> Iterable:
+        return ()
+
+
+def all_rules() -> List[Rule]:
+    from .atomic_io import AtomicIORule
+    from .collective_axis import CollectiveAxisRule
+    from .config_doc import ConfigDocRule
+    from .determinism import DeterminismRule
+    from .host_sync import HostSyncRule
+    from .jit_discipline import JitDisciplineRule
+    from .lock_discipline import LockDisciplineRule
+
+    return [JitDisciplineRule(), HostSyncRule(), CollectiveAxisRule(),
+            DeterminismRule(), AtomicIORule(), LockDisciplineRule(),
+            ConfigDocRule()]

@@ -1,0 +1,94 @@
+"""LGB005: model/checkpoint/result writes must be tmp+``os.replace`` atomic.
+
+The crash-consistency contract (docs/ROBUSTNESS.md) holds only if EVERY
+write of a file another process may read — models, checkpoints, serving
+candidates, CLI results, worker specs — goes through a same-directory
+tmp file sealed by ``os.replace``.  One direct ``open(path, "w")`` and a
+preemption mid-write leaves a truncated file that the registry's sha256
+check can only reject, the supervisor's retry can only skip, or — for
+files without a manifest — a reader silently consumes.
+
+Detection: a write-mode ``open()`` (or ``Path.write_text`` /
+``write_bytes``) in a scope (function, or module top level) that never
+calls ``os.replace``.  The tmp+replace idiom keeps both calls in one
+scope everywhere in this codebase (robustness/checkpoint.py helpers,
+heartbeat, tracer export), so the scope-local check has no false
+negatives here; append-mode streams (telemetry JSONL sinks) are exempt —
+appends of whole lines are the blessed streaming pattern.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Set
+
+from . import Rule
+from .common import const_str
+
+ATOMIC_HELPERS = ("atomic_write_text", "atomic_write_bytes",
+                  "atomic_write_lines", "atomic_open", "os.replace",
+                  "os.rename")
+
+
+class AtomicIORule(Rule):
+    rule_id = "LGB005"
+    title = "non-atomic write outside the tmp+os.replace discipline"
+    hint = ("use robustness.checkpoint.atomic_write_text/_bytes/_lines "
+            "(or atomic_open for streaming), or write to a same-directory "
+            "tmp file and os.replace it")
+
+    def _atomic_scopes(self, module) -> Set[ast.AST]:
+        """Scopes (function defs; None = module) that call os.replace or
+        one of the blessed atomic helpers."""
+        m = module.model
+        out: Set[ast.AST] = set()
+        for call in m.walk_calls():
+            if m.name_matches(call.func, *ATOMIC_HELPERS):
+                out.add(m.enclosing_function(call))
+        return out
+
+    @staticmethod
+    def _write_mode(call: ast.Call, *positions: int):
+        """The call's literal WRITE-mode string, looked up at the given
+        positional slots and the ``mode=`` keyword (``open(p, mode="w")``
+        must not slip the gate).  Only strings that parse as an open-mode
+        (``[rwxabt+U]+``) count — a path literal that happens to contain
+        a ``w`` is not a mode."""
+        cands = [call.args[p] for p in positions if len(call.args) > p]
+        cands += [kw.value for kw in call.keywords if kw.arg == "mode"]
+        for node in cands:
+            mode = const_str(node)
+            if mode and re.fullmatch(r"[rwxabtU+]+", mode) \
+                    and ("w" in mode or "x" in mode) and "a" not in mode:
+                return mode
+        return None
+
+    def check_module(self, module) -> Iterable:
+        m = module.model
+        atomic = self._atomic_scopes(module)
+        for call in m.walk_calls():
+            what = None
+            if isinstance(call.func, ast.Name) and call.func.id == "open":
+                mode = self._write_mode(call, 1)
+                if mode:
+                    what = f'open(..., "{mode}")'
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "open":
+                # Path.open("w") / io.open(p, "w") / gzip.open(p, "wt"):
+                # a literal write mode in either of the first two slots
+                # trips; read-mode and unknown-object opens stay quiet
+                mode = self._write_mode(call, 0, 1)
+                if mode:
+                    what = f'.open("{mode}")'
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("write_text", "write_bytes"):
+                what = f".{call.func.attr}(...)"
+            if what is None:
+                continue
+            if m.enclosing_function(call) in atomic:
+                continue   # tmp+os.replace idiom (or blessed helper) here
+            yield module.finding(
+                self.rule_id, call,
+                f"{what} without os.replace in the same scope — a crash "
+                "mid-write leaves a truncated file where a reader expects "
+                "a complete one", self.hint)

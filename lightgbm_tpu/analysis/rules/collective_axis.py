@@ -1,0 +1,83 @@
+"""LGB003: collective axis names must be bound by an enclosing mesh.
+
+``jax.lax.psum(x, "dta")`` inside a shard_map whose mesh binds ``"data"``
+fails only at trace time — and on the fallback/serial path it may not
+trace at all until a multichip run hits it in production.  PR 5's
+``parse_mesh_shape`` validates the *mesh spec* string at runtime; this
+rule closes the other half statically: every string-LITERAL axis name
+handed to a collective must appear in the module's axis vocabulary.
+
+Vocabulary per module (union):
+
+  * string literals inside ``PartitionSpec(...)`` / ``P(...)`` calls —
+    the in/out specs of every ``shard_map``/``shard_map_rows`` wrapper;
+  * string literals inside ``Mesh(...)`` constructor calls;
+  * module constants whose name ends in ``_AXIS``;
+  * the values of ``DATA_AXIS``/``FEATURE_AXIS`` when imported from
+    ``parallel.mesh`` ("data"/"feature" — the repo's global axis names).
+
+Axis arguments that are variables are left to the runtime validators
+(they are threaded from the mesh itself and cannot typo).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from . import Rule
+from .common import call_arg, const_str
+
+COLLECTIVES = ("psum", "psum_scatter", "all_gather", "all_to_all",
+               "pmin", "pmax", "pmean", "ppermute", "pshuffle",
+               "axis_index")
+# the two global axis names parallel/mesh.py defines; importing its
+# constants binds these spellings
+MESH_CONSTANTS = {"DATA_AXIS": "data", "FEATURE_AXIS": "feature"}
+
+
+class CollectiveAxisRule(Rule):
+    rule_id = "LGB003"
+    title = "collective axis name not bound by any mesh/PartitionSpec"
+    hint = ("use the axis constant (parallel.mesh.DATA_AXIS / the axis "
+            "variable threaded from the mesh) instead of retyping the "
+            "string, or bind the name in the enclosing shard_map specs")
+
+    def _vocabulary(self, module) -> Set[str]:
+        m = module.model
+        vocab: Set[str] = set()
+        for call in m.walk_calls():
+            if m.name_matches(call.func, "PartitionSpec", "P", "Mesh",
+                              "NamedSharding", "make_mesh"):
+                vocab.update(m.string_literals_in(call))
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                        v = const_str(node.value)
+                        if v:
+                            vocab.add(v)
+        for local, origin in m.import_aliases.items():
+            if local in MESH_CONSTANTS and "mesh" in origin:
+                vocab.add(MESH_CONSTANTS[local])
+        return vocab
+
+    def check_module(self, module) -> Iterable:
+        m = module.model
+        vocab = None   # built lazily: most modules have no collectives
+        for call in m.walk_calls():
+            if not m.name_matches(call.func, *COLLECTIVES):
+                continue
+            axis = call_arg(call, 1, "axis_name", "axis")
+            name = const_str(axis)
+            if name is None:
+                continue
+            if vocab is None:
+                vocab = self._vocabulary(module)
+            if name not in vocab:
+                known = ", ".join(sorted(vocab)) or "<none>"
+                yield module.finding(
+                    self.rule_id, call,
+                    f"collective axis {name!r} is not bound by any mesh or "
+                    f"PartitionSpec this module constructs (known axes: "
+                    f"{known}) — this fails only when the multichip path "
+                    "finally traces", self.hint)

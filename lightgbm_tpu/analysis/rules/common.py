@@ -1,0 +1,232 @@
+"""Shared AST semantics for the rule catalog.
+
+One :class:`ModuleModel` is built lazily per file (engine.Module.model)
+and shared by every rule, so each file pays one parse + one semantic
+pass no matter how many rules run — the repo-wide budget is < 10 s.
+
+The model answers the questions several rules share:
+
+  * dotted call names (``jax.lax.psum``) with import-alias resolution
+    (``import jax.numpy as jnp`` makes ``jnp.x`` resolve to
+    ``jax.numpy.x``; ``from jax.experimental import pallas as pl`` makes
+    ``pl.pallas_call`` resolve to ``jax.experimental.pallas.pallas_call``);
+  * which function defs execute under a jax trace ("jit context"):
+    decorated with / passed to ``watched_jit``/``jax.jit``/``pjit``/
+    ``shard_map``(+``shard_map_rows``), or defined inside such a
+    function — the closures the grower builds and hands to watched_jit
+    are jit context even though the def itself carries no decorator;
+  * enclosing-function lookup for any node.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# spellings that put a callee under a jax trace when a function is passed
+# to (or decorated with) them
+JIT_WRAPPERS = ("watched_jit", "jax.jit", "jit", "pjit", "jax.pjit",
+                "shard_map", "shard_map_rows", "jax.vmap", "vmap")
+# control-flow combinators whose function arguments also trace
+TRACING_COMBINATORS = ("jax.lax.scan", "jax.lax.while_loop",
+                       "jax.lax.fori_loop", "jax.lax.cond",
+                       "jax.lax.switch", "jax.lax.map")
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class ModuleModel:
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.all_nodes: List[ast.AST] = list(ast.walk(tree))
+        for node in self.all_nodes:
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # one walk serves every rule: the engine's < 10 s budget dies the
+        # day each of 7 rules re-walks gbdt.py's ~2k-node tree
+        self.calls: List[ast.Call] = [n for n in self.all_nodes
+                                      if isinstance(n, ast.Call)]
+        self.funcdefs: List[ast.AST] = [n for n in self.all_nodes
+                                        if isinstance(n, FuncDef)]
+        self.import_aliases = self._collect_import_aliases()
+        self._enclosing_cache: Dict[ast.AST, Optional[ast.AST]] = {}
+        self.jit_functions = self._collect_jit_functions()
+
+    # -- imports / call names ---------------------------------------------
+    def _collect_import_aliases(self) -> Dict[str, str]:
+        """local name -> dotted origin, e.g. {"jnp": "jax.numpy",
+        "pl": "jax.experimental.pallas", "watched_jit":
+        "lightgbm_tpu.telemetry.watchdog.watched_jit" (relative imports
+        keep their tail: "..telemetry.watchdog.watched_jit")}."""
+        out: Dict[str, str] = {}
+        for node in self.all_nodes:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+                    if a.asname:
+                        out[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{mod}.{a.name}" if mod \
+                        else a.name
+        return out
+
+    def dotted_name(self, node: ast.AST) -> Optional[str]:
+        """The source-level dotted name of an expression ("pl.pallas_call"),
+        or None for non-name expressions."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolved_name(self, node: ast.AST) -> Optional[str]:
+        """Dotted name with the leading import alias expanded, so callers
+        can match on canonical suffixes regardless of local spelling."""
+        dotted = self.dotted_name(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        origin = self.import_aliases.get(head)
+        if origin:
+            return f"{origin}.{rest}" if rest else origin
+        return dotted
+
+    def name_matches(self, node: ast.AST, *names: str) -> bool:
+        """True when the (resolved or source) dotted name equals one of
+        ``names`` or ends with "." + name — `jax.lax.psum` matches both
+        `lax.psum` and a `from jax import lax; lax.psum` spelling."""
+        for cand in (self.resolved_name(node), self.dotted_name(node)):
+            if cand is None:
+                continue
+            for name in names:
+                if cand == name or cand.endswith("." + name):
+                    return True
+        return False
+
+    # -- function topology -------------------------------------------------
+    def enclosing_function(self, node: ast.AST):
+        if node in self._enclosing_cache:
+            return self._enclosing_cache[node]
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FuncDef):
+            cur = self.parents.get(cur)
+        self._enclosing_cache[node] = cur
+        return cur
+
+    def function_stack(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            out.append(cur)
+            cur = self.enclosing_function(cur)
+        return out
+
+    # -- jit context -------------------------------------------------------
+    def _collect_jit_functions(self) -> Set[ast.AST]:
+        """Function defs that execute under a jax trace (see module doc)."""
+        by_scope: Dict[Tuple[ast.AST, str], List[ast.AST]] = {}
+        for node in self.funcdefs:
+            scope = self.enclosing_function(node)
+            by_scope.setdefault((scope, node.name), []).append(node)
+
+        jit: Set[ast.AST] = set()
+
+        def wrapper_call(call: ast.Call) -> bool:
+            if self.name_matches(call.func, *JIT_WRAPPERS,
+                                 *TRACING_COMBINATORS):
+                return True
+            # functools.partial(watched_jit, ...) decorator-factory form
+            if self.name_matches(call.func, "functools.partial", "partial") \
+                    and call.args:
+                return self.name_matches(call.args[0], *JIT_WRAPPERS)
+            return False
+
+        # 1. decorators
+        for node in self.funcdefs:
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if isinstance(dec, ast.Call) and wrapper_call(dec):
+                    jit.add(node)
+                elif self.name_matches(target, *JIT_WRAPPERS):
+                    jit.add(node)
+
+        # 2. functions passed by name to a wrapper call in the same scope
+        #    chain: watched_jit(_fn, ...), shard_map_rows(_local, mesh, ...),
+        #    jax.lax.scan(body, ...) — and through functools.partial(_fn,...)
+        for call in self.calls:
+            if not wrapper_call(call):
+                continue
+            cands = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in cands:
+                if isinstance(arg, ast.Call) and self.name_matches(
+                        arg.func, "functools.partial", "partial") and arg.args:
+                    arg = arg.args[0]
+                if not isinstance(arg, ast.Name):
+                    continue
+                scope = self.enclosing_function(call)
+                while True:
+                    for fn in by_scope.get((scope, arg.id), ()):
+                        jit.add(fn)
+                    if scope is None:
+                        break
+                    scope = self.enclosing_function(scope)
+
+        # 3. closure: every def nested inside a jit function traces too
+        changed = True
+        while changed:
+            changed = False
+            for node in self.funcdefs:
+                if node not in jit:
+                    enc = self.enclosing_function(node)
+                    if enc is not None and enc in jit:
+                        jit.add(node)
+                        changed = True
+        return jit
+
+    def in_jit_context(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        return fn is not None and fn in self.jit_functions
+
+    # -- misc helpers ------------------------------------------------------
+    def walk_calls(self) -> Iterator[ast.Call]:
+        return iter(self.calls)
+
+    def resolves_to_module(self, node: ast.AST, module_name: str) -> bool:
+        """True when a dotted expression's HEAD is exactly ``module_name``
+        (directly or through an import alias).  Unlike :meth:`name_matches`
+        suffix matching, this cannot confuse ``jax.numpy`` with ``numpy``."""
+        dotted = self.dotted_name(node)
+        if dotted is None:
+            return False
+        head = dotted.split(".")[0]
+        origin = self.import_aliases.get(head, head)
+        return origin == module_name or origin.startswith(module_name + ".")
+
+    def string_literals_in(self, node: ast.AST) -> List[str]:
+        return [n.value for n in ast.walk(node)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def call_arg(call: ast.Call, index: int, *keywords: str
+             ) -> Optional[ast.AST]:
+    """Positional-or-keyword argument lookup."""
+    if len(call.args) > index:
+        return call.args[index]
+    for kw in call.keywords:
+        if kw.arg in keywords:
+            return kw.value
+    return None
+
+
+def const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

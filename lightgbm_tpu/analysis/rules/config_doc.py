@@ -1,0 +1,80 @@
+"""LGB007: Config dataclass and docs/Parameters.md must not drift.
+
+The dataclass (lightgbm_tpu/config.py) is the source of truth the same
+way the reference's ``config.h`` doc comments are for its generated
+``Parameters.rst``/``config_auto.cpp`` (.ci/parameter-generator.py): a
+param added without docs, a doc row for a removed param, a changed
+default or alias — all ship silent user-facing lies.  This rule runs
+the same check as ``scripts/gen_params_doc.py --check`` (regenerate the
+doc in memory, diff against the committed file, no writes), sharing the
+script's ``render_doc()`` so the two can never disagree.
+
+The generator is loaded in-process (importlib on the script file) and its
+``render_doc()`` is diffed against the committed doc — the CLI process
+has already paid the package import, so a subprocess would only re-pay
+it and blow the < 10 s budget.  ``--check`` on the script itself stays
+available for CI lanes that want the standalone gate.
+"""
+from __future__ import annotations
+
+import importlib.util
+import re
+from typing import Iterable, List, Optional, Sequence
+
+from . import Rule
+from ..engine import Finding
+
+TRIGGER_FILES = ("lightgbm_tpu/config.py", "docs/Parameters.md",
+                 "scripts/gen_params_doc.py")
+
+
+class ConfigDocRule(Rule):
+    rule_id = "LGB007"
+    title = "Config dataclass <-> docs/Parameters.md drift"
+    hint = "regenerate with: python scripts/gen_params_doc.py"
+
+    def check_repo(self, root, modules: Sequence,
+                   changed: Optional[List[str]] = None) -> Iterable:
+        if changed is not None and not any(f in changed
+                                           for f in TRIGGER_FILES):
+            return
+        script = root / "scripts" / "gen_params_doc.py"
+        if not script.exists():
+            yield Finding(self.rule_id, "scripts/gen_params_doc.py", 0,
+                          "doc generator missing — the params doc can no "
+                          "longer be checked against Config", self.hint)
+            return
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_lgbt_gen_params_doc", script)
+            gen = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(gen)
+            want = gen.render_doc()
+        except Exception as e:  # noqa: BLE001 — report, don't crash the gate
+            yield Finding(self.rule_id, "scripts/gen_params_doc.py", 0,
+                          f"gen_params_doc.py failed to render: "
+                          f"{type(e).__name__}: {e}", self.hint)
+            return
+        doc = root / "docs" / "Parameters.md"
+        have = doc.read_text() if doc.exists() else ""
+        if have == want:
+            return
+        summarize = getattr(gen, "drift_summary", None)
+        if summarize is not None:
+            bits = list(summarize(have, want, limit=8))
+        else:  # minimal/older generator: param-set diff computed here
+            have_p = set(re.findall(r"^\| `(\w+)`", have, re.M))
+            want_p = set(re.findall(r"^\| `(\w+)`", want, re.M))
+            bits = []
+            if want_p - have_p:
+                bits.append("undocumented params: "
+                            + ", ".join(sorted(want_p - have_p)[:8]))
+            if have_p - want_p:
+                bits.append("doc rows for nonexistent params: "
+                            + ", ".join(sorted(have_p - want_p)[:8]))
+        if not bits:
+            bits.append("defaults/aliases/notes changed for an existing "
+                        "param (run the generator to see the diff)")
+        yield Finding(self.rule_id, "docs/Parameters.md", 0,
+                      "docs/Parameters.md is out of date with the Config "
+                      f"dataclass: {'; '.join(bits)}", self.hint)

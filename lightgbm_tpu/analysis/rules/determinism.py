@@ -1,0 +1,93 @@
+"""LGB004: nondeterminism sources in program construction and training.
+
+Trees must be bit-identical across serial/mesh/batched paths and across
+checkpoint resume (docs/ROBUSTNESS.md) — three things silently break
+that:
+
+  * **bare ``np.random.*`` module calls** draw from the global,
+    process-wide stream: import order or an unrelated caller reseeds it
+    and two "identical" runs diverge.  Every RNG in this codebase rides
+    an explicitly seeded ``RandomState`` that checkpoint/resume can
+    capture (robustness/checkpoint.py packs the MT19937 state);
+  * **set iteration** — ``for x in {...}`` / comprehensions over
+    ``set(...)`` — has hash-seed-dependent order; when the order feeds
+    XLA program construction (feature lists, group layouts) or
+    tie-breaks, PYTHONHASHSEED decides the model.  ``sorted(...)``
+    wrapping makes the order explicit and is always accepted;
+  * **``time.time()`` inside a jitted body** bakes the trace-time clock
+    into the compiled program as a constant — it looks dynamic, it is
+    not, and it changes per recompile.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from . import Rule
+
+BARE_RANDOM_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "seed", "shuffle", "permutation", "choice", "uniform",
+    "normal", "standard_normal", "binomial", "beta", "gamma", "poisson",
+    "exponential", "bytes", "get_state", "set_state",
+}
+CLOCKS = ("time.time", "time.perf_counter", "time.monotonic",
+          "time.process_time", "datetime.datetime.now")
+
+
+def _is_set_expr(node: ast.AST, model) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset"):
+        return True
+    return False
+
+
+class DeterminismRule(Rule):
+    rule_id = "LGB004"
+    title = "nondeterminism source (bare np.random / set iteration / clock in jit)"
+    hint = ("np.random.*: use a seeded np.random.RandomState so resume can "
+            "capture it; set iteration: wrap in sorted(...); clock in jit: "
+            "hoist the timestamp out of the traced function")
+
+    def check_module(self, module) -> Iterable:
+        m = module.model
+        for call in m.walk_calls():
+            # bare global-stream numpy randomness (resolved against the
+            # REAL numpy module, so a jax.random alias can never match)
+            res = m.resolved_name(call.func) or ""
+            head, _, tail = res.rpartition(".")
+            if tail in BARE_RANDOM_FNS and head.endswith("numpy.random") \
+                    and m.resolves_to_module(call.func, "numpy"):
+                yield module.finding(
+                    self.rule_id, call,
+                    f"bare {m.dotted_name(call.func)}() draws from the "
+                    "process-global RNG stream — unseeded, unresumable, "
+                    "order-dependent",
+                    "use an explicitly seeded np.random.RandomState "
+                    "held by the owning object (checkpoint packs it)")
+            # wall clock captured inside a traced body
+            elif m.name_matches(call.func, *CLOCKS) \
+                    and m.in_jit_context(call):
+                yield module.finding(
+                    self.rule_id, call,
+                    "clock call inside a jitted body is baked into the "
+                    "compiled program as a trace-time constant",
+                    "hoist the timestamp out of the traced function")
+        # set iteration: for-loops and comprehension generators
+        for node in m.all_nodes:
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _is_set_expr(it, m):
+                    yield module.finding(
+                        self.rule_id, it,
+                        "iteration over a set has PYTHONHASHSEED-dependent "
+                        "order; if this feeds program construction or a "
+                        "tie-break, the model changes between runs",
+                        "wrap the set in sorted(...) to pin the order")

@@ -1,0 +1,118 @@
+"""LGB002: host-sync hazards inside jitted/shard_map function bodies.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` / ``x.item()`` / ``np.asarray(x)``
+on a traced value either raises a ``ConcretizationTypeError`` at trace
+time or — worse, under ``jax.ensure_compile_time_eval`` or on a
+concrete-leaking path — silently forces a device→host transfer that
+serializes the pipelined TPU step.  Inside a function that runs under
+``watched_jit``/``shard_map`` these conversions are never what the
+author wants on array data.
+
+Taint model (deliberately shallow: one module, no interprocedural flow):
+the parameters of a jit-context function are traced, and so is any local
+assigned from an expression mentioning a traced name.  Static metadata is
+exempt — expressions going through ``.shape`` / ``.ndim`` / ``.dtype`` /
+``.size`` / ``len()`` are compile-time constants under trace and are the
+idiomatic way to do concrete arithmetic inside a kernel.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from . import Rule
+from .common import FuncDef
+
+CONVERTERS = {"float", "int", "bool", "complex"}
+# numpy-module converters that force the traced value to host; resolved
+# against the REAL numpy module only — jnp.asarray is device-side and fine
+NP_CONVERTER_ATTRS = {"asarray", "array", "ascontiguousarray",
+                      "float64", "float32", "int32", "int64"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the expression only reads compile-time metadata."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    rule_id = "LGB002"
+    title = "host-sync conversion of a traced value inside a jitted body"
+    hint = ("keep the value on device (jnp ops / lax.cond / jnp.where); "
+            "if a host readback is genuinely intended, hoist it out of "
+            "the jitted function")
+
+    def check_module(self, module) -> Iterable:
+        m = module.model
+        taint_of: Dict[ast.AST, Set[str]] = {}
+        # outer-first so nested closures inherit the enclosing taint —
+        # ast.walk yields parents before their children
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, FuncDef) or fn not in m.jit_functions:
+                continue
+            tainted: Set[str] = set()
+            enc = m.enclosing_function(fn)
+            if enc in taint_of:
+                tainted |= taint_of[enc]
+            a = fn.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + [x for x in (a.vararg, a.kwarg) if x]):
+                tainted.add(arg.arg)
+            # fixpoint over simple assignments (bounded: each pass adds
+            # names, at most len(assigns) passes)
+            assigns = [(n, _names_in(n.value),
+                        [x.id for t in n.targets for x in ast.walk(t)
+                         if isinstance(x, ast.Name)])
+                       for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+            changed = True
+            while changed:
+                changed = False
+                for _, value_names, target_names in assigns:
+                    if value_names & tainted:
+                        for t in target_names:
+                            if t not in tainted:
+                                tainted.add(t)
+                                changed = True
+            taint_of[fn] = tainted
+            yield from self._check_fn(module, fn, tainted)
+
+    def _check_fn(self, module, fn, tainted: Set[str]) -> Iterable:
+        m = module.model
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            if m.enclosing_function(call) is not fn:
+                continue   # nested defs are checked with their own taint
+            bad = None
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in CONVERTERS and call.args:
+                bad = (call.args[0], call.func.id + "()")
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in NP_CONVERTER_ATTRS and call.args \
+                    and m.resolves_to_module(call.func, "numpy"):
+                bad = (call.args[0], f"np.{call.func.attr}()")
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("item", "tolist") \
+                    and not call.args:
+                bad = (call.func.value, "." + call.func.attr + "()")
+            if bad is None:
+                continue
+            arg, what = bad
+            if not (_names_in(arg) & tainted) or _is_static_expr(arg):
+                continue
+            yield module.finding(
+                self.rule_id, call,
+                f"{what} on a traced value inside jitted function "
+                f"{fn.name!r} forces a host sync (or fails to trace)",
+                self.hint)

@@ -1,0 +1,68 @@
+"""LGB001: every jitted entry point rides ``watched_jit``.
+
+The recompile watchdog (telemetry/watchdog.py, docs/OBSERVABILITY.md) is
+only total if NO compilation path bypasses it: a bare ``jax.jit`` /
+``pjit`` dispatches outside the per-entry trace counters, so a shape
+drift there recompiles silently — the exact failure class the watchdog
+exists to catch.  ``pl.pallas_call`` is flagged when it is reachable
+outside any watched/jitted function (a bare pallas_call at module scope
+or in an unwrapped helper compiles per call site).
+
+Allow-list: telemetry/watchdog.py itself (the one blessed ``jax.jit``
+call every watched entry funnels through).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from . import Rule
+
+ALLOWED_FILES = ("lightgbm_tpu/telemetry/watchdog.py",)
+
+
+class JitDisciplineRule(Rule):
+    rule_id = "LGB001"
+    title = "bare jax.jit/pjit/pallas_call bypasses the recompile watchdog"
+    hint = ("wrap the entry point with telemetry.watchdog.watched_jit "
+            "(warn_after=0 for kernels that legitimately re-specialize "
+            "per shape), or pin it in analysis/baseline.toml with a "
+            "justification")
+
+    def check_module(self, module) -> Iterable:
+        if module.rel in ALLOWED_FILES:
+            return
+        m = module.model
+        for call in m.walk_calls():
+            if m.name_matches(call.func, "jax.jit", "pjit"):
+                # watched_jit internally calls jax.jit — any other call
+                # site is an unwatched compile path
+                yield module.finding(
+                    self.rule_id, call,
+                    "bare jit call escapes the recompile watchdog "
+                    "(telemetry counts zero traces for it)", self.hint)
+            elif m.name_matches(call.func, "functools.partial", "partial") \
+                    and call.args \
+                    and m.name_matches(call.args[0], "jax.jit", "pjit"):
+                yield module.finding(
+                    self.rule_id, call,
+                    "partial-applied bare jit escapes the recompile "
+                    "watchdog", self.hint)
+            elif m.name_matches(call.func, "pallas_call") \
+                    and not m.in_jit_context(call):
+                yield module.finding(
+                    self.rule_id, call,
+                    "pallas_call outside any watched_jit-wrapped function "
+                    "compiles unwatched at every call site", self.hint)
+        # decorator spellings: @jax.jit / @pjit (a bare decorator is not a
+        # Call node, so the loop above misses it)
+        for node in m.funcdefs:
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    continue   # calls handled above
+                if m.name_matches(dec, "jax.jit", "pjit"):
+                    yield module.finding(
+                        self.rule_id, dec,
+                        f"function {node.name!r} is jitted with a bare "
+                        "@jit decorator, bypassing the recompile "
+                        "watchdog", self.hint)

@@ -1,0 +1,125 @@
+"""LGB006: shared mutable state of lock-bearing classes mutates under lock.
+
+The serving subsystem shares one ``ModelRegistry`` and one
+``MicroBatcher`` across the HTTP handler threads, the batcher worker, and
+the reload path (serving/server.py).  Those classes own a lock precisely
+because their state is concurrently mutated — so ANY mutation that
+bypasses the lock is either a data race today (counter increments are
+read-modify-write, two threads lose updates) or a trap for the next
+field someone adds.
+
+Scope: classes that create a ``threading.Lock``/``RLock`` attribute on
+``self``.  Flagged, outside ``__init__`` and outside ``with self.<lock>``
+blocks:
+
+  * augmented assignments to any ``self`` attribute (``self.served += 1``
+    is never atomic under threads);
+  * plain assignments to attributes that are ALSO assigned under the
+    lock somewhere in the class (two disciplines for one field is how
+    torn reads happen).
+
+Single-threaded lock-free classes are untouched — no lock attr, no rule.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from . import Rule
+from .common import FuncDef
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, cls: ast.ClassDef, model):
+        self.cls = cls
+        self.model = model
+        self.lock_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                attr = _self_attr(node.targets[0]) if node.targets else None
+                if attr is None:
+                    continue
+                if isinstance(node.value, ast.Call) and model.name_matches(
+                        node.value.func, "threading.Lock", "threading.RLock",
+                        "Lock", "RLock", "threading.Condition", "Condition"):
+                    self.lock_attrs.add(attr)
+
+    def lock_regions(self) -> List[ast.With]:
+        out = []
+        for node in ast.walk(self.cls):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a in self.lock_attrs:
+                        out.append(node)
+                        break
+        return out
+
+    def under_lock(self, node: ast.AST, regions: List[ast.With]) -> bool:
+        cur = node
+        while cur is not None:
+            if cur in regions:
+                return True
+            cur = self.model.parents.get(cur)
+        return False
+
+
+class LockDisciplineRule(Rule):
+    rule_id = "LGB006"
+    title = "mutation of lock-guarded shared state outside the lock"
+    hint = ("move the mutation inside `with self._lock:` (counter "
+            "increments are read-modify-write and lose updates under "
+            "threads), or document single-ownership in baseline.toml")
+
+    def check_module(self, module) -> Iterable:
+        m = module.model
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _ClassInfo(cls, m)
+            if not info.lock_attrs:
+                continue
+            regions = info.lock_regions()
+            guarded: Set[str] = set()
+            for region in regions:
+                for node in ast.walk(region):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            a = _self_attr(t)
+                            if a:
+                                guarded.add(a)
+                    elif isinstance(node, ast.AugAssign):
+                        a = _self_attr(node.target)
+                        if a:
+                            guarded.add(a)
+            for node in ast.walk(cls):
+                enc = m.enclosing_function(node)
+                if enc is not None and enc.name in ("__init__", "__new__"):
+                    continue
+                if info.under_lock(node, regions):
+                    continue
+                if isinstance(node, ast.AugAssign):
+                    a = _self_attr(node.target)
+                    if a and a not in info.lock_attrs:
+                        yield module.finding(
+                            self.rule_id, node,
+                            f"{cls.name}.{a} += outside "
+                            f"{'/'.join(sorted(info.lock_attrs))} — "
+                            "read-modify-write races lose updates",
+                            self.hint)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a and a in guarded and a not in info.lock_attrs:
+                            yield module.finding(
+                                self.rule_id, node,
+                                f"{cls.name}.{a} is assigned under the "
+                                "lock elsewhere but bare here — one field, "
+                                "two disciplines", self.hint)

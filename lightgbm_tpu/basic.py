@@ -952,7 +952,8 @@ class Dataset:
                          float(m.max_val)] for m in mappers],
         }
         meta_b = json.dumps(meta).encode()
-        with open(filename, "wb") as f:
+        from .robustness.checkpoint import atomic_open
+        with atomic_open(filename, "wb") as f:
             f.write(self._BINARY_MAGIC)
             f.write(struct.pack("<Q", len(meta_b)))
             f.write(meta_b)
@@ -1482,8 +1483,12 @@ class Booster:
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0, importance_type: str = "split") -> "Booster":
-        Path(filename).write_text(self.model_to_string(num_iteration, start_iteration,
-                                                       importance_type))
+        # tmp + os.replace: the serving registry hot-reloads model files by
+        # path, so a torn write must never be observable (lgbtlint LGB005)
+        from .robustness.checkpoint import atomic_write_text
+        atomic_write_text(str(filename),
+                          self.model_to_string(num_iteration, start_iteration,
+                                               importance_type))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

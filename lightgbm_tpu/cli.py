@@ -277,7 +277,8 @@ def run_convert_model(params: Dict[str, Any]) -> None:
     bst = Booster(model_file=str(model_path))
     out = str(params.get("convert_model", params.get(
         "output_model", "model_convert.json")))
-    with open(out, "w") as fh:
+    from .robustness.checkpoint import atomic_open
+    with atomic_open(out, "w") as fh:
         json.dump(bst.dump_model(), fh, indent=2)
     log_info(f"Finished convert_model; JSON saved to {out}")
 

@@ -191,9 +191,10 @@ class CVBooster:
 
     def save_model(self, filename: str) -> "CVBooster":
         import json
+        from .robustness.checkpoint import atomic_write_text
         blob = {"best_iteration": self.best_iteration,
                 "boosters": [b.model_to_string() for b in self.boosters]}
-        open(filename, "w").write(json.dumps(blob))
+        atomic_write_text(str(filename), json.dumps(blob))
         return self
 
 

@@ -131,7 +131,9 @@ def _run_cohort(spec: Dict[str, Any], td: str, out_path: str, attempt: int,
     the full timeout)."""
     n = spec["nproc"]
     spec_path = os.path.join(td, f"spec_{attempt}.json")
-    with open(spec_path, "w") as fh:
+    # atomic: a worker that starts early must never read a half-written spec
+    from ..robustness.checkpoint import atomic_open
+    with atomic_open(spec_path, "w") as fh:
         json.dump(spec, fh)
     for r in range(n):
         for stale in (out_path, os.path.join(td, f"hb_{r}")):

@@ -22,6 +22,7 @@ never interrupted.
 """
 from __future__ import annotations
 
+import contextlib
 import glob
 import hashlib
 import io
@@ -66,15 +67,29 @@ _VOLATILE_PARAMS = frozenset({
 # atomic writes
 # ---------------------------------------------------------------------------
 
-def atomic_write_bytes(path: str, data: bytes) -> None:
-    """Write via a same-directory tmp file + fsync + ``os.replace`` so a
-    crash/preemption mid-write never leaves a partial file at ``path``."""
+@contextlib.contextmanager
+def atomic_open(path: str, mode: str = "w", **open_kw):
+    """Open a same-directory tmp file for writing; on clean ``with`` exit
+    it is fsynced and ``os.replace``d onto ``path``, on exception it is
+    unlinked — callers stream arbitrary content (binary datasets, GB-scale
+    CSV results) and a crash/preemption mid-write never leaves a partial
+    file at ``path``.  The one blessed write primitive (lgbtlint LGB005):
+    every atomic_write_* helper below rides it.
+
+    Truncating-write modes only: append/update modes would start from an
+    EMPTY tmp file and ``os.replace`` would silently discard everything
+    already at ``path`` — fail loudly instead."""
+    if "a" in mode or "+" in mode or "r" in mode:
+        raise ValueError(
+            f"atomic_open mode {mode!r} unsupported: the tmp file starts "
+            "empty, so append/update modes would truncate the destination; "
+            "use 'w'/'wb'/'x'/'xb'")
     d = os.path.dirname(path) or "."
     os.makedirs(d, exist_ok=True)
     tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
     try:
-        with open(tmp, "wb") as fh:
-            fh.write(data)
+        with open(tmp, mode, **open_kw) as fh:
+            yield fh
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, path)
@@ -84,6 +99,13 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
                 os.unlink(tmp)
             except OSError:
                 pass
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write via a same-directory tmp file + fsync + ``os.replace`` so a
+    crash/preemption mid-write never leaves a partial file at ``path``."""
+    with atomic_open(path, "wb") as fh:
+        fh.write(data)
 
 
 def atomic_write_text(path: str, text: str) -> None:
@@ -94,22 +116,9 @@ def atomic_write_lines(path: str, lines) -> None:
     """Streaming variant: writes an iterable of text chunks straight to
     the same-directory tmp file (constant memory — CLI predict outputs
     can be GBs) before the fsync + ``os.replace``."""
-    d = os.path.dirname(path) or "."
-    os.makedirs(d, exist_ok=True)
-    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
-    try:
-        with open(tmp, "w", encoding="utf-8") as fh:
-            for chunk in lines:
-                fh.write(chunk)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+    with atomic_open(path, "w", encoding="utf-8") as fh:
+        for chunk in lines:
+            fh.write(chunk)
 
 
 def _sha256_bytes(data: bytes) -> str:

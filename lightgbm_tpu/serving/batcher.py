@@ -150,7 +150,10 @@ class MicroBatcher:
             telemetry.observe("serve/latency_s",
                               time.perf_counter() - t0)
             telemetry.inc("serve/requests_fast")
-            self.served += 1
+            # the fast path runs on the CALLER thread and races the worker
+            # thread's batch-counter updates (lgbtlint LGB006)
+            with self._submit_lock:
+                self.served += 1
             fut: "Future[PredictResult]" = Future()
             fut.set_result(PredictResult(values, model.version, 1, 0.0))
             return fut
@@ -228,8 +231,9 @@ class MicroBatcher:
                     model.version, n, t0 - r.t_enqueue))
                 off += m
         dt = time.perf_counter() - t0
-        self.batches += 1
-        self.served += len(good)
+        with self._submit_lock:
+            self.batches += 1
+            self.served += len(good)
         telemetry.inc("serve/requests", len(good))
         telemetry.inc("serve/rows", n)
         telemetry.inc("serve/batches")

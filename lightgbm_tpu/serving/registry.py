@@ -201,18 +201,22 @@ class ModelRegistry:
             if self._warmup and model._compiled is not None:
                 model._compiled.warmup()
         except (OSError, UnicodeDecodeError) as e:
-            self.reloads_failed += 1
+            # counters mutate under the lock: /reload handler threads and
+            # an embedding caller can race here (lgbtlint LGB006)
+            with self._lock:
+                self.reloads_failed += 1
             telemetry.inc("serve/reload_failed")
             raise LightGBMError(f"cannot load serving model {path!r}: {e}")
         except LightGBMError:
-            self.reloads_failed += 1
+            with self._lock:
+                self.reloads_failed += 1
             telemetry.inc("serve/reload_failed")
             raise
         with self._lock:
             self._version += 1
             model.version = self._version
             self._current = model
-        self.reloads_ok += 1
+            self.reloads_ok += 1
         telemetry.inc("serve/reloads")
         telemetry.instant("serve:reload", version=model.version,
                           sha256=sha[:12])
@@ -236,8 +240,8 @@ class ModelRegistry:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             cur = self._current
-        out = {"reloads_ok": self.reloads_ok,
-               "reloads_failed": self.reloads_failed}
+            out = {"reloads_ok": self.reloads_ok,
+                   "reloads_failed": self.reloads_failed}
         if cur is not None:
             out["model"] = cur.describe()
         return out

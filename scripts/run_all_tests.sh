@@ -3,16 +3,29 @@
 # nightly consistency suites. ~17 min total on the 8-device CPU mesh.
 set -e
 cd "$(dirname "$0")/.."
-# telemetry first: cheapest suite, and a broken observability layer makes
+# lgbtlint first: the static-analysis gate (docs/ANALYSIS.md) is the
+# cheapest stage (< 10 s, no test models trained) and a jit-discipline /
+# atomic-IO / lock regression fails here with file:line before any suite
+# spends minutes training models
+echo "=== stage: lgbtlint static-analysis gate ==="
+python -m lightgbm_tpu.analysis
+# telemetry next: cheapest suite, and a broken observability layer makes
 # every later perf triage lie
+echo "=== stage: telemetry fast tier ==="
 python -m pytest tests/test_telemetry.py -x -q
+# the analysis-engine suite rides with it (per-rule tripping fixtures +
+# the repo-clean findings==baseline gate test; no models trained)
+echo "=== stage: analysis-engine fast tier ==="
+python -m pytest tests/test_analysis.py -x -q
 # robustness fast tier next: checkpoint/resume bit-identity and the chaos
 # guard paths protect every longer suite below from wasted reruns (the
 # multi-process kill/retry/hang cases are in the slow tier)
+echo "=== stage: robustness fast tier ==="
 python -m pytest tests/test_robustness.py -x -q -m 'not slow'
 # serving fast tier: the online path (bucketed compiled predictor,
 # micro-batcher, hot reload) is bit-identity-gated against predict, so a
 # regression here flags scoring breakage before the long suites run
+echo "=== stage: serving fast tier ==="
 python -m pytest tests/test_serving.py -x -q -m 'not slow'
 # distributed fast tier on a 4-device CPU mesh: the reduce-scatter comms
 # path (psum vs reduce_scatter bit-identity, comms-bytes counters,
@@ -20,9 +33,27 @@ python -m pytest tests/test_serving.py -x -q -m 'not slow'
 # conftest keeps a pre-set device-count flag, so this exercises D=4 while
 # the full suites below run the default 8
 # keep any caller-provided XLA flags, overriding only the device count
+echo "=== stage: distributed fast tier (D=4) ==="
 XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
     | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
 --xla_force_host_platform_device_count=4" \
     python -m pytest tests/test_distributed_fast.py -x -q
+echo "=== stage: full fast tier ==="
 python -m pytest tests/ -x -q
-python -m pytest tests/ -x -q -m slow
+# native sanitizer tier: builds native/binner.cpp under ASan/UBSan and
+# drives every extern-C entry point (incl. the categorical bitset
+# walker's word-index edges) — the reference's sanitizer CI lanes.
+# Runs as its own labeled stage so a toolchain-less box reports WHY the
+# lane did not run instead of silently skipping inside the slow suite.
+echo "=== stage: native sanitizer tier (ASan/UBSan) ==="
+if command -v g++ >/dev/null 2>&1; then
+    python -m pytest tests/test_native_sanitizers.py -x -q -m slow
+else
+    echo "NOTICE: no g++ toolchain on this machine — native ASan/UBSan"
+    echo "lane SKIPPED (install g++ with libasan/libubsan to enable)"
+fi
+echo "=== stage: slow consistency tier ==="
+# sanitizers already ran (or were skipped with notice) in their own
+# stage above — don't rebuild and rerun the ASan/UBSan binary here
+python -m pytest tests/ -x -q -m slow \
+    --ignore=tests/test_native_sanitizers.py

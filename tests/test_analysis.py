@@ -1,0 +1,273 @@
+"""lgbtlint engine + rule-catalog tests (docs/ANALYSIS.md).
+
+One tripping fixture per rule (asserting the rule id AND the line), the
+suppression-baseline round-trip, and the repo-wide ``findings == baseline``
+gate that keeps the analyzer clean on every fast-tier run.
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from lightgbm_tpu.analysis import engine as eng
+from lightgbm_tpu.analysis.rules import all_rules
+from lightgbm_tpu.analysis.rules.atomic_io import AtomicIORule
+from lightgbm_tpu.analysis.rules.collective_axis import CollectiveAxisRule
+from lightgbm_tpu.analysis.rules.config_doc import ConfigDocRule
+from lightgbm_tpu.analysis.rules.determinism import DeterminismRule
+from lightgbm_tpu.analysis.rules.host_sync import HostSyncRule
+from lightgbm_tpu.analysis.rules.jit_discipline import JitDisciplineRule
+from lightgbm_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_snippet(tmp_path, source, rule, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    return eng.run_analysis(tmp_path, files=[p], rules=[rule])
+
+
+# ---------------------------------------------------------------------------
+# one tripping fixture per rule
+# ---------------------------------------------------------------------------
+
+def test_lgb001_bare_jit_trips(tmp_path):
+    src = ("import jax\n"
+           "import functools\n"
+           "f = jax.jit(lambda x: x + 1)\n"                      # line 3
+           "g = functools.partial(jax.jit, static_argnums=0)\n"  # line 4
+           "@jax.jit\n"                                          # line 5
+           "def h(x):\n"
+           "    return x\n")
+    found = run_snippet(tmp_path, src, JitDisciplineRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB001", 3), ("LGB001", 4), ("LGB001", 5)]
+    assert "watchdog" in found[0].message
+
+
+def test_lgb001_watched_and_wrapped_pallas_clean(tmp_path):
+    src = ("import functools\n"
+           "from lightgbm_tpu.telemetry.watchdog import watched_jit\n"
+           "from jax.experimental import pallas as pl\n"
+           "@functools.partial(watched_jit, name='k', warn_after=0)\n"
+           "def kernel(x):\n"
+           "    return pl.pallas_call(None, out_shape=x)(x)\n")
+    assert run_snippet(tmp_path, src, JitDisciplineRule()) == []
+
+
+def test_lgb001_bare_pallas_call_trips(tmp_path):
+    src = ("from jax.experimental import pallas as pl\n"
+           "def kernel(x):\n"
+           "    return pl.pallas_call(None, out_shape=x)(x)\n")   # line 3
+    found = run_snippet(tmp_path, src, JitDisciplineRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB001", 3)]
+
+
+def test_lgb002_host_sync_trips(tmp_path):
+    src = ("from lightgbm_tpu.telemetry.watchdog import watched_jit\n"
+           "import numpy as np\n"
+           "def build(engine):\n"
+           "    def _fn(grad, hess):\n"
+           "        total = grad + hess\n"
+           "        bad = float(total)\n"                         # line 6
+           "        arr = np.asarray(grad)\n"                     # line 7
+           "        n = int(grad.shape[0])\n"                     # static: ok
+           "        return bad + arr.sum() + n\n"
+           "    return watched_jit(_fn, name='g', owner=engine)\n")
+    found = run_snippet(tmp_path, src, HostSyncRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB002", 6), ("LGB002", 7)]
+    assert "host sync" in found[0].message
+
+
+def test_lgb002_jnp_asarray_clean(tmp_path):
+    # jnp.asarray is device-side: must NOT be confused with numpy.asarray
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return jnp.asarray(x) + 1\n")
+    assert run_snippet(tmp_path, src, HostSyncRule()) == []
+
+
+def test_lgb003_unbound_axis_trips(tmp_path):
+    src = ("import jax\n"
+           "from jax.sharding import PartitionSpec as P\n"
+           "SPEC = P('data')\n"
+           "def local(h):\n"
+           "    good = jax.lax.psum(h, 'data')\n"
+           "    return jax.lax.psum(good, 'dta')\n")              # line 6
+    found = run_snippet(tmp_path, src, CollectiveAxisRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB003", 6)]
+    assert "'dta'" in found[0].message and "data" in found[0].message
+
+
+def test_lgb003_variable_axis_clean(tmp_path):
+    src = ("import jax\n"
+           "def local(h, axis):\n"
+           "    return jax.lax.psum(h, axis)\n")
+    assert run_snippet(tmp_path, src, CollectiveAxisRule()) == []
+
+
+def test_lgb004_determinism_trips(tmp_path):
+    src = ("import time\n"
+           "import numpy as np\n"
+           "import jax\n"
+           "mask = np.random.rand(16) < 0.5\n"                   # line 4
+           "for g in {'a', 'b'}:\n"                              # line 5
+           "    print(g)\n"
+           "cols = [c for c in set(['x', 'y'])]\n"               # line 7
+           "good = sorted(set(['x', 'y']))\n"                    # sorted: ok
+           "rs = np.random.RandomState(7)\n"                     # seeded: ok
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x * time.time()\n")                       # line 12
+    found = run_snippet(tmp_path, src, DeterminismRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB004", 4), ("LGB004", 5), ("LGB004", 7), ("LGB004", 12)]
+
+
+def test_lgb005_atomic_io_trips(tmp_path):
+    src = ("import os, json\n"
+           "def bad(path, blob):\n"
+           "    with open(path, 'w') as fh:\n"                   # line 3
+           "        json.dump(blob, fh)\n"
+           "def good(path, blob):\n"
+           "    tmp = path + '.tmp'\n"
+           "    with open(tmp, 'w') as fh:\n"                    # replaced: ok
+           "        json.dump(blob, fh)\n"
+           "    os.replace(tmp, path)\n"
+           "def append(path, line):\n"
+           "    with open(path, 'a') as fh:\n"                   # append: ok
+           "        fh.write(line)\n")
+    found = run_snippet(tmp_path, src, AtomicIORule())
+    assert [(f.rule, f.line) for f in found] == [("LGB005", 3)]
+    assert "os.replace" in found[0].message
+
+
+def test_lgb006_lock_discipline_trips(tmp_path):
+    src = ("import threading\n"
+           "class Registry:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.loads = 0\n"
+           "        self._current = None\n"
+           "    def swap(self, model):\n"
+           "        with self._lock:\n"
+           "            self._current = model\n"
+           "        self.loads += 1\n"                           # line 10
+           "    def sneak(self, model):\n"
+           "        self._current = model\n")                    # line 12
+    found = run_snippet(tmp_path, src, LockDisciplineRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB006", 10), ("LGB006", 12)]
+    assert "races" in found[0].message
+
+
+def test_lgb006_lockless_class_clean(tmp_path):
+    src = ("class Plain:\n"
+           "    def __init__(self):\n"
+           "        self.count = 0\n"
+           "    def bump(self):\n"
+           "        self.count += 1\n")
+    assert run_snippet(tmp_path, src, LockDisciplineRule()) == []
+
+
+def test_lgb007_doc_drift_trips(tmp_path):
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "scripts" / "gen_params_doc.py").write_text(
+        "def render_doc():\n"
+        "    return '| `alpha` |\\n| `beta` |\\n'\n")
+    (tmp_path / "docs" / "Parameters.md").write_text("| `alpha` |\n")
+    found = list(ConfigDocRule().check_repo(tmp_path, []))
+    assert [f.rule for f in found] == ["LGB007"]
+    assert "beta" in found[0].message
+    # in-sync doc -> clean
+    (tmp_path / "docs" / "Parameters.md").write_text(
+        "| `alpha` |\n| `beta` |\n")
+    assert list(ConfigDocRule().check_repo(tmp_path, [])) == []
+
+
+def test_lgb007_respects_changed_only_trigger(tmp_path):
+    # no trigger file changed -> the (expensive) check is skipped entirely
+    assert list(ConfigDocRule().check_repo(
+        tmp_path, [], changed=["lightgbm_tpu/ops/grow.py"])) == []
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: baseline round-trip, stale entries, parse errors
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src = "f = open('out.txt', 'w')\n"
+    found = run_snippet(tmp_path, src, AtomicIORule())
+    assert len(found) == 1
+    entries = [eng.Suppression(f.rule, f.file, f.line, "fixture pin")
+               for f in found]
+    bpath = tmp_path / "baseline.toml"
+    bpath.write_text(eng.render_baseline(entries))
+    loaded = eng.load_baseline(bpath)
+    assert loaded == entries
+    active, suppressed, stale = eng.apply_baseline(found, loaded)
+    assert active == [] and len(suppressed) == 1 and stale == []
+
+
+def test_baseline_stale_entry_detected(tmp_path):
+    sup = eng.Suppression("LGB005", "gone.py", 3, "was fixed")
+    active, suppressed, stale = eng.apply_baseline([], [sup])
+    assert active == [] and suppressed == [] and stale == [sup]
+
+
+def test_baseline_requires_reason(tmp_path):
+    bpath = tmp_path / "baseline.toml"
+    bpath.write_text('[[suppress]]\nrule = "LGB001"\nfile = "x.py"\n'
+                     'line = 1\nreason = ""\n')
+    with pytest.raises(ValueError, match="justification"):
+        eng.load_baseline(bpath)
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def oops(:\n")
+    found = eng.run_analysis(tmp_path, files=[p], rules=[])
+    assert [f.rule for f in found] == ["LGB000"]
+    assert "parse" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# the repo gate: today's tree is clean modulo the reviewed baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_findings_match_baseline():
+    """The CI gate's exact semantics: every finding on the current tree is
+    pinned by a justified baseline entry, and no baseline entry is stale.
+    A regression in jit discipline, atomic IO, lock usage, determinism, or
+    config<->doc sync fails THIS test with file:line."""
+    findings = eng.run_analysis(REPO)
+    baseline = eng.load_baseline(eng.default_baseline_path(REPO))
+    active, suppressed, stale = eng.apply_baseline(findings, baseline)
+    assert active == [], "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in active)
+    assert stale == [], "stale baseline entries: " + ", ".join(
+        f"{s.file}:{s.line}" for s in stale)
+    for s in baseline:
+        assert s.reason.strip() and not s.reason.startswith("TODO")
+
+
+def test_cli_json_output(capsys, monkeypatch):
+    monkeypatch.chdir(REPO)
+    rc = eng.main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == [] and out["stale_baseline"] == []
+    assert len(out["checked_rules"]) == 7
+
+
+def test_cli_list_rules(capsys):
+    assert eng.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("LGB001", "LGB002", "LGB003", "LGB004", "LGB005",
+                "LGB006", "LGB007"):
+        assert rid in out

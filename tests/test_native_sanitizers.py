@@ -58,30 +58,48 @@ int main() {
     lgbt_value_to_bin(vals.data(), (int64_t)vals.size(), ub.data(),
                       (int32_t)ub.size(), mt, 5, 1, bins.data());
 
-  // two-tree walk: numeric split w/ NaN default-left + categorical bitset
+  // three-tree walk: numeric split w/ NaN default-left + two categorical
+  // bitset splits, the second exercising the WORD-INDEX edge of the bitset
+  // walker (iv/32 selecting word 0/1, the last set bit 63, the first
+  // out-of-range category 64, and a far-out-of-range 1e12 — each must be
+  // an in-bounds read of cat_threshold or a clean go-right, never a read
+  // past the ordinal's [s, e) word span; UBSan/ASan abort otherwise)
   // tree 0: 1 internal node (feature 0 <= 0.5), leaves -0.5 / 0.5
-  // tree 1: categorical node on feature 1, bitset holds category 3
-  std::vector<int32_t> tree_off = {0, 1, 2};
-  std::vector<int32_t> split_feature = {0, 1};
-  std::vector<double> threshold = {0.5, 0.0};
-  std::vector<int32_t> threshold_bin = {0, 0};   // cat ordinal for tree 1
+  // tree 1: categorical on feature 1, ONE-word bitset holding category 3
+  // tree 2: categorical on feature 1, TWO-word bitset (ordinal 1) holding
+  //         categories 32 (word 1, bit 0) and 63 (word 1, bit 31)
+  std::vector<int32_t> tree_off = {0, 1, 2, 3};
+  std::vector<int32_t> split_feature = {0, 1, 1};
+  std::vector<double> threshold = {0.5, 0.0, 0.0};
+  std::vector<int32_t> threshold_bin = {0, 0, 1};   // cat ordinals
   std::vector<uint8_t> decision_type = {(uint8_t)(2 | (2 << 2)),
-                                        (uint8_t)1};
-  std::vector<int32_t> left = {~0, ~0}, right = {~1, ~1};
-  std::vector<int32_t> leaf_off = {0, 2};
-  std::vector<double> leaf_value = {-0.5, 0.5, -2.0, 2.0};
-  std::vector<int32_t> cat_boundaries = {0, 1};
-  std::vector<uint32_t> cat_threshold = {1u << 3};
-  double rowvals[4][2] = {{0.0, 3.0}, {1.0, 3.0},
-                          {std::nan(""), 7.0}, {0.2, -1.0}};
-  double expect[4] = {
-      -0.5 + -2.0,   // 0.0 <= 0.5 left; cat 3 in bitset -> left (-2.0)
-      0.5 + -2.0,    // 1.0 > 0.5 right; cat 3 -> left
-      -0.5 + 2.0,    // NaN numeric -> default_left; cat 7 not set -> right
-      -0.5 + 2.0};   // 0.2 left; cat -1 (negative) -> right
-  for (int r = 0; r < 4; ++r) {
+                                        (uint8_t)1, (uint8_t)1};
+  std::vector<int32_t> left = {~0, ~0, ~0}, right = {~1, ~1, ~1};
+  std::vector<int32_t> leaf_off = {0, 2, 4};
+  std::vector<double> leaf_value = {-0.5, 0.5, -2.0, 2.0, -8.0, 8.0};
+  std::vector<int32_t> cat_boundaries = {0, 1, 3};
+  std::vector<uint32_t> cat_threshold = {1u << 3,          // ordinal 0
+                                         0u,               // ord 1 word 0
+                                         1u | (1u << 31)}; // ord 1 word 1
+  double rowvals[8][2] = {{0.0, 3.0}, {1.0, 3.0},
+                          {std::nan(""), 7.0}, {0.2, -1.0},
+                          {0.0, 32.0},   // word boundary: first bit, word 1
+                          {0.0, 63.0},   // last bit of the last word
+                          {0.0, 64.0},   // first category past the span
+                          {0.0, 1e12}};  // way past: iv/32 >> e - s
+  double expect[8] = {
+      -0.5 + -2.0 + 8.0,  // cat 3: tree1 left, tree2 word0 bit3 unset
+      0.5 + -2.0 + 8.0,   // 1.0 > 0.5 right; cat 3 -> left / right
+      -0.5 + 2.0 + 8.0,   // NaN numeric -> default_left; cat 7 unset
+      -0.5 + 2.0 + 8.0,   // 0.2 left; cat -1 (negative) -> right
+      -0.5 + 2.0 + -8.0,  // cat 32: tree1 word span is 1 -> right,
+                          //         tree2 word 1 bit 0 set -> left
+      -0.5 + 2.0 + -8.0,  // cat 63: tree2 word 1 bit 31 set -> left
+      -0.5 + 2.0 + 8.0,   // cat 64: word 2 outside span -> right
+      -0.5 + 2.0 + 8.0};  // cat 1e12: far outside every span -> right
+  for (int r = 0; r < 8; ++r) {
     double acc[1] = {0.0};
-    lgbt_predict_row(rowvals[r], tree_off.data(), 2, split_feature.data(),
+    lgbt_predict_row(rowvals[r], tree_off.data(), 3, split_feature.data(),
                      threshold.data(), threshold_bin.data(),
                      decision_type.data(), left.data(), right.data(),
                      leaf_off.data(), leaf_value.data(),
