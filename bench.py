@@ -2,7 +2,9 @@
 
 Prints one JSON line per workload: {"metric", "value", "unit", "vs_baseline",
 "peak_hbm_gb", "host_rss_gb"}.  A plain `python bench.py` runs BOTH; set
-BENCH_TASK=higgs or BENCH_TASK=ranking to run just one.
+BENCH_TASK=higgs or BENCH_TASK=ranking to run just one.  BENCH_TASK=goss
+runs the GOSS row-compaction A/B (s/tree + sampled fraction vs the
+unsampled run, AUC- and speedup-gated; writes BENCH_GOSS.json).
 
 Baseline: LightGBM CPU trains HIGGS (10.5M rows x 28 features, num_leaves=255,
 lr=0.1, 500 iters) in 130.094 s => 0.2602 s/tree on a 28-core Haswell
@@ -398,6 +400,100 @@ def auc_score(y, p):
     return (r[y > 0.5].sum() - npos * (npos - 1) / 2) / (npos * nneg)
 
 
+def run_goss():
+    """BENCH_TASK=goss: GOSS sampling + row compaction (ROADMAP item 1,
+    docs/PERF.md "sample-strategy speedups") — s/tree and sampled-row
+    fraction vs the UNSAMPLED HIGGS-like run at the default
+    top_rate=0.2/other_rate=0.1, gated on holdout AUC (same gate as the
+    main run: a fast-but-wrong sampler cannot pass) AND on the speedup
+    (>= BENCH_GOSS_SPEEDUP_GATE, default 2x: tree cost must actually
+    scale with the sampled row count, not just mask rows).
+
+    Both arms run the batched-round shape (max_splits_per_round=64 — the
+    TPU stream default) so the measured cost is the histogram passes the
+    sampling attacks; the CPU-auto exact-best-first shape would spend its
+    time in 254 single-split rounds instead.  The GOSS arm times trees
+    AFTER the reference's 1/learning_rate warmup iterations (goss.hpp
+    trains unsampled until then), i.e. the steady-state sampled regime."""
+    import lightgbm_tpu as lgb
+
+    rss0 = _rss_kb()
+    n_iters = int(os.environ.get("BENCH_GOSS_ITERS", N_ITERS))
+    speed_gate = float(os.environ.get("BENCH_GOSS_SPEEDUP_GATE", 2.0))
+    X, y = make_higgs_like(N_ROWS, N_FEATURES)
+    n_test = min(500_000, N_ROWS // 10)
+    X_tr, y_tr = X[:-n_test], y[:-n_test]
+    X_te, y_te = X[-n_test:], y[-n_test:]
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "verbosity": -1,
+        "max_splits_per_round": 64,
+        "use_quantized_grad": True,
+        "num_grad_quant_bins": 64,
+    }
+    extra = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    if extra:
+        params.update(json.loads(extra))
+    if os.environ.get("BENCH_TELEMETRY", "") == "1":
+        params.setdefault("telemetry", True)
+
+    def timed(p, warmup):
+        ds = lgb.Dataset(X_tr, label=y_tr)
+        bst = lgb.Booster(p, ds)
+        for _ in range(warmup):
+            bst.update()
+        bst.engine.score.block_until_ready()
+        t0 = time.time()
+        for _ in range(n_iters):
+            bst.update()
+        bst.engine.score.block_until_ready()
+        return (time.time() - t0) / n_iters, bst
+
+    dense_s, _ = timed(params, warmup=1)
+    goss_warmup = int(1.0 / params["learning_rate"]) + 1
+    goss_s, bst = timed(dict(params, data_sample_strategy="goss"),
+                        warmup=goss_warmup)
+    sampled = bst.engine._last_sampled_rows or 0
+    frac = sampled / max(bst.engine.num_data, 1)
+    compact = bst.engine._last_compact_rows
+    speedup = dense_s / max(goss_s, 1e-12)
+    auc = auc_score(y_te, bst.predict(X_te, raw_score=True))
+    scale = HIGGS_ROWS / N_ROWS
+    ok = auc >= AUC_GATE and speedup >= speed_gate and compact > 0
+    import jax
+    record = {
+        "metric": "higgs_like_goss_s_per_tree",
+        "value": round(goss_s * scale, 4),
+        "unit": (f"s/tree, GOSS top0.2/other0.1 row-compacted (unsampled "
+                 f"arm {dense_s * scale:.4f}; sampled fraction {frac:.3f}; "
+                 f"holdout AUC {auc:.4f} "
+                 f"{'>=' if auc >= AUC_GATE else '< GATE '}{AUC_GATE}; "
+                 f"speedup {speedup:.2f}x "
+                 f"{'>=' if speedup >= speed_gate else '< GATE '}"
+                 f"{speed_gate}x)"),
+        # vs_baseline = measured speedup over the unsampled run (the gate)
+        "vs_baseline": round(speedup, 3) if ok else 0.0,
+        "dense_s_per_tree": round(dense_s * scale, 4),
+        "sampled_fraction": round(frac, 4),
+        "compact_rows_per_shard": compact,
+        "auc": round(float(auc), 5),
+        "rows": N_ROWS,
+        "platform": jax.default_backend(),
+        **_memory_fields(rss0),
+        **_telemetry_fields(bst),
+    }
+    print(json.dumps(record), flush=True)
+    from lightgbm_tpu.robustness.checkpoint import atomic_open
+    with atomic_open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "BENCH_GOSS.json"), "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    return ok
+
+
 def main():
     import lightgbm_tpu as lgb
 
@@ -772,9 +868,11 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_SERVE", "") == "1":
         sys.exit(0 if run_serve_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
-    if task not in ("", "higgs", "ranking", "multiclass"):
+    if task not in ("", "higgs", "ranking", "multiclass", "goss"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "
-                 "multiclass")
+                 "multiclass, goss")
+    if task == "goss":
+        sys.exit(0 if run_goss() else 1)
     ok = True
     if task in ("", "higgs"):
         ok = main() and ok

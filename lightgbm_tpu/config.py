@@ -180,6 +180,7 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "hist_comms": ["histogram_comms"],        # psum | reduce_scatter
     "hist_comms_dtype": ["histogram_comms_dtype"],  # f32 | bf16_pair
+    "row_compaction": ["sample_compaction"],  # auto | off | pad
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
     # --- robustness (docs/ROBUSTNESS.md) ---
     "nan_guard": ["nan_policy"],
@@ -325,7 +326,14 @@ class Config:
     xgboost_dart_mode: bool = False
     uniform_drop: bool = False
     drop_seed: int = 4
+    # GOSS: fraction of rows with the largest |grad*hess| always kept
+    # (data_sample_strategy=goss); top_rate + other_rate must be <= 1.0,
+    # and GOSS rejects an ACTIVE bagging config (bagging_freq > 0 with
+    # bagging_fraction < 1.0) — both enforced like the reference's
+    # Config::CheckParamConflict
     top_rate: float = 0.2
+    # GOSS: uniformly sampled fraction of the remaining rows; their
+    # gradients are amplified by (1 - top_rate) / other_rate
     other_rate: float = 0.1
     min_data_per_group: int = 100
     max_cat_threshold: int = 32
@@ -471,6 +479,15 @@ class Config:
     # and the cross-device accumulation runs in f32. Halves the wire
     # payload; opt-in (not bit-identical to psum).
     hist_comms_dtype: str = "f32"
+    # GOSS/bagging row compaction (docs/PERF.md "sample-strategy
+    # speedups"): auto = when a sampling mask is sparse enough, one
+    # stable partition per tree compacts the in-bag rows so histogram
+    # MACs scale with the SAMPLED row count; off = legacy dense masking
+    # (masked rows still stream through the kernel); pad = partition but
+    # keep the full row count (A/B reference — byte-identical trees to
+    # auto, proving compaction drops only exact-zero work).
+    # LGBTPU_COMPACT=auto|off|pad overrides for experiments.
+    row_compaction: str = "auto"
     tpu_dtype: str = "f32"
 
     # --- robustness (docs/ROBUSTNESS.md) ---
@@ -562,6 +579,39 @@ class Config:
             raise ValueError(
                 f"nan_guard={self.nan_guard!r} is not one of "
                 f"{', '.join(repr(m) for m in VALID_MODES)}")
+        from .utils.log import LightGBMError
+        if str(self.row_compaction).strip().lower() not in (
+                "auto", "off", "pad"):
+            raise LightGBMError(
+                f"row_compaction={self.row_compaction!r} is not one of "
+                "'auto', 'off', 'pad'")
+        # GOSS parameter conflicts (reference: Config::CheckParamConflict,
+        # src/io/config.cpp — "cannot use bagging in GOSS" and the sampled
+        # fractions must partition the data)
+        use_goss = (str(self.data_sample_strategy).strip().lower() == "goss"
+                    or str(self.boosting).strip().lower() == "goss")
+        if use_goss:
+            if self.top_rate < 0.0 or self.other_rate < 0.0:
+                raise LightGBMError(
+                    f"GOSS rates must be non-negative, got top_rate="
+                    f"{self.top_rate}, other_rate={self.other_rate}")
+            if self.top_rate + self.other_rate > 1.0:
+                raise LightGBMError(
+                    f"top_rate + other_rate must be <= 1.0 for GOSS, got "
+                    f"{self.top_rate} + {self.other_rate} = "
+                    f"{self.top_rate + self.other_rate}")
+            bagging_on = (self.bagging_fraction < 1.0
+                          or self.pos_bagging_fraction < 1.0
+                          or self.neg_bagging_fraction < 1.0)
+            if self.bagging_freq > 0 and bagging_on:
+                # only an ACTIVE bagging config conflicts (the reference's
+                # CheckParamConflict gate: bagging needs freq > 0 AND a
+                # sub-1.0 fraction — plain or pos/neg-balanced); an
+                # inactive bagging_freq stays accepted for compatibility
+                raise LightGBMError(
+                    "GOSS (data_sample_strategy=goss) cannot be combined "
+                    "with bagging; set bagging_freq=0 (reference: "
+                    "Config::CheckParamConflict)")
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
                 # rf requires bagging (reference: config.cpp CheckParamConflict)

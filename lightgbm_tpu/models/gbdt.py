@@ -223,14 +223,19 @@ class GBDT:
         self._check_unsupported_params()
         self._grow_params = self._make_grow_params()
         packed = None
+        # row-compaction capacity quantum: compacted views must stay whole
+        # multiples of the stream kernel block (smaller-tier K-widened
+        # blocks are powers of two, so multiples of the pack block divide
+        # them too); contraction backends have no block constraint but
+        # reuse the same quantum for bounded jit-capacity buckets
+        self._pack_block = 256
         if self._grow_params.hist_backend == "stream":
             from ..pallas.stream_kernel import (pack_bins_T,
                                                stream_block_rows)
-            packed = pack_bins_T(dd.bins,
-                                 stream_block_rows(
-                                     dd.max_bins, dd.num_groups,
-                                     self._grow_params.int_hist,
-                                     bin_buckets=self._grow_params.bin_buckets),
+            self._pack_block = stream_block_rows(
+                dd.max_bins, dd.num_groups, self._grow_params.int_hist,
+                bin_buckets=self._grow_params.bin_buckets)
+            packed = pack_bins_T(dd.bins, self._pack_block,
                                  max_bins=dd.max_bins).bins_T
             if self._mesh_stream:
                 # rows were pre-padded to a whole kernel block per device, so
@@ -256,7 +261,15 @@ class GBDT:
             mesh=self.mesh if self._mesh_stream else None,
             row_axis=self._row_axis)
         self._grow_fn = watched_jit(self._grow_partial, name="grow_tree",
-                                    owner=self)
+                                    owner=self,
+                                    static_argnames=("compact_rows",))
+        # per-iteration sampled-row telemetry + the compaction capacity the
+        # last grow call ran at (0 = dense masking); _compact_cap is the
+        # sticky capacity choice (see _row_compaction_capacity)
+        self._last_sampled_rows: Optional[int] = None
+        self._last_compact_rows = 0
+        self._compact_cap = 0
+        self._sample_count_cache: Optional[Tuple[int, np.ndarray]] = None
         self._grow_fn_k = None
         self._grow_fn_kb = None
         self._score_add_k_fn = None
@@ -377,6 +390,95 @@ class GBDT:
         spec = self._row_sharding.spec
         return jax.device_put(
             a, NamedSharding(self._row_sharding.mesh, P(spec[0], None)))
+
+    # ------------------------------------------------------------------
+    def _row_compaction_capacity(self, mask) -> int:
+        """Static PER-SHARD row capacity for this iteration's GOSS/bagging
+        row compaction (docs/PERF.md "sample-strategy speedups"); 0 keeps
+        the legacy dense-mask path.
+
+        The in-bag count is read back eagerly (one device sync — the
+        sampled path already runs eagerly) and bucketed to a ~3%-granular
+        multiple of the kernel block, so the jitted grower specializes to
+        a handful of capacities per run, not one per tree.  Under the
+        row-sharded mesh the capacity covers the FULLEST shard (every
+        device compacts its own rows to the same static size).
+        row_compaction=pad partitions but keeps the full row count — the
+        A/B reference the bit-identity suite compares against."""
+        if not self.sample_strategy.is_active():
+            return 0
+        import os as _os
+        mode = str(_os.environ.get("LGBTPU_COMPACT", "")
+                   or self.config.row_compaction).strip().lower()
+        if mode not in ("auto", "off", "pad"):
+            # Config validated its own (case-insensitive) value, so this
+            # can only be an LGBTPU_COMPACT typo — which must not silently
+            # run as "auto"
+            from ..utils.log import LightGBMError
+            raise LightGBMError(
+                f"LGBTPU_COMPACT={mode!r} is not one of 'auto', 'off', "
+                "'pad'")
+        gp = self._grow_params
+        eligible = (mode != "off"
+                    and gp.hist_backend in ("stream", "segsum", "onehot")
+                    and not self._voting
+                    and (self.mesh is None or self._mesh_stream))
+        if not eligible and not _tel_tracer.enabled:
+            # opted-out / ineligible runs keep the legacy fully-async
+            # pipeline: no per-iteration count readback (the sync below
+            # exists for the capacity choice and the telemetry field)
+            return 0
+        n_rows = self.dd.bins.shape[0]
+        D = 1
+        if self._mesh_stream and self._row_axis is not None:
+            D = int(self.mesh.shape[self._row_axis])
+        local = n_rows // D
+        # per-mask count cache: bagging reuses one mask for a whole
+        # bagging_freq epoch (mask_key = epoch), so the blocking count
+        # readback — a full device sync — runs once per DISTINCT mask,
+        # not once per iteration (GOSS draws a fresh mask every
+        # iteration, so its key never repeats)
+        ck = self.sample_strategy.mask_key(self.iter_)
+        if self._sample_count_cache is not None \
+                and self._sample_count_cache[0] == ck:
+            counts = self._sample_count_cache[1]
+        else:
+            with global_timer.scope("GBDT::SampleCount"), \
+                    _tel_tracer.span("GBDT::SampleCount"):
+                counts = np.asarray(jax.device_get(
+                    (mask > 0).reshape(D, local).sum(axis=1)))
+            self._sample_count_cache = (ck, counts)
+        self._last_sampled_rows = int(counts.sum())
+        if not eligible:
+            return 0
+        unit = self._pack_block
+        q = max(unit, -(-local // (32 * unit)) * unit)
+        nc_max = int(counts.max())
+        cap_min = max(unit, (-(-nc_max // q)) * q)
+        if nc_max * 4 >= local * 3 or cap_min >= local:
+            # <25% in-bag row savings (or block quantization ate them): the
+            # partition pass + the per-round full-data route-only pass would
+            # eat the win — stay dense
+            return 0
+        if mode == "pad":
+            # full row count, rounded UP to the kernel block — the stream
+            # operands are padded to whole blocks, so an unaligned dataset
+            # row count (anything not a block multiple after the 256-row
+            # Dataset pad) must not reach the grower's alignment check
+            return -(-local // unit) * unit
+        # STICKY capacity with one quantum of headroom: the in-bag count
+        # jitters a few sigma between iterations (GOSS's uniform b-sample
+        # is binomial), and any crossing of a bucket boundary changes the
+        # static compact_rows jit arg — i.e. recompiles the grower
+        # MID-RUN.  Reusing the last capacity while it still covers nc
+        # (and still saves rows) pins the program to one compile per run;
+        # padding rows past nc carry exact-zero weights, so the capacity
+        # choice never changes the grown tree (the pad-mode A/B).
+        if cap_min <= self._compact_cap < local:
+            return self._compact_cap
+        cap = cap_min + q if cap_min + q < local else cap_min
+        self._compact_cap = cap
+        return cap
 
     # ------------------------------------------------------------------
     def _comms_model(self) -> Optional[Dict[str, Any]]:
@@ -1083,7 +1185,7 @@ class GBDT:
         return force == "1" or self.config.multiclass_batched
 
     def _grow_classes_batched(self, grad, hess, mask, col_mask, gh_scales,
-                              k: int):
+                              k: int, compact_rows: int = 0):
         """All K class trees from ONE widened lockstep program
         (ops.grow.grow_tree_k): the dominant one-hot bin construct and its
         MXU contraction are built once per growth round and contract
@@ -1095,24 +1197,29 @@ class GBDT:
             mesh = self.mesh if self._mesh_stream else None
             row_axis = self._row_axis
 
-            def _fn(bins, grad2, hess2, mask, colm, packed, scales):
+            def _fn(bins, grad2, hess2, mask, colm, packed, scales,
+                    compact_rows=0):
                 return grow_tree_k(bins, grad2.T, hess2.T, mask, colm,
                                    layout=dd.layout, routing=dd.routing,
                                    params=gp, packed=packed,
                                    gh_scales=scales, mesh=mesh,
-                                   row_axis=row_axis)
+                                   row_axis=row_axis,
+                                   compact_rows=compact_rows)
 
             self._grow_fn_kb = watched_jit(_fn, name="grow_tree_k",
-                                           owner=self)
+                                           owner=self,
+                                           static_argnames=("compact_rows",))
         scales = (jnp.transpose(gh_scales) if gh_scales is not None
                   else jnp.zeros((k, 2), jnp.float32))
         arrays_k, leaf_k = self._grow_fn_kb(
-            self.dd.bins, grad, hess, mask, col_mask, self._packed, scales)
+            self.dd.bins, grad, hess, mask, col_mask, self._packed, scales,
+            compact_rows=compact_rows)
         self._mc_stacked = (arrays_k, leaf_k)
         return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
                 for kk in range(k)]
 
-    def _grow_classes(self, grad, hess, mask, col_mask, gh_scales, k: int):
+    def _grow_classes(self, grad, hess, mask, col_mask, gh_scales, k: int,
+                      compact_rows: int = 0):
         """Grow all K class trees inside one jitted program: the widened
         lockstep path (grow_tree_k) when eligible, else a lax.scan over
         classes (one launch per iteration either way; reference: the
@@ -1120,18 +1227,20 @@ class GBDT:
         self._mc_batched_last = self._use_batched_multiclass()
         if self._mc_batched_last:
             return self._grow_classes_batched(grad, hess, mask, col_mask,
-                                              gh_scales, k)
+                                              gh_scales, k, compact_rows)
         if self._grow_fn_k is None:
             grow = self._grow_partial
             needs_key = self._needs_grow_key
 
-            def _fn(bins, grad2, hess2, mask, colm, packed, scales, keys):
+            def _fn(bins, grad2, hess2, mask, colm, packed, scales, keys,
+                    compact_rows=0):
                 def body(_, xs):
                     g, h, key1, sc = xs
                     arrays, lid = grow(
                         bins, g, h, mask, colm,
                         key=(key1 if needs_key else None),
-                        packed=packed, cegb_used=None, gh_scales=sc)
+                        packed=packed, cegb_used=None, gh_scales=sc,
+                        compact_rows=compact_rows)
                     return None, (arrays, lid)
 
                 _, out = jax.lax.scan(
@@ -1139,7 +1248,8 @@ class GBDT:
                 return out
 
             self._grow_fn_k = watched_jit(_fn, name="grow_tree_k_scan",
-                                          owner=self)
+                                          owner=self,
+                                          static_argnames=("compact_rows",))
         keys = jnp.stack([
             jax.random.PRNGKey((self.config.extra_seed or 3) * 1000003
                                + self.iter_ * (k + 1) + kk)
@@ -1148,7 +1258,7 @@ class GBDT:
                   else jnp.zeros((k, 2), jnp.float32))
         arrays_k, leaf_k = self._grow_fn_k(
             self.dd.bins, grad, hess, mask, col_mask, self._packed,
-            scales, keys)
+            scales, keys, compact_rows=compact_rows)
         self._mc_stacked = (arrays_k, leaf_k)
         return [(jax.tree.map(lambda a, i=kk: a[i], arrays_k), leaf_k[kk])
                 for kk in range(k)]
@@ -1294,6 +1404,14 @@ class GBDT:
             "trees": self.iter_ * k, "wall_s": round(wall, 6),
             "phases": phases, "num_leaves": num_leaves,
             "finished": bool(finished), **memory_snapshot()}
+        if self._last_sampled_rows is not None:
+            # GOSS/bagging: rows that actually fed this iteration's
+            # histograms, plus the per-shard compaction capacity the grow
+            # programs ran at (0 = dense masking)
+            rec["sampled_rows"] = self._last_sampled_rows
+            rec["compact_rows"] = self._last_compact_rows
+            _tel_registry.gauge("train/sampled_rows",
+                                self._last_sampled_rows)
         # ---- comms: analytic histogram payload + measured barrier wait ----
         cm = self._comms_model()
         if cm is not None:
@@ -1430,6 +1548,13 @@ class GBDT:
 
         k = self.num_tree_per_iteration
         col_mask = self._feature_mask()
+        # GOSS/bagging row compaction: static per-shard capacity for this
+        # iteration's grow programs (0 = dense masking). The kwarg is only
+        # passed when engaged so the unsampled jit signatures stay unchanged.
+        self._last_sampled_rows = None
+        compact = self._row_compaction_capacity(mask)
+        self._last_compact_rows = compact
+        compact_kw = {"compact_rows": compact} if compact else {}
         if quant_done:
             grad_raw, hess_raw, gh_scales = graw, hraw, q_scales
         else:
@@ -1451,7 +1576,7 @@ class GBDT:
                     _tel_tracer.span("GBDT::TrainTree", k=k), \
                     self._grow_x64_ctx():
                 k_results = self._grow_classes(grad, hess, mask, col_mask,
-                                               gh_scales, k)
+                                               gh_scales, k, compact)
         # stacked multiclass score update: ONE launch adds every class's
         # leaf outputs to the (N, K) score block from the grower's stacked
         # outputs, replacing K per-class gathers. BOTH multiclass grow
@@ -1500,7 +1625,8 @@ class GBDT:
                     out = self._grow_fn(
                         self.dd.bins, g, h, mask, col_mask, key=gkey,
                         packed=self._packed, cegb_used=self._cegb_used,
-                        cegb_lazy=self._cegb_lazy, gh_scales=sc)
+                        cegb_lazy=self._cegb_lazy, gh_scales=sc,
+                        **compact_kw)
                     if len(out) == 3:
                         arrays, leaf_id, self._cegb_lazy = out
                     else:
@@ -1914,6 +2040,12 @@ class GBDT:
                     vdd, kk, 1.0)
             self._valid_scores[vi] = score
         self.iter_ -= 1
+        # the rolled-back score is only f32-approximately restored, so a
+        # re-run of this iteration may draw a (slightly) different GOSS
+        # mask under the SAME mask_key — drop the cached in-bag counts so
+        # the compaction capacity is re-sized against the fresh mask
+        # (a stale undersized capacity would silently truncate in-bag rows)
+        self._sample_count_cache = None
 
     @property
     def num_trees(self) -> int:

@@ -1,8 +1,13 @@
 """Row sampling strategies: bagging and GOSS.
 
 Reference: src/boosting/sample_strategy.cpp (factory), bagging.hpp:15, goss.hpp:19.
-TPU design: no index compaction — strategies return a dense {0,1} mask (and possibly
-re-weighted gradients), which feeds the histogram count channel directly.
+TPU design: strategies return a dense {0,1} mask (and possibly re-weighted
+gradients), which feeds the histogram count channel directly.  Making tree
+cost actually SCALE with the sampled row count is the grower's job: when the
+mask is sparse enough, the engine hands ops/grow a static row capacity and
+one stable partition per tree compacts the in-bag rows into the view every
+histogram pass streams (ops/compact.plan_sample_rows — the reference's
+bag_data_indices_ prefix, device-side).
 """
 from __future__ import annotations
 
@@ -29,6 +34,14 @@ class SampleStrategy:
 
     def is_active(self) -> bool:
         return False
+
+    def mask_key(self, iteration: int) -> int:
+        """Cache key under which this iteration's mask is reused: two
+        iterations with the same key are guaranteed the same mask, so
+        per-mask derived state (the in-bag counts the row-compaction
+        capacity choice reads back, gbdt._row_compaction_capacity) can be
+        cached on it instead of re-synced every iteration."""
+        return iteration
 
     def sample(self, iteration: int, grad: jax.Array, hess: jax.Array
                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -65,13 +78,27 @@ class BaggingSampleStrategy(SampleStrategy):
     def is_active(self) -> bool:
         return self.active
 
+    def mask_key(self, iteration: int) -> int:
+        # the mask is a pure function of the bagging epoch (see sample)
+        return iteration // max(self.config.bagging_freq, 1)
+
     def sample(self, iteration: int, grad, hess):
         if not self.active:
             return super().sample(iteration, grad, hess)
         c = self.config
         freq = max(c.bagging_freq, 1)
-        if self._mask is None or iteration % freq == 0:
-            key = jax.random.PRNGKey(c.bagging_seed * 131071 + iteration // freq)
+        # iteration-keyed cache: the old `iteration % freq == 0` refresh left
+        # a STALE mask whenever iterations were not visited consecutively
+        # (rollback_one_iter, checkpoint resume mid-epoch) — e.g. freq=2,
+        # sample(4) then rollback to sample(3) reused epoch-2's mask for an
+        # epoch-1 iteration.  Keying the cache on the bagging epoch makes
+        # the mask a pure function of `iteration`, which is what lets
+        # robustness snapshots skip the RNG stream entirely: the stream
+        # position IS the iteration counter the checkpoint already stores.
+        epoch = iteration // freq
+        if self._mask is None or epoch != self._mask_iter:
+            key = jax.random.PRNGKey(c.bagging_seed * 131071 + epoch)
+            self._mask_iter = epoch
             n = self.num_data
             if c.bagging_by_query and self.query_boundaries is not None:
                 u = jax.random.uniform(key, (self._nq,))
@@ -103,17 +130,36 @@ class GOSSStrategy(SampleStrategy):
     def is_active(self) -> bool:
         return True
 
+    def _is_warmup(self, iteration: int) -> bool:
+        # reference warms up GOSS: no sampling for the first 1/lr
+        # iterations (goss.hpp) — the ONE predicate sample() and
+        # mask_key() must agree on (a desync would let the engine reuse
+        # warmup in-bag counts for a sampled mask)
+        return iteration < 1.0 / max(self.config.learning_rate, 1e-12)
+
+    def mask_key(self, iteration: int) -> int:
+        # every warmup iteration returns the SAME all-ones mask — one
+        # shared key keeps the engine's count cache warm instead of
+        # paying a device sync per warmup iteration; sampled iterations
+        # draw a fresh mask each time (key never repeats)
+        return -1 if self._is_warmup(iteration) else iteration
+
     def sample(self, iteration: int, grad, hess):
         c = self.config
         n = self.num_data
-        if iteration < 1.0 / max(c.learning_rate, 1e-12):
-            # reference warms up GOSS: no sampling for the first 1/lr iterations
+        if self._is_warmup(iteration):
             return SampleStrategy.sample(self, iteration, grad, hess)
         key = jax.random.PRNGKey(c.bagging_seed * 524287 + iteration)
         g2 = grad * hess if grad.ndim == 1 else jnp.sum(jnp.abs(grad * hess), axis=1)
         mag = jnp.abs(g2) if g2.ndim == 1 else g2
         k_top = max(1, int(c.top_rate * n))
-        thresh = jax.lax.top_k(mag, k_top)[0][-1]
+        # k-th largest |grad*hess| via ONE device sort (measured 230M rows/s,
+        # docs/PERF.md) — jax.lax.top_k over millions of rows is the slow
+        # path on TPU.  Under a row-sharded mesh the sort is a GLOBAL
+        # collective, so the threshold is a global statistic across row
+        # shards and data-parallel GOSS trees are well-defined: every shard
+        # keeps its rows against the same cut (docs/DISTRIBUTED.md).
+        thresh = jnp.sort(mag)[n - k_top]
         is_top = mag >= thresh
         u = jax.random.uniform(key, (n,))
         keep_rest = (~is_top) & (u < c.other_rate)
@@ -128,6 +174,9 @@ class GOSSStrategy(SampleStrategy):
 def create_sample_strategy(config: Config, num_data: int, query_boundaries=None,
                            label=None) -> SampleStrategy:
     """reference: SampleStrategy::CreateSampleStrategy (sample_strategy.h:30)."""
-    if config.data_sample_strategy == "goss" or config.boosting == "goss":
+    # case-insensitive, matching Config's GOSS conflict validation — a
+    # spelling accepted there ('GOSS') must select the same strategy here
+    if (str(config.data_sample_strategy).strip().lower() == "goss"
+            or str(config.boosting).strip().lower() == "goss"):
         return GOSSStrategy(config, num_data, query_boundaries, label)
     return BaggingSampleStrategy(config, num_data, query_boundaries, label)

@@ -119,6 +119,10 @@ class RoutingLayout(NamedTuple):
 
 class _GrowState(NamedTuple):
     leaf_id: jax.Array
+    # compacted-view leaf ids (GOSS/bagging row compaction; (1,) dummy when
+    # compaction is off — the histogram pass routes the compacted rows, the
+    # full-data route-only pass keeps `leaf_id` current for every row)
+    leaf_id_c: jax.Array
     # node arrays (L-1 padded to L)
     split_feature: jax.Array
     threshold_bin: jax.Array
@@ -328,6 +332,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               cegb_used=None, cegb_lazy=None, cegb_lazy_pen=None,
               gh_scales: Optional[jax.Array] = None,
               mesh=None, row_axis: Optional[str] = None,
+              compact_rows: int = 0,
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
 
@@ -345,7 +350,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     shard_map over the row axis and its histogram block is psum'd — the
     reference's per-worker fast histogram path + ReduceScatter
     (data_parallel_tree_learner.cpp:285-299); all other backends partition
-    via GSPMD without this."""
+    via GSPMD without this.
+    compact_rows: static PER-SHARD row capacity for GOSS/bagging row
+    compaction (0 = off).  One stable partition per tree (ops/compact.
+    plan_sample_rows) gathers the in-bag rows to the front and every
+    histogram pass runs over `compact_rows` rows instead of N — the
+    dominant MAC cost scales with the sampled row count (reference analog:
+    bag_data_indices_ prefix scans).  A per-round full-data ROUTE-ONLY
+    kernel pass keeps leaf_id current for all N rows (score update, renew
+    paths).  The caller guarantees compact_rows covers the in-bag count,
+    is a multiple of the kernel block, and — under a mesh — divides the
+    per-device shard."""
     N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
@@ -431,6 +446,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
     # ---- root ----
     use_stream = params.hist_backend == "stream"
+    use_compact = compact_rows > 0
+    if use_compact:
+        from .compact import check_compact_supported
+        check_compact_supported(params.hist_backend, mesh)
     bins_packed = None
     Bpad = -(-Bmax // 8) * 8
     # reduce_scatter comms (docs/DISTRIBUTED.md): the histogram block is
@@ -479,6 +498,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         w_T = jnp.zeros((8, n_pad), f32)
         w_T = (w_T.at[0, :N].set(w_grad).at[1, :N].set(w_hess)
                   .at[2, :N].set(cnt_w))
+
+        # ---- GOSS/bagging row compaction: one stable partition per tree
+        # (never a per-round gather) builds the compact view every histogram
+        # pass of this tree streams; padded/out-of-bag columns carry exact
+        # zero weights, so truncating them changes no f32 sum (the
+        # sorted-full vs compacted bit-identity the A/B suite asserts)
+        bins_T_h, w_T_h = bins_T, w_T
+        if use_compact:
+            from .compact import compact_transposed_view
+            bins_T_h, w_T_h = compact_transposed_view(
+                bins_T, w_T, 2, compact_rows, T_rows,
+                mesh=mesh, row_axis=row_axis)
+        n_pad_h = bins_T_h.shape[1]
 
         if mesh is not None:
             # data-parallel stream path: per-device kernel + histogram psum —
@@ -532,7 +564,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                    zL.at[0].set(1), routing, L)
         bits0 = jnp.zeros((Bpad, L), jnp.bfloat16)
         leaf_id = jnp.zeros(n_pad, i32)
-        _, root_hist, _ = _rh(bins_T, leaf_id.reshape(1, -1), w_T, tabs0,
+        leaf_id_c = jnp.zeros(n_pad_h if use_compact else 1, i32)
+        lid0 = leaf_id_c if use_compact else leaf_id
+        _, root_hist, _ = _rh(bins_T_h, lid0.reshape(1, -1), w_T_h, tabs0,
                               bits0, 1)
         if use_int:
             root_hist = root_hist.astype(f32) * hscale
@@ -544,10 +578,23 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 from ..pallas.hist_kernel import pack_bins
                 bins_packed = pack_bins(bins)
         leaf_id = jnp.zeros(N, i32)
-        root_hist = build_histograms(bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
-                                     backend=params.hist_backend,
-                                     bins_packed=bins_packed,
-                                     acc_dtype=hdt)[..., :2]
+        leaf_id_c = jnp.zeros(1, i32)
+        if use_compact:
+            # contraction/segsum backends: the per-tree partition plan feeds
+            # the histogram build a compact (compact_rows,) row view; the
+            # per-round slot gather below is O(compact_rows), not O(N)
+            from .compact import compact_row_views
+            bins_c, grad_c, hess_c, cnt_c, c_perm = compact_row_views(
+                bins, grad, hess, cnt_w, compact_rows)
+            root_hist = build_histograms(
+                bins_c, jnp.zeros(compact_rows, i32), grad_c, hess_c, cnt_c,
+                1, Bmax, backend=params.hist_backend, bins_packed=None,
+                acc_dtype=hdt)[..., :2]
+        else:
+            root_hist = build_histograms(
+                bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
+                backend=params.hist_backend, bins_packed=bins_packed,
+                acc_dtype=hdt)[..., :2]
     root_g = jnp.sum(grad, dtype=hdt)
     root_h = jnp.sum(hess, dtype=hdt)
     root_c = jnp.sum(cnt_w, dtype=hdt)
@@ -583,6 +630,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     hist = jnp.zeros((L, G_h, Bmax, 2), hdt).at[0].set(root_hist[0])
     state = _GrowState(
         leaf_id=leaf_id,
+        leaf_id_c=leaf_id_c,
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
         dir_flags=jnp.zeros(L, i32),
         left_child=jnp.zeros(L, i32), right_child=jnp.zeros(L, i32),
@@ -785,13 +833,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 tabs = build_route_tables(
                     leaf_chosen.astype(i32), leaf_feat, leaf_thr, leaf_dir,
                     leaf_new_id, sl1, sr1, jnp.zeros(L, i32), routing, L)
+                lid_h = st.leaf_id_c if use_compact else st.leaf_id
                 with jax.named_scope("route_and_hist"):
                     new_leaf_row, hist_small, slot_cnt = _rh(
-                        bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
+                        bins_T_h, lid_h.reshape(1, -1), w_T_h, tabs,
                         bits_l.T, S, with_hist=with_hist)
                 if use_int and with_hist:
                     hist_small = hist_small.astype(f32) * hscale
-                new_leaf_id = new_leaf_row.reshape(-1)
+                if use_compact:
+                    # full-data ROUTE-ONLY pass (no one-hot contraction, no
+                    # VMEM histogram block): every row's leaf id stays
+                    # current for the score update / renew / CEGB paths
+                    with jax.named_scope("route_full"):
+                        nl_full, _, _ = _rh(
+                            bins_T, st.leaf_id.reshape(1, -1), w_T, tabs,
+                            bits_l.T, S, with_hist=False)
+                    new_leaf_id = nl_full.reshape(-1)
+                    new_leaf_c = new_leaf_row.reshape(-1)
+                else:
+                    new_leaf_id = new_leaf_row.reshape(-1)
+                    new_leaf_c = st.leaf_id_c
             else:
                 leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bitset,
                                                                        mode="drop")
@@ -816,18 +877,28 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 go_left = jnp.where(is_cat, go_left_cat, go_left_num)
                 new_leaf_id = jnp.where(r_chosen & ~go_left,
                                         leaf_new_id[st.leaf_id], st.leaf_id)
+                new_leaf_c = st.leaf_id_c
 
             # ---- histograms for the smaller children + EXACT slot counts ----
             smaller_id_pre = jnp.where(smaller_is_left, pair_old, pair_new)
             if not use_stream:   # stream path built these in the fused kernel
                 slot_map = jnp.full(L, -1, i32).at[
                     jnp.where(pair_valid, smaller_id_pre, drop)].set(
-                        jnp.arange(S), mode="drop")
+                        jnp.arange(S, dtype=i32), mode="drop")
                 slot = slot_map[new_leaf_id]
-                hist3 = build_histograms(bins, slot, grad, hess, cnt_w, S, Bmax,
-                                         backend=params.hist_backend,
-                                         bins_packed=bins_packed,
-                                         acc_dtype=hdt)
+                if use_compact:
+                    # O(compact_rows) slot gather + histogram over the
+                    # compact row view (the partition plan is per-tree)
+                    hist3 = build_histograms(
+                        bins_c, jnp.take(slot, c_perm, axis=0), grad_c,
+                        hess_c, cnt_c, S, Bmax,
+                        backend=params.hist_backend, bins_packed=None,
+                        acc_dtype=hdt)
+                else:
+                    hist3 = build_histograms(
+                        bins, slot, grad, hess, cnt_w, S, Bmax,
+                        backend=params.hist_backend,
+                        bins_packed=bins_packed, acc_dtype=hdt)
                 hist_small = hist3[..., :2]
                 # any one group's bins partition the slot's rows, so group 0's
                 # count channel sums to the exact per-slot data count
@@ -842,6 +913,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             # ---- per-leaf stats for the children ----
             st2 = st2._replace(
                 leaf_id=new_leaf_id,
+                leaf_id_c=new_leaf_c,
                 sum_g=st2.sum_g.at[old_idx].set(lg, mode="drop")
                               .at[new_idx].set(rg, mode="drop"),
                 sum_h=st2.sum_h.at[old_idx].set(lh, mode="drop")
@@ -1340,6 +1412,8 @@ class _GrowStateK(NamedTuple):
     """Channelized grow state — every per-class array gains a leading K
     axis; the round body updates all K class trees in lockstep."""
     leaf_id: jax.Array          # (K, N_pad) i32
+    leaf_id_c: jax.Array        # (K, compact_rows) i32 ((1, 1) dummy when
+                                # row compaction is off)
     split_feature: jax.Array    # (K, L) i32 — node arrays
     threshold_bin: jax.Array
     dir_flags: jax.Array
@@ -1373,6 +1447,7 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 params: GrowParams,
                 packed=None, gh_scales: Optional[jax.Array] = None,
                 mesh=None, row_axis: Optional[str] = None,
+                compact_rows: int = 0,
                 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow K class trees in LOCKSTEP inside one widened XLA program
     (batched multiclass). Returns (TreeArrays with a leading K axis,
@@ -1436,6 +1511,10 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root ----
     use_stream = params.hist_backend == "stream"
+    use_compact = compact_rows > 0
+    if use_compact:
+        from .compact import check_compact_supported
+        check_compact_supported(params.hist_backend, mesh)
     bins_packed = None
     Bpad = -(-Bmax // 8) * 8
     # reduce_scatter comms for the widened K-class block: identical design
@@ -1475,6 +1554,17 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         w2 = jnp.stack([w_grad, w_hess], axis=1).reshape(2 * K, N)
         w_T = jnp.zeros((w_pad_rows, n_pad), f32)
         w_T = w_T.at[:2 * K, :N].set(w2).at[2 * K, :N].set(cnt_w)
+
+        # ---- GOSS/bagging row compaction (see grow_tree): one stable
+        # partition per iteration serves all K lockstep class trees — the
+        # mask row (2K) is shared across classes
+        bins_T_h, w_T_h = bins_T, w_T
+        if use_compact:
+            from .compact import compact_transposed_view
+            bins_T_h, w_T_h = compact_transposed_view(
+                bins_T, w_T, 2 * K, compact_rows, T_rows,
+                mesh=mesh, row_axis=row_axis)
+        n_pad_h = bins_T_h.shape[1]
 
         if mesh is not None:
             from jax.sharding import PartitionSpec as P
@@ -1522,7 +1612,10 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                    zKL.at[kI * L].set(1), routing, K * L)
         bits0 = jnp.zeros((Bpad, K * L), jnp.bfloat16)
         leaf_id = jnp.zeros((K, n_pad), i32)
-        _, root_hist, _ = _rh(bins_T, leaf_id, w_T, tabs0, bits0, 1)
+        leaf_id_c = jnp.zeros((K, n_pad_h) if use_compact else (1, 1), i32)
+        _, root_hist, _ = _rh(bins_T_h,
+                              leaf_id_c if use_compact else leaf_id,
+                              w_T_h, tabs0, bits0, 1)
         if use_int:
             root_hist = root_hist.astype(f32) \
                 * hscale[:, None, None, None, :]
@@ -1534,10 +1627,22 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 from ..pallas.hist_kernel import pack_bins
                 bins_packed = pack_bins(bins)
         leaf_id = jnp.zeros((K, N), i32)
-        root_hist = build_histograms_k(
-            bins, leaf_id, grad, hess, cnt_w, K, 1, Bmax,
-            backend=params.hist_backend, bins_packed=bins_packed,
-            acc_dtype=hdt)[..., :2]
+        leaf_id_c = jnp.zeros((1, 1), i32)
+        if use_compact:
+            # see grow_tree: same shared compact_row_views helper; grad/
+            # hess are (K, N) here and the helper gathers the last axis
+            from .compact import compact_row_views
+            bins_c, grad_c, hess_c, cnt_c, c_perm = compact_row_views(
+                bins, grad, hess, cnt_w, compact_rows)
+            root_hist = build_histograms_k(
+                bins_c, jnp.zeros((K, compact_rows), i32), grad_c, hess_c,
+                cnt_c, K, 1, Bmax, backend=params.hist_backend,
+                bins_packed=None, acc_dtype=hdt)[..., :2]
+        else:
+            root_hist = build_histograms_k(
+                bins, leaf_id, grad, hess, cnt_w, K, 1, Bmax,
+                backend=params.hist_backend, bins_packed=bins_packed,
+                acc_dtype=hdt)[..., :2]
     root_g = jnp.sum(grad, axis=1, dtype=hdt)                # (K,)
     root_h = jnp.sum(hess, axis=1, dtype=hdt)
     root_c = jnp.broadcast_to(jnp.sum(cnt_w, dtype=hdt), (K,))
@@ -1553,6 +1658,7 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         root_hist.reshape(K, G_h, Bmax, 2))
     state = _GrowStateK(
         leaf_id=leaf_id,
+        leaf_id_c=leaf_id_c,
         split_feature=jnp.zeros((K, L), i32),
         threshold_bin=jnp.zeros((K, L), i32),
         dir_flags=jnp.zeros((K, L), i32),
@@ -1741,14 +1847,26 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     leaf_dir.reshape(-1), leaf_new_id.reshape(-1),
                     sl1.reshape(-1), sr1.reshape(-1),
                     jnp.zeros(K * L, i32), routing, K * L)
+                lid_h = st.leaf_id_c if use_compact else st.leaf_id
                 with jax.named_scope("route_and_hist_k"):
-                    new_leaf_id, hist_small, slot_cnt = _rh(
-                        bins_T, st.leaf_id, w_T, tabs,
+                    new_leaf_h, hist_small, slot_cnt = _rh(
+                        bins_T_h, lid_h, w_T_h, tabs,
                         bits_l.reshape(K * L, Bpad).T, S,
                         with_hist=with_hist)
                 if use_int and with_hist:
                     hist_small = hist_small.astype(f32) \
                         * hscale[:, None, None, None, :]
+                if use_compact:
+                    # full-data route-only pass (see grow_tree)
+                    with jax.named_scope("route_full_k"):
+                        new_leaf_id, _, _ = _rh(
+                            bins_T, st.leaf_id, w_T, tabs,
+                            bits_l.reshape(K * L, Bpad).T, S,
+                            with_hist=False)
+                    new_leaf_c = new_leaf_h
+                else:
+                    new_leaf_id = new_leaf_h
+                    new_leaf_c = st.leaf_id_c
             else:
                 leaf_bits = jnp.zeros((K, L, Bmax), bool).at[
                     k2, old_idx].set(bitset, mode="drop")
@@ -1775,6 +1893,7 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 go_left = jnp.where(is_cat, go_left_cat, go_left_num)
                 new_leaf_id = jnp.where(r_chosen & ~go_left,
                                         ta(leaf_new_id, lid), lid)
+                new_leaf_c = st.leaf_id_c
 
             # ---- histograms for the smaller children + EXACT counts ----
             smaller_id_pre = jnp.where(smaller_is_left, pair_old, pair_new)
@@ -1783,10 +1902,17 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     k2, jnp.where(pair_valid, smaller_id_pre, drop)].set(
                     jnp.broadcast_to(sS[None, :], (K, S)), mode="drop")
                 slot = ta(slot_map, new_leaf_id)             # (K, N)
-                hist3 = build_histograms_k(
-                    bins, slot, grad, hess, cnt_w, K, S, Bmax,
-                    backend=params.hist_backend, bins_packed=bins_packed,
-                    acc_dtype=hdt)
+                if use_compact:
+                    hist3 = build_histograms_k(
+                        bins_c, jnp.take(slot, c_perm, axis=1), grad_c,
+                        hess_c, cnt_c, K, S, Bmax,
+                        backend=params.hist_backend, bins_packed=None,
+                        acc_dtype=hdt)
+                else:
+                    hist3 = build_histograms_k(
+                        bins, slot, grad, hess, cnt_w, K, S, Bmax,
+                        backend=params.hist_backend, bins_packed=bins_packed,
+                        acc_dtype=hdt)
                 hist_small = hist3[..., :2]
                 slot_cnt = hist3[:, :, 0, :, 2].sum(axis=-1)
             lc_x = jnp.where(smaller_is_left, slot_cnt, pc - slot_cnt)
@@ -1795,6 +1921,7 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # ---- per-leaf stats for the children ----
             st2 = st2._replace(
                 leaf_id=new_leaf_id,
+                leaf_id_c=new_leaf_c,
                 sum_g=st2.sum_g.at[k2, old_idx].set(lg, mode="drop")
                                .at[k2, new_idx].set(rg, mode="drop"),
                 sum_h=st2.sum_h.at[k2, old_idx].set(lh, mode="drop")

@@ -40,6 +40,16 @@ XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
     python -m pytest tests/test_distributed_fast.py -x -q
 echo "=== stage: full fast tier ==="
 python -m pytest tests/ -x -q
+# GOSS sampling bench: the row-compaction speedup gate (docs/PERF.md
+# "sample-strategy speedups") — sampled trees must run >= 2x faster than
+# the unsampled arm at matched AUC, or the stage fails.  Reduced rows /
+# iters keep the CPU stage to a few minutes; BENCH_ROWS/BENCH_GOSS_ITERS
+# pre-set by the caller are respected (full-size on TPU runs).
+echo "=== stage: GOSS sampling bench (BENCH_TASK=goss) ==="
+BENCH_TASK=goss \
+BENCH_ROWS="${BENCH_ROWS:-100000}" \
+BENCH_GOSS_ITERS="${BENCH_GOSS_ITERS:-5}" \
+    python bench.py
 # native sanitizer tier: builds native/binner.cpp under ASan/UBSan and
 # drives every extern-C entry point (incl. the categorical bitset
 # walker's word-index edges) — the reference's sanitizer CI lanes.
