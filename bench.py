@@ -860,6 +860,217 @@ def run_serve_bench():
     return ok
 
 
+def run_fleet_bench():
+    """BENCH_FLEET=1: the serving-fleet CHAOS gate (docs/SERVING.md).
+
+    Sustains loopback load against a >=3-replica fleet while chaos
+    SIGKILL-exits one replica and wedges another mid-run, and a
+    fleet-wide ``/reload`` promotes a second model mid-chaos.  Gates:
+
+      * zero non-503 client errors (the front's deadline/retry/breaker
+        machinery absorbs the kills, hangs, and resets);
+      * every 200 response bitwise equal to ``Booster.predict`` of the
+        model whose sha256 the response claims — zero mis-versioned
+        responses across the promotion;
+      * p99 of successful requests bounded (<= BENCH_FLEET_P99_MS);
+      * the killed replica restarts (supervisor backoff) and every
+        reachable replica converges on the promoted generation.
+
+    Writes BENCH_FLEET.json (QPS, p50/p99, shed/retry/breaker/restart
+    counts, reload outcome)."""
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ServingFleet
+    from lightgbm_tpu.serving.fleet import validate_candidate
+    from lightgbm_tpu.serving.front import http_json
+
+    rows = int(os.environ.get("BENCH_FLEET_ROWS", 50_000))
+    iters = int(os.environ.get("BENCH_FLEET_MODEL_ITERS", 20))
+    secs = float(os.environ.get("BENCH_FLEET_SECS", 10.0))
+    clients = int(os.environ.get("BENCH_FLEET_CLIENTS", 6))
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
+    p99_gate_ms = float(os.environ.get("BENCH_FLEET_P99_MS", 2500.0))
+    deadline_ms = 2000.0
+    if replicas < 3:
+        raise RuntimeError("the fleet chaos gate needs >= 3 replicas "
+                           "(one killed, one hung, one clean)")
+    X, y = make_higgs_like(rows, N_FEATURES)
+    td = tempfile.mkdtemp(prefix="lgb_bench_fleet_")
+    paths, oracle = [], {}
+    sizes = [1, 4, 16]
+    for i, seed in enumerate((1, 2)):
+        bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                         "learning_rate": 0.1, "max_bin": 63,
+                         "verbosity": -1, "seed": seed},
+                        lgb.Dataset(X, label=y), num_boost_round=iters)
+        p = os.path.join(td, f"model_{i}.txt")
+        bst.save_model(p)
+        paths.append(p)
+        ref = lgb.Booster(model_file=p)
+        oracle[validate_candidate(p)] = {
+            m: ref.predict(X[:m], raw_score=True) for m in sizes}
+    sha_b = validate_candidate(paths[1])
+
+    # chaos: kill replica 0 ~2.5 s in, wedge replica 1 ~3.5 s in (beat
+    # period 0.25 s); once-markers keep the restarted processes alive
+    m_kill = os.path.join(td, "kill.marker")
+    m_hang = os.path.join(td, "hang.marker")
+    chaos_prev = os.environ.get("LGBTPU_CHAOS")
+    os.environ["LGBTPU_CHAOS"] = (
+        f"kill_replica:iter=10,rank=0,once={m_kill};"
+        f"hang_replica:iter=14,rank=1,once={m_hang}")
+    fleet = ServingFleet(
+        paths[0], replicas=replicas, max_batch=max(sizes),
+        buckets_spec=str(max(sizes)), max_delay_ms=1.0, queue_size=512,
+        deadline_ms=deadline_ms, retries=3, retry_backoff_ms=10.0,
+        breaker_failures=3, breaker_cooldown_s=0.5,
+        restart_backoff_s=0.2, hang_timeout_s=2.0)
+    bodies = {m: {"rows": X[:m].tolist(), "raw_score": True,
+                  "deadline_ms": deadline_ms} for m in sizes}
+    lat_ms: list = []
+    outcomes = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(seed):
+        rs = np.random.RandomState(seed)
+        local_lat, local = [], {"ok": 0, "s503": 0, "errors": 0,
+                                "mis_versioned": 0}
+        while not stop.is_set():
+            m = sizes[rs.randint(len(sizes))]
+            t0 = time.perf_counter()
+            try:
+                st, obj, _ = http_json(fleet.host, fleet.port, "POST",
+                                       "/predict", bodies[m],
+                                       timeout=deadline_ms / 1e3 + 5)
+            except OSError:
+                local["errors"] += 1
+                continue
+            if st == 200:
+                by_sha = oracle.get(obj.get("model_sha256"))
+                if by_sha is None or not np.array_equal(
+                        np.asarray(obj["predictions"]), by_sha[m]):
+                    local["mis_versioned"] += 1
+                else:
+                    local["ok"] += 1
+                    local_lat.append((time.perf_counter() - t0) * 1e3)
+            elif st == 503:
+                local["s503"] += 1
+            else:
+                local["errors"] += 1
+        with lock:
+            lat_ms.extend(local_lat)
+            for k, v in local.items():
+                outcomes[k] += v
+
+    reload_outcome = {}
+    try:
+        fleet.start()
+        # warm every client-visible shape through the front first
+        for m in sizes:
+            st, _, _ = http_json(fleet.host, fleet.port, "POST",
+                                 "/predict", bodies[m], timeout=60)
+            assert st == 200
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        # mid-chaos promotion: by secs/2 the kill and hang have fired
+        time.sleep(secs * 0.5)
+        st, reload_outcome, _ = http_json(
+            fleet.host, fleet.port, "POST", "/reload",
+            {"path": paths[1]}, timeout=60)
+        reload_ok = st == 200 and len(reload_outcome.get("promoted",
+                                                         [])) >= 1
+        time.sleep(secs * 0.5)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        elapsed = time.time() - t0
+        # convergence: every reachable replica ends on the promoted
+        # generation (the hung one comes back via SIGKILL+restart)
+        gen_b = int(reload_outcome.get("generation", 0))
+        converged = False
+        t_conv = time.time()
+        while time.time() - t_conv < 30:
+            d = fleet.describe()
+            reachable = [r for r in d["replicas"] if r["reachable"]]
+            if (len(reachable) == replicas
+                    and all(r.get("generation") == gen_b
+                            and r.get("model_sha256") == sha_b
+                            for r in reachable)):
+                converged = True
+                break
+            time.sleep(0.5)
+        d = fleet.describe()
+        front_stats = fleet.front.describe()
+        restarts = d["restarts_total"]
+    finally:
+        fleet.stop()
+        if chaos_prev is None:
+            os.environ.pop("LGBTPU_CHAOS", None)
+        else:
+            os.environ["LGBTPU_CHAOS"] = chaos_prev
+
+    qps = outcomes["ok"] / max(elapsed, 1e-9)
+    p50 = float(np.percentile(lat_ms, 50)) if lat_ms else float("inf")
+    p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float("inf")
+    chaos_fired = os.path.exists(m_kill) and os.path.exists(m_hang)
+    ok = (outcomes["errors"] == 0 and outcomes["mis_versioned"] == 0
+          and outcomes["ok"] > 0 and chaos_fired and restarts >= 1
+          and reload_ok and converged and p99 <= p99_gate_ms)
+    record = {
+        "metric": "fleet_chaos_qps",
+        "value": round(qps, 1),
+        "unit": (f"successful req/s over {elapsed:.1f}s, {clients} "
+                 f"clients, {replicas} replicas, kill+hang chaos "
+                 f"mid-run ({'OK' if ok else 'FAIL'}: "
+                 f"errors={outcomes['errors']}, "
+                 f"mis_versioned={outcomes['mis_versioned']}, "
+                 f"p99={p99:.0f}ms<=gate {p99_gate_ms:.0f}, "
+                 f"restarts={restarts}, chaos_fired={chaos_fired}, "
+                 f"reload_converged={converged})"),
+        "vs_baseline": None,
+        "qps": round(qps, 1),
+        "p50_ms": round(p50, 2),
+        "p99_ms": round(p99, 2),
+        "served_200": outcomes["ok"],
+        "shed_503": outcomes["s503"],
+        "non_503_errors": outcomes["errors"],
+        "mis_versioned": outcomes["mis_versioned"],
+        "front_shed": front_stats["shed"],
+        "front_retries": front_stats["retried"],
+        "breaker_trips": sum(b["trips"] for b in
+                             front_stats["breakers"].values()),
+        "replica_restarts": restarts,
+        "reload": reload_outcome,
+        "replicas": replicas,
+        "clients": clients,
+    }
+    print(json.dumps({k: record[k] for k in
+                      ("metric", "value", "unit", "vs_baseline")}),
+          flush=True)
+    print(json.dumps({
+        "metric": "fleet_chaos_latency_ms",
+        "value": record["p50_ms"],
+        "unit": (f"p50 ms client-side (p99 {record['p99_ms']} ms, "
+                 f"{record['front_retries']} retries, "
+                 f"{record['front_shed']} shed, "
+                 f"{record['breaker_trips']} breaker trips, "
+                 f"{restarts} restarts)"),
+        "vs_baseline": None,
+    }), flush=True)
+    from lightgbm_tpu.robustness.checkpoint import atomic_open
+    with atomic_open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "BENCH_FLEET.json"), "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    return ok
+
+
 if __name__ == "__main__":
     if os.environ.get("_BENCH_MC_CHILD", "") == "1":
         sys.exit(0 if _multichip_child() else 1)
@@ -867,6 +1078,8 @@ if __name__ == "__main__":
         sys.exit(0 if run_multichip_bench() else 1)
     if os.environ.get("BENCH_SERVE", "") == "1":
         sys.exit(0 if run_serve_bench() else 1)
+    if os.environ.get("BENCH_FLEET", "") == "1":
+        sys.exit(0 if run_fleet_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
     if task not in ("", "higgs", "ranking", "multiclass", "goss"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "

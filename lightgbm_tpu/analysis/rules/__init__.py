@@ -30,7 +30,8 @@ def all_rules() -> List[Rule]:
     from .host_sync import HostSyncRule
     from .jit_discipline import JitDisciplineRule
     from .lock_discipline import LockDisciplineRule
+    from .subprocess_discipline import SubprocessDisciplineRule
 
     return [JitDisciplineRule(), HostSyncRule(), CollectiveAxisRule(),
             DeterminismRule(), AtomicIORule(), LockDisciplineRule(),
-            ConfigDocRule()]
+            ConfigDocRule(), SubprocessDisciplineRule()]

@@ -1,0 +1,105 @@
+"""LGB008: subprocesses in ``serving/`` and ``parallel/`` must be bounded.
+
+The fleet supervisor (serving/fleet.py) and the distributed launcher
+(parallel/cluster.py) both babysit worker processes.  A ``Popen`` that
+nothing polls — or a ``subprocess.run`` with no ``timeout`` — is an
+unbounded wait: one wedged child (a replica stuck in an XLA dispatch, a
+worker stuck in a collective) blocks the whole supervisor forever, which
+is precisely the failure these layers exist to absorb.  The run-loop
+rule: every blocking subprocess call carries an explicit ``timeout``,
+and every ``Popen`` is owned by code that polls it (``.poll()``) or
+waits with a deadline (``.wait(timeout=...)`` /
+``.communicate(..., timeout=...)``).
+
+Scope: only ``lightgbm_tpu/serving/`` and ``lightgbm_tpu/parallel/`` —
+the supervisor layers.  (bench/scripts/tests run subprocesses too, but a
+hung bench is an operator's Ctrl-C, not a production outage.)
+
+Detection (scope-local, like LGB005):
+
+  * ``subprocess.run`` / ``check_output`` / ``check_call`` / ``call``
+    without a ``timeout=`` keyword trips;
+  * a ``Popen(...)`` call trips unless its enclosing function — or, for
+    supervisor classes whose spawn and poll loops are different methods,
+    another method of the same class — calls ``.poll()`` or a
+    deadline-bounded ``.wait``/``.communicate``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from . import Rule
+
+SCOPED_PREFIXES = ("lightgbm_tpu/serving/", "lightgbm_tpu/parallel/")
+RUN_FUNCS = ("subprocess.run", "subprocess.check_output",
+             "subprocess.check_call", "subprocess.call")
+
+
+def _has_timeout(call: ast.Call, wait_positional: bool = False) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    # Popen.wait(10) passes the timeout positionally
+    return wait_positional and len(call.args) >= 1
+
+
+class SubprocessDisciplineRule(Rule):
+    rule_id = "LGB008"
+    title = "unsupervised subprocess in a supervisor layer"
+    hint = ("pass timeout= (subprocess.run family), or supervise the "
+            "Popen with a poll loop / wait(timeout=...) in the same "
+            "function or another method of the same class")
+
+    def _enclosing_class(self, module, node: ast.AST
+                         ) -> Optional[ast.AST]:
+        cur = module.model.parents.get(node)
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = module.model.parents.get(cur)
+        return cur
+
+    def _supervised_scopes(self, module) -> tuple:
+        """(scopes, classes) that poll or deadline-wait a process."""
+        m = module.model
+        scopes: Set[ast.AST] = set()
+        for call in m.walk_calls():
+            f = call.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "poll" or (
+                    f.attr in ("wait", "communicate")
+                    and _has_timeout(call, wait_positional=f.attr == "wait")):
+                scopes.add(m.enclosing_function(call))
+        classes = set()
+        for scope in scopes:
+            cls = self._enclosing_class(module, scope) \
+                if scope is not None else None
+            if cls is not None:
+                classes.add(cls)
+        return scopes, classes
+
+    def check_module(self, module) -> Iterable:
+        if not module.rel.startswith(SCOPED_PREFIXES):
+            return
+        m = module.model
+        scopes, classes = self._supervised_scopes(module)
+        for call in m.walk_calls():
+            if m.name_matches(call.func, *RUN_FUNCS):
+                if not _has_timeout(call):
+                    yield module.finding(
+                        self.rule_id, call,
+                        "blocking subprocess call without timeout= — one "
+                        "wedged child blocks this supervisor layer "
+                        "forever", self.hint)
+                continue
+            if not m.name_matches(call.func, "subprocess.Popen", "Popen"):
+                continue
+            scope = m.enclosing_function(call)
+            if scope in scopes:
+                continue
+            cls = self._enclosing_class(module, call)
+            if cls is not None and cls in classes:
+                continue
+            yield module.finding(
+                self.rule_id, call,
+                "Popen with no poll loop or deadline-bounded wait in "
+                "reach — the spawned process is unsupervised", self.hint)

@@ -301,7 +301,9 @@ def main(argv=None) -> int:
     elif task == "convert_model":
         run_convert_model(params)
     elif task == "serve":
-        # online inference server (docs/SERVING.md); blocks until SIGTERM
+        # online inference server (docs/SERVING.md); blocks until SIGTERM.
+        # serve_replicas > 1 runs the replica-fleet supervisor (restart
+        # with backoff, fleet-wide promotion, fanout front)
         from .serving.server import run_server
         return run_server(params)
     else:

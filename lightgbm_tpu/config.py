@@ -195,6 +195,16 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "serve_buckets": ["serve_bucket_ladder"],
     "serve_warmup": [],
     "serve_heartbeat": ["serve_heartbeat_file"],
+    "serve_replicas": ["num_replicas", "serve_num_replicas"],
+    "serve_fleet_mode": ["fleet_mode"],
+    "serve_fleet_dir": ["fleet_dir"],
+    "serve_deadline_ms": ["serve_deadline", "deadline_ms"],
+    "serve_retries": [],
+    "serve_retry_backoff_ms": [],
+    "serve_breaker_failures": [],
+    "serve_breaker_cooldown_s": [],
+    "serve_restart_backoff_s": [],
+    "serve_hang_timeout_s": ["serve_hang_timeout"],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -521,6 +531,40 @@ class Config:
     # heartbeat file the batch worker touches after every dispatch
     # (robustness liveness probe; "" = off)
     serve_heartbeat: str = ""
+    # replica fleet size for task=serve; > 1 runs the fleet supervisor
+    # (N replica processes + restart-with-backoff + fleet-wide promotion,
+    # docs/SERVING.md "Fleet architecture") instead of one process
+    serve_replicas: int = 1
+    # how clients reach the fleet: "front" routes through the fanout
+    # front (deadline/retry/backoff + per-replica circuit breaker);
+    # "reuseport" binds every replica to serve_port via SO_REUSEPORT
+    # (kernel load-balancing; falls back to "front" where unavailable)
+    serve_fleet_mode: str = "front"
+    # shared fleet state/promotion directory ("" = private tmpdir);
+    # holds the promote.json pointer, per-replica endpoints + heartbeats
+    serve_fleet_dir: str = ""
+    # default per-request budget in ms when the body carries no
+    # deadline_ms (propagated through admission + batching so expired
+    # requests are shed, never scored); 0 = no deadline
+    serve_deadline_ms: float = 10000.0
+    # fanout front: retry attempts beyond the first, each on a different
+    # replica, splitting the remaining deadline budget
+    serve_retries: int = 2
+    # fanout front: base backoff between retry attempts (jittered,
+    # doubling per attempt, capped by the remaining budget)
+    serve_retry_backoff_ms: float = 25.0
+    # per-replica circuit breaker: consecutive errors/timeouts that trip
+    # it open (overload 503s do not count — shed is not broken)
+    serve_breaker_failures: int = 5
+    # circuit breaker: seconds a tripped replica gets no traffic before
+    # ONE half-open probe (success closes, failure re-opens)
+    serve_breaker_cooldown_s: float = 2.0
+    # fleet supervisor: base delay before restarting a dead/hung replica
+    # (jittered, doubling per consecutive restart, capped at 30 s)
+    serve_restart_backoff_s: float = 0.5
+    # fleet supervisor: SIGKILL+restart a replica whose heartbeat file
+    # goes stale past this many seconds (0 = hang detection off)
+    serve_hang_timeout_s: float = 10.0
 
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records

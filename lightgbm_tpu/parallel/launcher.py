@@ -25,6 +25,7 @@ full feature matrix. In-memory arrays must still be identical everywhere.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..utils.log import LightGBMError, log_info
@@ -53,6 +54,18 @@ def init_distributed(coordinator_address: Optional[str] = None,
         log_info("jax.distributed already initialized "
                  f"({jax.process_count()} processes)")
         return
+    # the default CPU client refuses cross-process computations
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"); the gloo collectives implementation is what makes
+    # localhost-simulated multi-host runs work (parallel/cluster.py's
+    # workers set the same; older jax: option absent, TPU: irrelevant)
+    platforms = str(getattr(jax.config, "jax_platforms", None)
+                    or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" in platforms:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - option absent in old jax
+            pass
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

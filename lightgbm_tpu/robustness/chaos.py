@@ -14,10 +14,22 @@ Grammar (directives separated by ``;``, options by ``,``)::
     LGBTPU_CHAOS="hang:iter=3,rank=1,once=/tmp/m"   # stop heartbeating
     LGBTPU_CHAOS="heartbeat_delay:seconds=2"        # slow every heartbeat
 
+Serving-fleet faults (docs/SERVING.md fleet architecture; ``rank`` here
+is the REPLICA rank — the supervisor exports ``LGBTPU_REPLICA_RANK`` to
+every replica process and rank matching prefers it over
+``jax.process_index``; ``iter`` is the replica's heartbeat-loop beat
+number, one beat every ~0.25 s)::
+
+    LGBTPU_CHAOS="kill_replica:iter=8,rank=0,once=/tmp/m"  # SIGKILL-like exit
+    LGBTPU_CHAOS="hang_replica:iter=12,rank=1,once=/tmp/m" # wedge the replica
+    LGBTPU_CHAOS="slow_replica:seconds=0.5"                # delay every request
+    LGBTPU_CHAOS="drop_conn:count=3"                       # reset 3 connections
+
 Options:
 
 * ``iter=N``   — fire at boosting iteration N (1-based); omitted = every.
-* ``rank=R``   — only in the process with ``jax.process_index() == R``.
+* ``rank=R``   — only in the process with ``jax.process_index() == R``
+  (or ``LGBTPU_REPLICA_RANK == R`` in serving replicas).
 * ``once=P``   — marker-file latch: fire only if P does not exist, and
   create P first, so a relaunched/resumed cohort is not killed again.
 * ``seconds=S``/``count=N`` — directive-specific magnitudes.
@@ -105,6 +117,15 @@ def has(name: str) -> bool:
 def _rank_matches(d: Directive) -> bool:
     if d.rank is None:
         return True
+    # serving replicas carry their rank in the environment (set by the
+    # fleet supervisor); importing jax for process_index would be both
+    # wrong (replicas are single-process jax) and expensive here
+    env_rank = os.environ.get("LGBTPU_REPLICA_RANK")
+    if env_rank is not None:
+        try:
+            return int(env_rank) == d.rank
+        except ValueError:
+            return False
     import jax
     return jax.process_index() == d.rank
 
@@ -176,6 +197,74 @@ def heartbeat_hook(iteration: int) -> None:
             time.sleep(d.seconds or 3600.0)
         elif _matches(d, "heartbeat_delay", iteration):
             time.sleep(d.seconds or 1.0)
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet faults (docs/SERVING.md "Fleet architecture")
+# ---------------------------------------------------------------------------
+
+class DropConnection(Exception):
+    """Raised by :func:`request_hook` when ``drop_conn`` fires; the HTTP
+    handler closes the client socket without a response, so the client
+    sees a connection reset — the fanout front must absorb it as a
+    retryable transport error."""
+
+
+# a wedged replica stays wedged: once hang_replica fires, EVERY later
+# request (and the beat loop) blocks, like a process stuck in a lock
+_replica_hung = False
+
+# drop_conn with count=N resets only the first N matching requests; the
+# latch is per-process (each replica counts its own drops)
+_drops_fired = 0
+
+
+def replica_hung() -> bool:
+    return _replica_hung
+
+
+def replica_beat_hook(beat: int) -> None:
+    """Called by the fleet replica's heartbeat loop before each beat
+    (one beat every ~0.25 s; ``iter`` matches the beat number).
+
+    ``kill_replica`` exits the process with no cleanup (SIGKILL-like);
+    ``hang_replica`` wedges the whole replica: the beat loop blocks (the
+    supervisor's stale-heartbeat detector must reap it) and every request
+    thread blocks too (the front's deadline/breaker must route around
+    it)."""
+    global _replica_hung
+    for d in directives():
+        if _matches(d, "kill_replica", beat) and _fire_once(d):
+            log_warning(f"chaos: killing serving replica at beat {beat}")
+            os._exit(137)
+        elif _matches(d, "hang_replica", beat) and _fire_once(d):
+            log_warning(f"chaos: hanging serving replica at beat {beat}")
+            _replica_hung = True
+            time.sleep(d.seconds or 3600.0)
+
+
+def request_hook() -> None:
+    """Called by the serving request path before any work.
+
+    ``slow_replica`` delays the request by ``seconds``; ``drop_conn``
+    raises :class:`DropConnection` (``count`` bounds how many requests
+    are reset); a replica wedged by ``hang_replica`` blocks here forever
+    — a hung process answers nothing, not just its heartbeat."""
+    global _drops_fired
+    if _replica_hung:
+        time.sleep(3600.0)
+    for d in directives():
+        if _matches(d, "slow_replica", None) and _fire_once(d):
+            time.sleep(d.seconds or 0.5)
+        elif _matches(d, "drop_conn", None):
+            if d.count is not None and _drops_fired >= d.count:
+                continue
+            if not _fire_once(d):
+                continue
+            _drops_fired += 1
+            log_warning("chaos: dropping serving connection "
+                        f"({_drops_fired}{'/' + str(d.count) if d.count else ''})")
+            raise DropConnection()
 
 
 def main() -> int:

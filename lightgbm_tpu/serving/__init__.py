@@ -13,17 +13,28 @@ Four layers on top of the trained-model stack:
     ``serve_max_batch``/``serve_max_delay_ms`` with admission control
     (structured overload rejection) and a native single-row fast path;
   * :mod:`.server` — the stdlib-HTTP JSON front end
-    (``/predict /health /reload /stats``) with graceful SIGTERM drain,
-    launched via ``python -m lightgbm_tpu.serve`` or CLI ``task=serve``.
+    (``/predict /health /ready /reload /stats``) with graceful SIGTERM
+    drain, launched via ``python -m lightgbm_tpu.serve`` or CLI
+    ``task=serve``;
+  * :mod:`.fleet` + :mod:`.front` — the replica-pool supervisor
+    (restart-with-backoff, heartbeat liveness, shared-directory
+    fleet-wide promotion) and the fanout front (deadline/retry/backoff,
+    per-replica circuit breaker, load shedding); ``serve_replicas > 1``
+    serves through the fleet.
 """
-from .batcher import MicroBatcher, OverloadError, PredictResult
+from .batcher import DeadlineError, MicroBatcher, OverloadError, PredictResult
 from .compiled import CompiledPredictor, bucket_ladder
+from .front import CircuitBreaker, FanoutFront
+from .fleet import ServingFleet, run_fleet
 from .registry import ModelRegistry, ServingModel
-from .server import ServingApp, run_server, serve_from_params
+from .server import (ServingApp, reuseport_available, run_server,
+                     serve_from_params)
 
 __all__ = [
     "CompiledPredictor", "bucket_ladder",
     "ModelRegistry", "ServingModel",
-    "MicroBatcher", "OverloadError", "PredictResult",
+    "MicroBatcher", "OverloadError", "DeadlineError", "PredictResult",
     "ServingApp", "run_server", "serve_from_params",
+    "ServingFleet", "run_fleet", "FanoutFront", "CircuitBreaker",
+    "reuseport_available",
 ]

@@ -8,7 +8,7 @@ device call for the coalesced matrix.  Per-request tails (averaging +
 output transform) are applied to each request's row slice, so every
 response is bitwise identical to predicting that request alone.
 
-Two escape hatches keep tail latency honest:
+Three escape hatches keep tail latency honest:
 
   * **singleton fast path** — ``submit(..., fast=True)`` executes a
     one-row request synchronously on the caller thread through the
@@ -16,16 +16,22 @@ Two escape hatches keep tail latency honest:
     no device dispatch) — the latency-critical path of the reference's
     ``LGBM_BoosterPredictForMatSingleRowFast``;
   * **admission control** — a full queue rejects immediately with a
-    structured :class:`OverloadError` (HTTP 503 upstream) instead of
-    buffering unboundedly; shedding at the door keeps the p99 of
-    admitted requests bounded.
+    structured :class:`OverloadError` (HTTP 503 + ``Retry-After``
+    upstream) instead of buffering unboundedly; shedding at the door
+    keeps the p99 of admitted requests bounded;
+  * **deadline propagation** — ``submit(..., deadline=t)`` carries the
+    client's remaining budget (an absolute ``time.perf_counter`` point):
+    an already-expired request is shed at admission, and the worker
+    re-checks right before dispatch so the device NEVER works on a
+    request whose client has already given up (:class:`DeadlineError`,
+    structured 503 upstream).
 """
 from __future__ import annotations
 
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -39,18 +45,44 @@ DEPTH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 class OverloadError(LightGBMError):
-    """Queue-full rejection carrying the structured overload payload."""
+    """Load-shed rejection carrying the structured 503 payload.
 
-    def __init__(self, queue_depth: int, queue_size: int):
+    ``reason`` names WHY the request was shed ("queue_full",
+    "draining", "deadline_expired", "no_ready_replicas", ...) and
+    ``retry_after_s`` is the server's estimate of when retrying is
+    worthwhile — surfaced upstream both in the JSON body and as the
+    HTTP ``Retry-After`` header."""
+
+    def __init__(self, queue_depth: int, queue_size: int,
+                 reason: str = "queue_full",
+                 retry_after_s: float = 1.0):
         self.queue_depth = int(queue_depth)
         self.queue_size = int(queue_size)
+        self.reason = str(reason)
+        self.retry_after_s = float(retry_after_s)
         super().__init__(
-            f"serving queue full ({self.queue_depth}/{self.queue_size} "
-            "requests); retry with backoff")
+            f"serving request shed ({self.reason}; queue "
+            f"{self.queue_depth}/{self.queue_size}); retry with backoff")
 
     def payload(self) -> Dict[str, Any]:
-        return {"error": "overload", "queue_depth": self.queue_depth,
-                "queue_size": self.queue_size}
+        return {"error": "overload", "reason": self.reason,
+                "queue_depth": self.queue_depth,
+                "queue_size": self.queue_size,
+                "retry_after_s": round(self.retry_after_s, 3)}
+
+
+class DeadlineError(OverloadError):
+    """The request's propagated deadline expired before (or while)
+    queued — shed without touching the device."""
+
+    def __init__(self, queue_depth: int, queue_size: int):
+        super().__init__(queue_depth, queue_size,
+                         reason="deadline_expired", retry_after_s=0.0)
+
+    def payload(self) -> Dict[str, Any]:
+        out = super().payload()
+        out["error"] = "deadline_expired"
+        return out
 
 
 @dataclass
@@ -66,8 +98,24 @@ class PredictResult:
 class _Request:
     rows: np.ndarray
     raw_score: bool
+    deadline: Optional[float] = None      # absolute time.perf_counter point
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.perf_counter)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.perf_counter()) >= self.deadline)
+
+    def resolve(self, result=None, error: Optional[BaseException] = None):
+        """Set the future's outcome, tolerating a caller that already
+        cancelled it (deadline handlers give up on queued requests)."""
+        try:
+            if error is not None:
+                self.future.set_exception(error)
+            else:
+                self.future.set_result(result)
+        except InvalidStateError:
+            pass
 
 
 class MicroBatcher:
@@ -94,6 +142,17 @@ class MicroBatcher:
         self.batches = 0
         self.served = 0
         self.rejected = 0
+        self.expired = 0
+        # EWMA of per-batch dispatch seconds, seeding the Retry-After
+        # estimate before the first batch completes
+        self._dispatch_ewma = self.max_delay_s + 0.005
+
+    def retry_after_s(self) -> float:
+        """How long a shed client should back off: the estimated time to
+        drain the CURRENT queue (pending batches x recent dispatch time),
+        clamped to a sane [0.05 s, 5 s] window."""
+        batches_pending = max(self._q.qsize() / self.max_batch, 1.0)
+        return min(max(batches_pending * self._dispatch_ewma, 0.05), 5.0)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -132,16 +191,27 @@ class MicroBatcher:
 
     # -- submission --------------------------------------------------------
     def submit(self, rows, raw_score: bool = False,
-               fast: bool = False) -> "Future[PredictResult]":
+               fast: bool = False,
+               deadline: Optional[float] = None) -> "Future[PredictResult]":
         """Enqueue one request; returns a Future resolving to
         :class:`PredictResult`.  Raises :class:`OverloadError` at once
-        when the queue is full, :class:`LightGBMError` on shape errors."""
+        when the queue is full (or ``deadline`` — an absolute
+        ``time.perf_counter`` point — has already passed),
+        :class:`LightGBMError` on shape errors."""
         from .. import telemetry
 
         model = self.registry.current()
         X = model.validate_rows(rows)
         if self._stop.is_set():
-            raise OverloadError(self._q.qsize(), self.queue_size)
+            raise OverloadError(self._q.qsize(), self.queue_size,
+                                reason="draining",
+                                retry_after_s=self.retry_after_s())
+        if deadline is not None and time.perf_counter() >= deadline:
+            # expired before admission: shed at the door, zero queue work
+            with self._submit_lock:
+                self.expired += 1
+            telemetry.inc("serve/deadline_expired")
+            raise DeadlineError(self._q.qsize(), self.queue_size)
         if fast and X.shape[0] == 1:
             # latency-critical singleton: pre-bound native walk, caller
             # thread, zero queueing — still version-stamped
@@ -157,27 +227,50 @@ class MicroBatcher:
             fut: "Future[PredictResult]" = Future()
             fut.set_result(PredictResult(values, model.version, 1, 0.0))
             return fut
-        req = _Request(np.ascontiguousarray(X), bool(raw_score))
+        req = _Request(np.ascontiguousarray(X), bool(raw_score),
+                       deadline=deadline)
         with self._submit_lock:
             if self._stop.is_set():
-                raise OverloadError(self._q.qsize(), self.queue_size)
+                raise OverloadError(self._q.qsize(), self.queue_size,
+                                    reason="draining",
+                                    retry_after_s=self.retry_after_s())
             try:
                 self._q.put_nowait(req)
             except queue.Full:
                 self.rejected += 1
                 telemetry.inc("serve/rejected")
-                raise OverloadError(self._q.qsize(), self.queue_size)
+                telemetry.inc("serve/shed")
+                raise OverloadError(self._q.qsize(), self.queue_size,
+                                    reason="queue_full",
+                                    retry_after_s=self.retry_after_s())
         telemetry.observe("serve/queue_depth", float(self._q.qsize()),
                           bounds=DEPTH_BOUNDS)
         return req.future
 
     # -- worker ------------------------------------------------------------
+    def _expire(self, req: _Request) -> bool:
+        """Resolve an already-expired request with :class:`DeadlineError`
+        (the client gave up; the device must not score it)."""
+        from .. import telemetry
+
+        if not req.expired():
+            return False
+        with self._submit_lock:
+            self.expired += 1
+        telemetry.inc("serve/deadline_expired")
+        req.resolve(error=DeadlineError(self._q.qsize(), self.queue_size))
+        return True
+
     def _collect(self) -> List[_Request]:
         """One coalescing round: block for the first request, then gather
-        batch-mates until the row budget or the delay deadline."""
+        batch-mates until the row budget or the delay deadline.  Requests
+        whose propagated deadline lapsed while queued are expired here
+        instead of joining the batch."""
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
+            return []
+        if self._expire(first):
             return []
         batch = [first]
         rows = first.rows.shape[0]
@@ -189,6 +282,8 @@ class MicroBatcher:
                        else self._q.get(timeout=left))
             except queue.Empty:
                 break
+            if self._expire(nxt):
+                continue
             batch.append(nxt)
             rows += nxt.rows.shape[0]
             if left <= 0:
@@ -198,6 +293,12 @@ class MicroBatcher:
     def _process(self, batch: List[_Request]) -> None:
         from .. import telemetry
 
+        # final pre-dispatch deadline check: the coalescing window may
+        # have outlived a tight budget — the device never scores a
+        # request whose client already gave up
+        batch = [r for r in batch if not self._expire(r)]
+        if not batch:
+            return
         model = self.registry.current()   # pinned for the WHOLE batch
         good = [r for r in batch
                 if r.rows.shape[1] == model.num_features]
@@ -205,7 +306,7 @@ class MicroBatcher:
             if r.rows.shape[1] != model.num_features:
                 # the model was hot-swapped to a different feature count
                 # between submit-time validation and dispatch
-                r.future.set_exception(LightGBMError(
+                r.resolve(error=LightGBMError(
                     f"model v{model.version} expects "
                     f"{model.num_features} features, request has "
                     f"{r.rows.shape[1]}"))
@@ -218,7 +319,7 @@ class MicroBatcher:
         if n == 1 and len(good) == 1:
             # a lone singleton skips the device: native single-row walk
             values = model.predict(good[0].rows, raw_score=good[0].raw_score)
-            good[0].future.set_result(PredictResult(
+            good[0].resolve(PredictResult(
                 values, model.version, 1,
                 t0 - good[0].t_enqueue))
         else:
@@ -226,7 +327,7 @@ class MicroBatcher:
             off = 0
             for r in good:
                 m = r.rows.shape[0]
-                r.future.set_result(PredictResult(
+                r.resolve(PredictResult(
                     model.finish(raw[off:off + m], r.raw_score),
                     model.version, n, t0 - r.t_enqueue))
                 off += m
@@ -234,6 +335,8 @@ class MicroBatcher:
         with self._submit_lock:
             self.batches += 1
             self.served += len(good)
+            # EWMA feeds the Retry-After estimate for shed responses
+            self._dispatch_ewma = 0.8 * self._dispatch_ewma + 0.2 * dt
         telemetry.inc("serve/requests", len(good))
         telemetry.inc("serve/rows", n)
         telemetry.inc("serve/batches")
@@ -263,7 +366,7 @@ class MicroBatcher:
                 log_warning(f"serve batcher error: {type(e).__name__}: {e}")
                 for r in batch:
                     if not r.future.done():
-                        r.future.set_exception(
+                        r.resolve(error=(
                             e if isinstance(e, LightGBMError)
-                            else LightGBMError(f"serving failure: {e}"))
+                            else LightGBMError(f"serving failure: {e}")))
         log_debug("serve batcher worker exited")

@@ -1,0 +1,712 @@
+"""Serving fleet: replica pool supervisor with fleet-wide promotion.
+
+PR 4's server is one Python process — one crash, hang, or hot-reload
+hiccup takes 100% of traffic down.  This module turns it into a FLEET
+(docs/SERVING.md "Fleet architecture"):
+
+  * **replica pool** — N single-replica :class:`ServingApp` processes,
+    each importing jax on its own, so a wedged XLA dispatch or a killed
+    interpreter costs 1/N of capacity, not all of it.  Where the kernel
+    supports ``SO_REUSEPORT`` the replicas can share one listen port
+    (kernel load-balancing, ``serve_fleet_mode=reuseport``); everywhere
+    else — and whenever retry/breaker routing is wanted — the tiny
+    fanout front (:mod:`.front`) is the client-facing port
+    (``serve_fleet_mode=front``, the default);
+  * **liveness + restart** — every replica heartbeats a per-rank file
+    (the existing :mod:`..robustness.heartbeat` machinery) every
+    ``_BEAT_S``; the supervisor polls process exits AND heartbeat ages,
+    SIGKILLs replicas wedged past ``hang_timeout_s``, and restarts dead
+    ones with jittered exponential backoff (doubling per consecutive
+    restart, decaying after a healthy period);
+  * **fleet-wide promotion** — a shared registry directory holds a
+    ``promote.json`` pointer (generation, model path, sha256).  Any
+    ``/reload`` — on the front or on any replica — VALIDATES the
+    candidate first (manifest sha256, truncation parse, finite trees),
+    then atomically replaces the pointer; every replica's watcher thread
+    re-validates (pointer sha256 + the full registry checks) before its
+    own atomic swap.  A replica that fails validation keeps serving its
+    old version and reports itself degraded via ``/ready``; the fleet
+    never half-applies a poisoned candidate.
+
+The supervisor owns only the replica processes and the state directory —
+request routing, deadlines, retries and circuit breaking live in
+:mod:`.front`.
+
+State directory layout (``serve_fleet_dir``; a private tmpdir when
+unset)::
+
+    promote.json       {"generation", "path", "sha256", "promoted_unix"}
+    replica_<r>.json   {"rank", "host", "port", "pid", "started_unix"}
+    hb_<r>             heartbeat file (mtime = liveness)
+    replica_<r>.log    stdout/stderr of the replica process
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..robustness.checkpoint import atomic_write_text
+from ..robustness.heartbeat import heartbeat_age, write_heartbeat
+from ..utils.log import LightGBMError, log_debug, log_info, log_warning
+
+PROMOTE_NAME = "promote.json"
+_BEAT_S = 0.25           # replica heartbeat-loop period (chaos beat unit)
+_SUPERVISE_S = 0.2       # supervisor poll period
+_RESTART_CAP_S = 30.0    # backoff ceiling
+_HEALTHY_DECAY_S = 60.0  # a replica alive this long forgets its restarts
+
+
+# ---------------------------------------------------------------------------
+# candidate validation + the shared promotion pointer
+# ---------------------------------------------------------------------------
+
+def validate_candidate(path: str) -> str:
+    """The promotion pre-flight every promoter runs BEFORE touching the
+    pointer: manifest sha256 (when a sidecar exists), truncation/
+    corruption parse, finite-tree guard.  Returns the candidate's sha256.
+
+    Replicas re-run the same checks (plus a sha match against the
+    pointer) before their own swap — promotion is validated twice by
+    design: once so a garbage file never enters the pointer, once so a
+    file that changed on disk between pointer write and replica read is
+    rejected per-replica instead of served."""
+    from ..model_io import load_model_string
+    from ..robustness.guards import check_model_trees
+    from .registry import _check_manifest
+
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError as e:
+        raise LightGBMError(f"cannot read serving candidate {path!r}: {e}")
+    sha = _check_manifest(str(path), data)
+    try:
+        loaded = load_model_string(data.decode("utf-8"))
+    except UnicodeDecodeError as e:
+        raise LightGBMError(f"serving candidate {path!r} is not a text "
+                            f"model file: {e}")
+    check_model_trees(loaded.trees, what=f"serving candidate {path!r}")
+    return sha
+
+
+def read_pointer(fleet_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(fleet_dir, PROMOTE_NAME)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def write_pointer(fleet_dir: str, path: str, sha: str,
+                  generation: int) -> Dict[str, Any]:
+    """Atomically replace the promotion pointer (tmp + ``os.replace``:
+    a replica's watcher never reads a half-written pointer)."""
+    pointer = {"generation": int(generation), "path": str(path),
+               "sha256": sha, "promoted_unix": time.time()}
+    atomic_write_text(os.path.join(fleet_dir, PROMOTE_NAME),
+                      json.dumps(pointer))
+    return pointer
+
+
+def promote_pointer(fleet_dir: str, path: str,
+                    sha: Optional[str] = None) -> Dict[str, Any]:
+    """Validate ``path`` and advance the shared pointer one generation.
+    Any process with the fleet directory can promote — the supervisor,
+    a replica's ``/reload``, or an external deploy tool."""
+    checked = validate_candidate(path)
+    if sha is not None and sha != checked:
+        raise LightGBMError(
+            f"serving candidate {path!r} sha256 mismatch (expected "
+            f"{sha[:12]}..., file {checked[:12]}...)")
+    cur = read_pointer(fleet_dir)
+    gen = int(cur["generation"]) + 1 if cur else 1
+    return write_pointer(fleet_dir, path, checked, gen)
+
+
+# ---------------------------------------------------------------------------
+# replica process
+# ---------------------------------------------------------------------------
+
+def _replica_main(spec_path: str, rank: int) -> int:
+    """Entry point of one replica process (spawned by the supervisor as
+    ``python -m lightgbm_tpu.serving.fleet --replica <spec> <rank>``)."""
+    from ..robustness import chaos
+    from .server import ServingApp
+
+    with open(spec_path) as fh:
+        spec = json.load(fh)
+    if spec.get("cache_dir"):
+        # shared persistent compile cache: replica warmups after the
+        # first pay file reads, not XLA compiles
+        import jax
+        jax.config.update("jax_compilation_cache_dir", spec["cache_dir"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    fleet_dir = spec["fleet_dir"]
+    hb_path = os.path.join(fleet_dir, f"hb_{rank}")
+    stop = threading.Event()
+
+    # the heartbeat loop starts BEFORE the model loads: a replica stuck
+    # waiting for a valid pointer (below) must look alive to the
+    # supervisor, not wedged
+    def _beat() -> None:
+        n = 0
+        while not stop.is_set():
+            n += 1
+            chaos.replica_beat_hook(n)
+            try:
+                write_heartbeat(hb_path, n)
+            except OSError as e:
+                log_debug(f"replica {rank} heartbeat write failed: {e}")
+            if stop.wait(_BEAT_S):
+                break
+
+    beat_thread = threading.Thread(target=_beat,
+                                   name=f"lgbtpu-replica{rank}-beat",
+                                   daemon=True)
+    beat_thread.start()
+
+    # boot from the CURRENT pointer, but only after the same
+    # re-validation the promotion watcher performs — a candidate the
+    # fleet rejected (file tampered after promotion) must not be served
+    # just because this replica restarted; wait for a pointer that
+    # validates instead of crash-looping on a dead one
+    pointer = None
+    while pointer is None:
+        p = read_pointer(fleet_dir)
+        if p is None:
+            raise LightGBMError(f"fleet dir {fleet_dir!r} has no promotion "
+                                "pointer; the supervisor writes it before "
+                                "spawning replicas")
+        try:
+            sha = validate_candidate(str(p["path"]))
+            if sha != p.get("sha256"):
+                raise LightGBMError(
+                    f"pointer generation {p['generation']} sha256 mismatch "
+                    f"({sha[:12]}... != {str(p.get('sha256'))[:12]}...) — "
+                    "the file changed after promotion")
+            pointer = p
+        except LightGBMError as e:
+            log_warning(f"replica {rank}: promoted model failed boot "
+                        f"validation ({e}); waiting for a valid promotion")
+            if stop.wait(1.0):
+                return 0
+    reuseport = bool(spec.get("reuseport"))
+    app = ServingApp(
+        str(pointer["path"]),
+        host=spec["host"],
+        port=int(spec["shared_port"]) if reuseport else 0,
+        max_batch=int(spec["max_batch"]),
+        max_delay_ms=float(spec["max_delay_ms"]),
+        queue_size=int(spec["queue_size"]),
+        buckets_spec=str(spec.get("buckets", "")),
+        warmup=bool(spec.get("warmup", True)),
+        heartbeat_path=hb_path,
+        deadline_ms=float(spec.get("deadline_ms", 0.0)),
+        reuse_port=reuseport)
+    app.replica_rank = rank
+    app.generation = int(pointer["generation"])
+    app.seen_generation = app.generation
+
+    def _watch_promotions() -> None:
+        applied = int(pointer["generation"])
+        while not stop.wait(float(spec.get("poll_s", _BEAT_S))):
+            p = read_pointer(fleet_dir)
+            if p is None or int(p["generation"]) <= applied:
+                continue
+            gen = int(p["generation"])
+            applied = gen
+            try:
+                # re-validate against the POINTER's sha first: a file
+                # swapped after promotion must not be served even if it
+                # parses
+                sha = validate_candidate(str(p["path"]))
+                if sha != p.get("sha256"):
+                    raise LightGBMError(
+                        f"candidate {p['path']!r} does not match the "
+                        f"promoted sha256 ({sha[:12]}... != "
+                        f"{str(p.get('sha256'))[:12]}...) — the file "
+                        "changed after promotion")
+                app.registry.load(str(p["path"]))
+            except LightGBMError as e:
+                app.degraded = (f"candidate generation {gen} rejected: {e}")
+                app.seen_generation = gen
+                log_warning(f"replica {rank}: {app.degraded}; still "
+                            f"serving generation {app.generation}")
+                continue
+            app.generation = gen
+            app.seen_generation = gen
+            app.degraded = None
+            log_info(f"replica {rank}: promoted to generation {gen} "
+                     f"(sha {str(p['sha256'])[:12]})")
+
+    def _promote_fn(path: str):
+        # any replica's /reload promotes FLEET-WIDE through the shared
+        # pointer (its own watcher applies the swap like everyone else's)
+        p = promote_pointer(fleet_dir, path)
+        return {"promoted_generation": p["generation"],
+                "sha256": p["sha256"], "fleet_wide": True}
+
+    app.promote_fn = _promote_fn
+    app.start()
+    atomic_write_text(
+        os.path.join(fleet_dir, f"replica_{rank}.json"),
+        json.dumps({"rank": rank, "host": app.host, "port": app.port,
+                    "pid": os.getpid(), "started_unix": time.time()}))
+    threading.Thread(target=_watch_promotions,
+                     name=f"lgbtpu-replica{rank}-promote",
+                     daemon=True).start()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    log_info(f"replica {rank} serving on http://{app.host}:{app.port} "
+             f"(generation {app.generation}, pid {os.getpid()})")
+    while not stop.wait(0.2):
+        pass
+    app.shutdown(drain=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class ServingFleet:
+    """N replica processes + state dir + (front mode) the fanout front.
+
+    ``start()`` spawns everything and blocks until the fleet answers;
+    ``promote()`` advances the shared pointer and waits for replicas to
+    converge; ``stop()`` drains and reaps.  The supervisor thread
+    restarts dead/hung replicas with jittered exponential backoff."""
+
+    def __init__(self, model_path: str, *, replicas: int = 2,
+                 host: str = "127.0.0.1", port: int = 0,
+                 mode: str = "front", fleet_dir: str = "",
+                 max_batch: int = 256, max_delay_ms: float = 2.0,
+                 queue_size: int = 512, buckets_spec: str = "",
+                 warmup: bool = True, deadline_ms: float = 0.0,
+                 retries: int = 2, retry_backoff_ms: float = 25.0,
+                 breaker_failures: int = 5, breaker_cooldown_s: float = 2.0,
+                 restart_backoff_s: float = 0.5,
+                 hang_timeout_s: float = 10.0,
+                 startup_timeout_s: float = 180.0,
+                 python: str = sys.executable):
+        from .server import reuseport_available
+
+        if replicas < 1:
+            raise LightGBMError("serve_replicas must be >= 1")
+        if mode not in ("front", "reuseport"):
+            raise LightGBMError(
+                f"serve_fleet_mode must be 'front' or 'reuseport', "
+                f"got {mode!r}")
+        if mode == "reuseport" and not reuseport_available():
+            log_warning("SO_REUSEPORT is unavailable on this platform; "
+                        "the fleet falls back to the fanout front")
+            mode = "front"
+        self.mode = mode
+        self.replicas = int(replicas)
+        self.host = str(host)
+        self.port = int(port)
+        if self.mode == "reuseport" and self.port == 0:
+            # port 0 would hand every replica its OWN kernel-assigned
+            # port — SO_REUSEPORT shares nothing and the fleet has no
+            # addressable endpoint; pick one concrete free port for the
+            # whole group instead
+            import socket
+            with socket.socket() as s:
+                s.bind((self.host, 0))
+                self.port = s.getsockname()[1]
+            log_info(f"fleet: reuseport mode picked shared port "
+                     f"{self.port}")
+        self.deadline_ms = float(deadline_ms or 0.0)
+        self.retries = int(retries)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.restart_backoff_s = max(float(restart_backoff_s), 0.05)
+        self.hang_timeout_s = float(hang_timeout_s or 0.0)
+        self.startup_timeout_s = float(startup_timeout_s)
+        self._python = python
+        self._own_dir = not fleet_dir
+        self.dir = fleet_dir or tempfile.mkdtemp(prefix="lgb_tpu_fleet_")
+        os.makedirs(self.dir, exist_ok=True)
+        # gen 1 (or continue a pre-existing shared dir's count): the
+        # pointer exists BEFORE any replica starts, so every replica
+        # boots on the same validated version
+        sha = validate_candidate(model_path)
+        cur = read_pointer(self.dir)
+        gen = int(cur["generation"]) + 1 if cur else 1
+        self._pointer = write_pointer(self.dir, model_path, sha, gen)
+        self._spec = {
+            "fleet_dir": self.dir, "host": self.host,
+            "shared_port": self.port, "reuseport": mode == "reuseport",
+            "max_batch": int(max_batch),
+            "max_delay_ms": float(max_delay_ms),
+            "queue_size": int(queue_size), "buckets": str(buckets_spec),
+            "warmup": bool(warmup), "deadline_ms": self.deadline_ms,
+            "poll_s": _BEAT_S, "cache_dir": "/tmp/lgb_tpu_jax_cache",
+        }
+        self._spec_path = os.path.join(self.dir, "replica_spec.json")
+        # atomic: a replica that races the supervisor must never read a
+        # half-written spec
+        atomic_write_text(self._spec_path, json.dumps(self._spec))
+        self._lock = threading.Lock()
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._restarts: Dict[int, int] = {}
+        self._last_spawn: Dict[int, float] = {}
+        self._restart_due: Dict[int, float] = {}
+        self.restarts_total = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.front = None
+        # jitter keeps a mass-restart from thundering-herding the model
+        # load; seeded per-fleet so runs are reproducible
+        self._rng = random.Random(0xF1EE7 ^ self.replicas)
+
+    # -- process plumbing --------------------------------------------------
+    def _endpoint_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"replica_{rank}.json")
+
+    def endpoint(self, rank: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._endpoint_path(rank)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def endpoints(self) -> Dict[int, Dict[str, Any]]:
+        """rank -> endpoint record for replicas with a LIVE process."""
+        out: Dict[int, Dict[str, Any]] = {}
+        with self._lock:
+            live = [r for r, p in self._procs.items() if p.poll() is None]
+        for r in live:
+            ep = self.endpoint(r)
+            if ep is not None:
+                out[r] = ep
+        return out
+
+    def _spawn(self, rank: int) -> None:
+        for stale in (self._endpoint_path(rank),
+                      os.path.join(self.dir, f"hb_{rank}")):
+            if os.path.exists(stale):
+                os.unlink(stale)
+        env = dict(os.environ)
+        env["LGBTPU_REPLICA_RANK"] = str(rank)
+        env["PYTHONUNBUFFERED"] = "1"
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        log_path = os.path.join(self.dir, f"replica_{rank}.log")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [self._python, "-m", "lightgbm_tpu.serving.fleet",
+                 "--replica", self._spec_path, str(rank)],
+                env=env, stdout=logf, stderr=subprocess.STDOUT)
+        with self._lock:
+            self._procs[rank] = proc
+            self._last_spawn[rank] = time.monotonic()
+        log_debug(f"fleet: spawned replica {rank} (pid {proc.pid})")
+
+    def _schedule_restart(self, rank: int, why: str) -> None:
+        from .. import telemetry
+
+        with self._lock:
+            healthy_for = time.monotonic() - self._last_spawn.get(rank, 0.0)
+            if healthy_for > _HEALTHY_DECAY_S:
+                self._restarts[rank] = 0
+            n = self._restarts.get(rank, 0)
+            self._restarts[rank] = n + 1
+            self.restarts_total += 1
+            delay = min(self.restart_backoff_s * (2 ** n), _RESTART_CAP_S)
+            delay *= 0.75 + 0.5 * self._rng.random()   # +/-25% jitter
+            self._restart_due[rank] = time.monotonic() + delay
+        telemetry.inc("fleet/restarts")
+        log_warning(f"fleet: replica {rank} {why}; restart "
+                    f"{self._restarts[rank]} in {delay:.2f}s")
+
+    def _tail_log(self, rank: int, n: int = 2000) -> str:
+        try:
+            with open(os.path.join(self.dir, f"replica_{rank}.log"),
+                      "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - n))
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return "<no replica log>"
+
+    def _supervise(self) -> None:
+        """The babysitter: poll exits + heartbeat ages, reap hung
+        replicas, respawn dead ones once their backoff elapses."""
+        from .. import telemetry
+
+        while not self._stop.wait(_SUPERVISE_S):
+            now = time.monotonic()
+            with self._lock:
+                snapshot = dict(self._procs)
+                due = dict(self._restart_due)
+            alive = 0
+            for rank, proc in snapshot.items():
+                rc = proc.poll()
+                telemetry.gauge(f"fleet/replica/{rank}/up",
+                                1.0 if rc is None else 0.0)
+                if rc is not None:
+                    if rank not in due:
+                        self._schedule_restart(rank, f"exited (rc {rc})")
+                    continue
+                alive += 1
+                if self.hang_timeout_s > 0:
+                    age = heartbeat_age(os.path.join(self.dir, f"hb_{rank}"))
+                    if age is not None:
+                        telemetry.gauge(
+                            f"fleet/replica/{rank}/heartbeat_age_s", age)
+                    started = self._last_spawn.get(rank, now)
+                    if age is None:
+                        # no beat yet: give the interpreter+jax import
+                        # the startup window before declaring it wedged
+                        if now - started > max(self.startup_timeout_s,
+                                               self.hang_timeout_s):
+                            log_warning(f"fleet: replica {rank} never "
+                                        "heartbeat; killing")
+                            proc.kill()
+                    elif age > self.hang_timeout_s:
+                        log_warning(f"fleet: replica {rank} heartbeat "
+                                    f"stale ({age:.1f}s > "
+                                    f"{self.hang_timeout_s:.1f}s); killing")
+                        proc.kill()
+            telemetry.gauge("fleet/replicas_alive", float(alive))
+            for rank, when in due.items():
+                if now >= when:
+                    with self._lock:
+                        self._restart_due.pop(rank, None)
+                    self._spawn(rank)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingFleet":
+        for r in range(self.replicas):
+            self._spawn(r)
+        deadline = time.monotonic() + self.startup_timeout_s
+        pending = set(range(self.replicas))
+        while pending:
+            for r in sorted(pending):
+                proc = self._procs.get(r)
+                if proc is not None and proc.poll() is not None:
+                    raise LightGBMError(
+                        f"fleet replica {r} died during startup "
+                        f"(rc {proc.returncode}):\n{self._tail_log(r)}")
+                if self.endpoint(r) is not None:
+                    pending.discard(r)
+            if not pending:
+                break
+            if time.monotonic() > deadline:
+                raise LightGBMError(
+                    f"fleet replicas {sorted(pending)} not up within "
+                    f"{self.startup_timeout_s:.0f}s")
+            time.sleep(0.1)
+        self._thread = threading.Thread(target=self._supervise,
+                                        name="lgbtpu-fleet-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        if self.mode == "front":
+            from .front import FanoutFront
+            self.front = FanoutFront(
+                self, host=self.host, port=self.port,
+                retries=self.retries,
+                retry_backoff_ms=self.retry_backoff_ms,
+                breaker_failures=self.breaker_failures,
+                breaker_cooldown_s=self.breaker_cooldown_s,
+                deadline_ms=self.deadline_ms).start()
+            self.port = self.front.port
+        else:
+            self.port = int(self._spec["shared_port"])
+        log_info(f"fleet: {self.replicas} replicas up "
+                 f"({self.mode} mode, http://{self.host}:{self.port}, "
+                 f"dir {self.dir})")
+        return self
+
+    @property
+    def generation(self) -> int:
+        p = read_pointer(self.dir)
+        return int(p["generation"]) if p else 0
+
+    def current_pointer(self) -> Optional[Dict[str, Any]]:
+        return read_pointer(self.dir)
+
+    def promote(self, path: str,
+                timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Validate + write the pointer, then wait for every live
+        replica to process the new generation.  Returns the per-replica
+        outcome; raises only when the CANDIDATE fails validation (the
+        fleet is untouched in that case)."""
+        pointer = promote_pointer(self.dir, path)
+        gen = int(pointer["generation"])
+        deadline = time.monotonic() + timeout_s
+        promoted: Dict[int, bool] = {}
+        rejected: Dict[int, str] = {}
+        while time.monotonic() < deadline:
+            states = self._ready_states()
+            pending = False
+            for rank, st in states.items():
+                if st is None or int(st.get("seen_generation", 0)) < gen:
+                    pending = True
+                    continue
+                if int(st.get("generation", 0)) == gen:
+                    promoted[rank] = True
+                    rejected.pop(rank, None)
+                else:
+                    rejected[rank] = str(st.get("degraded", "rejected"))
+            if not pending and states:
+                break
+            time.sleep(0.1)
+        unreachable = [r for r, st in self._ready_states().items()
+                       if st is None
+                       or int(st.get("seen_generation", 0)) < gen]
+        return {"generation": gen, "sha256": pointer["sha256"],
+                "promoted": sorted(promoted),
+                "rejected": {str(r): m for r, m in sorted(rejected.items())},
+                "unreachable": sorted(set(unreachable) - set(promoted))}
+
+    def _ready_states(self) -> Dict[int, Optional[Dict[str, Any]]]:
+        """rank -> /ready payload (None when unreachable) for every live
+        replica."""
+        from .front import http_json
+
+        import http.client
+
+        out: Dict[int, Optional[Dict[str, Any]]] = {}
+        for rank, ep in self.endpoints().items():
+            try:
+                _, obj, _ = http_json(ep["host"], ep["port"], "GET",
+                                      "/ready", timeout=1.0)
+                out[rank] = obj
+            except (OSError, http.client.HTTPException):
+                # a replica dying mid-response (IncompleteRead) must read
+                # as unreachable, not abort a promote()/describe() whose
+                # pointer already advanced
+                out[rank] = None
+        return out
+
+    def describe(self, states: Optional[Dict[int, Optional[Dict[str, Any]]]]
+                 = None) -> Dict[str, Any]:
+        """Fleet snapshot.  ``states`` lets a caller that already holds
+        fresh /ready payloads (the front's background cache) avoid N
+        synchronous per-replica probes per /stats scrape."""
+        if states is None:
+            states = self._ready_states()
+        with self._lock:
+            restarts = dict(self._restarts)
+            total = self.restarts_total
+        reps: List[Dict[str, Any]] = []
+        for rank in range(self.replicas):
+            st = states.get(rank)
+            rec: Dict[str, Any] = {"rank": rank,
+                                   "reachable": st is not None,
+                                   "restarts": restarts.get(rank, 0)}
+            if st:
+                rec.update({k: st[k] for k in
+                            ("ready", "queue_depth", "model_version",
+                             "model_sha256", "generation", "degraded",
+                             "heartbeat_age_s") if k in st})
+            reps.append(rec)
+        return {"mode": self.mode, "replicas": reps,
+                "generation": self.generation,
+                "restarts_total": total, "dir": self.dir}
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(5.0)
+        if self.front is not None:
+            self.front.stop()
+        with self._lock:
+            procs = dict(self._procs)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()        # SIGTERM: replicas drain
+        deadline = time.monotonic() + timeout_s
+        for proc in procs.values():
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        if self._own_dir:
+            shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
+    """Build (not start) a ServingFleet from resolved CLI/conf params."""
+    from ..config import Config
+
+    cfg = Config.from_params(params)
+    model_path = str(params.get("input_model", "") or "")
+    if not model_path:
+        raise LightGBMError("task=serve requires input_model=<model file>")
+    return ServingFleet(
+        model_path, replicas=cfg.serve_replicas,
+        host=cfg.serve_host, port=cfg.serve_port,
+        mode=cfg.serve_fleet_mode, fleet_dir=cfg.serve_fleet_dir,
+        max_batch=cfg.serve_max_batch, max_delay_ms=cfg.serve_max_delay_ms,
+        queue_size=cfg.serve_queue_size, buckets_spec=cfg.serve_buckets,
+        warmup=cfg.serve_warmup, deadline_ms=cfg.serve_deadline_ms,
+        retries=cfg.serve_retries,
+        retry_backoff_ms=cfg.serve_retry_backoff_ms,
+        breaker_failures=cfg.serve_breaker_failures,
+        breaker_cooldown_s=cfg.serve_breaker_cooldown_s,
+        restart_backoff_s=cfg.serve_restart_backoff_s,
+        hang_timeout_s=cfg.serve_hang_timeout_s)
+
+
+def run_fleet(params: Dict[str, Any]) -> int:
+    """Blocking CLI entry: serve the fleet until SIGTERM/SIGINT."""
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        telemetry.configure(enabled=True,
+                            metrics_out=str(params.get("telemetry_out", ""))
+                            or None)
+    fleet = fleet_from_params(params).start()
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        log_info(f"signal {signum}: draining serving fleet")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        stop.wait()
+    finally:
+        fleet.stop()
+        log_info("serving fleet stopped")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if len(argv) == 3 and argv[0] == "--replica":
+        return _replica_main(argv[1], int(argv[2]))
+    print("usage: python -m lightgbm_tpu.serving.fleet --replica "
+          "<spec.json> <rank>\n(the fleet supervisor spawns this; start "
+          "a fleet with: python -m lightgbm_tpu.serve "
+          "input_model=model.txt serve_replicas=3)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

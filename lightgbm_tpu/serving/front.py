@@ -1,0 +1,496 @@
+"""Fanout front: the client-facing port of a serving fleet.
+
+A tiny stdlib-HTTP reverse proxy over the replica pool with the three
+request-resilience mechanisms the single-process server cannot provide
+(docs/SERVING.md "Fleet architecture"):
+
+  * **deadline-aware bounded retry** — the client's budget
+    (``deadline_ms``, defaulting to ``serve_deadline_ms``) is split
+    across up to ``serve_retries + 1`` attempts on DIFFERENT replicas,
+    with jittered exponential backoff between attempts.  Transport
+    failures (connection reset, timeout — what a killed or hung replica
+    produces) and replica 5xx/503 responses retry; 4xx client errors
+    pass through untouched.  The remaining budget rides to the replica
+    in the forwarded body, so a request never queues past its own
+    expiry downstream;
+  * **per-replica circuit breaker** — consecutive errors/timeouts past
+    ``serve_breaker_failures`` trip the replica's breaker OPEN: it gets
+    no traffic for ``serve_breaker_cooldown_s``, then ONE half-open
+    probe; success closes it, failure re-opens.  A wedged replica costs
+    its first few victims a per-attempt timeout, then nothing;
+  * **load shedding** — when no ready replica remains (all breakers
+    open, none ready, or the budget ran out before an attempt), the
+    front answers a fast structured 503 with ``Retry-After`` instead of
+    queueing into collapse.
+
+Routing keys off replica READINESS (``/ready``, polled in the
+background), not liveness: a draining or model-less replica gets no
+traffic but is not presumed dead.
+"""
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import math
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.log import LightGBMError, log_debug, log_info
+
+_READY_POLL_S = 0.5       # background readiness sweep period
+_READY_TIMEOUT_S = 1.0    # per-replica /ready probe timeout
+_MIN_TRY_S = 0.05         # floor on a per-attempt forward timeout
+_FALLBACK_BUDGET_S = 30.0  # budget when neither client nor config set one
+
+
+def http_json(host: str, port: int, method: str, path: str,
+              obj: Optional[Dict[str, Any]] = None,
+              timeout: float = 10.0
+              ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+    """One JSON request; raises OSError-family on transport failure."""
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        body = json.dumps(obj) if obj is not None else None
+        conn.request(method, path, body,
+                     {"Content-Type": "application/json"} if body else {})
+        r = conn.getresponse()
+        raw = r.read()
+        try:
+            payload = json.loads(raw) if raw else {}
+        except ValueError:
+            raise ConnectionError(
+                f"non-JSON reply ({r.status}) from {host}:{port}{path}")
+        return r.status, payload, dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+class CircuitBreaker:
+    """closed -> open (after N consecutive failures) -> half-open (one
+    probe after the cooldown) -> closed|open.  Thread-safe; the clock is
+    injectable so tests drive the state machine deterministically."""
+
+    def __init__(self, failures: int = 5, cooldown_s: float = 2.0,
+                 clock=time.monotonic):
+        self.failures = max(int(failures), 1)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.trips = 0            # closed/half-open -> open transitions
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._clock() - self._opened_at >= self.cooldown_s:
+            return "half_open"
+        return "open"
+
+    def peek(self) -> bool:
+        """Non-consuming routability check (candidate filtering): True
+        unless the breaker is open or a half-open probe is in flight."""
+        with self._lock:
+            st = self._state_locked()
+            return st == "closed" or (st == "half_open"
+                                      and not self._probing)
+
+    def allow(self) -> bool:
+        """May a request be routed here right now?  In half-open, only
+        ONE in-flight probe is allowed at a time — calling this CLAIMS
+        the probe slot, so only call it for the replica actually being
+        dispatched to (use :meth:`peek` for filtering)."""
+        with self._lock:
+            st = self._state_locked()
+            if st == "closed":
+                return True
+            if st == "half_open" and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            # a failed half-open probe re-opens; consecutive failures
+            # past the threshold trip a closed breaker; failures landing
+            # while already open (stragglers) leave the cooldown clock
+            # alone
+            if self._probing or (self._opened_at is None
+                                 and self._consecutive >= self.failures):
+                self._opened_at = self._clock()
+                self._probing = False
+                self.trips += 1
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self._state_locked(),
+                    "consecutive_failures": self._consecutive,
+                    "trips": self.trips}
+
+
+class FanoutFront:
+    """The fleet's public HTTP endpoint: routes ``/predict`` across the
+    replica pool, aggregates ``/ready``/``/stats``, and turns ``/reload``
+    into a fleet-wide promotion."""
+
+    def __init__(self, fleet, *, host: str = "127.0.0.1", port: int = 0,
+                 retries: int = 2, retry_backoff_ms: float = 25.0,
+                 breaker_failures: int = 5, breaker_cooldown_s: float = 2.0,
+                 deadline_ms: float = 0.0):
+        self.fleet = fleet
+        self.retries = max(int(retries), 0)
+        self.retry_backoff_s = max(float(retry_backoff_ms), 0.0) / 1e3
+        self.deadline_ms = float(deadline_ms or 0.0)
+        self._breaker_failures = int(breaker_failures)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        self._ready: Dict[int, Dict[str, Any]] = {}
+        self._ready_swept = False
+        self.shed = 0
+        self.retried = 0
+        self.forwarded = 0
+        self._rng = random.Random(0xF407)
+        self._stop = threading.Event()
+        self._httpd = ThreadingHTTPServer((host, int(port)), _FrontHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.front = self
+        self._threads: List[threading.Thread] = []
+        self.t0 = time.time()
+
+    # -- plumbing ----------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "FanoutFront":
+        self._threads = [
+            threading.Thread(target=self._httpd.serve_forever,
+                             name="lgbtpu-fleet-front", daemon=True),
+            threading.Thread(target=self._poll_ready,
+                             name="lgbtpu-front-ready", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        log_info(f"fleet front on http://{self.host}:{self.port} "
+                 f"({self.fleet.replicas} replicas)")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for t in self._threads:
+            if t.is_alive():
+                t.join(5.0)
+
+    def breaker(self, rank: int) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(rank)
+            if br is None:
+                br = CircuitBreaker(self._breaker_failures,
+                                    self._breaker_cooldown_s)
+                self._breakers[rank] = br
+            return br
+
+    # -- readiness ---------------------------------------------------------
+    def _poll_ready(self) -> None:
+        from .. import telemetry
+
+        while True:   # first sweep runs immediately, not a period late
+            snapshot: Dict[int, Dict[str, Any]] = {}
+            for rank, ep in self.fleet.endpoints().items():
+                try:
+                    st, obj, _ = http_json(ep["host"], ep["port"], "GET",
+                                           "/ready",
+                                           timeout=_READY_TIMEOUT_S)
+                    obj["_reachable"] = True
+                    obj["ready"] = bool(obj.get("ready")) and st == 200
+                except (OSError, http.client.HTTPException) as e:
+                    # a replica killed mid-response raises IncompleteRead
+                    # (an HTTPException, NOT an OSError) — either way
+                    # this sweep must survive, or the readiness cache
+                    # freezes forever
+                    obj = {"_reachable": False, "ready": False,
+                           "error": f"{type(e).__name__}: {e}"}
+                snapshot[rank] = obj
+            with self._lock:
+                self._ready = snapshot
+                self._ready_swept = True
+            telemetry.gauge("fleet/replicas_ready",
+                            float(sum(1 for o in snapshot.values()
+                                      if o.get("ready"))))
+            if self._stop.wait(_READY_POLL_S):
+                break
+
+    def _candidates(self) -> List[Tuple[int, Dict[str, Any]]]:
+        """(rank, endpoint) targets in round-robin order: ready replicas
+        whose breaker LOOKS routable (:meth:`CircuitBreaker.peek` —
+        the probe slot is only claimed for the replica actually picked).
+        Before the first readiness sweep completes, every live replica
+        is optimistically a candidate."""
+        eps = self.fleet.endpoints()
+        with self._lock:
+            ready, swept = dict(self._ready), self._ready_swept
+        ranks = [r for r in sorted(eps)
+                 if (not swept or ready.get(r, {}).get("ready"))
+                 and self.breaker(r).peek()]
+        if not ranks:
+            return []
+        start = next(self._rr) % len(ranks)
+        return [(r, eps[r]) for r in ranks[start:] + ranks[:start]]
+
+    # -- request handling --------------------------------------------------
+    def handle_predict(self, body: Dict[str, Any]
+                       ) -> Tuple[int, Dict[str, Any],
+                                  Optional[Dict[str, str]]]:
+        from .. import telemetry
+
+        t0 = time.perf_counter()
+        try:
+            budget_ms = float(body.get("deadline_ms",
+                                       self.deadline_ms) or 0.0)
+        except (TypeError, ValueError):
+            return 400, {"error": "deadline_ms must be a number"}, None
+        budget_s = budget_ms / 1e3 if budget_ms > 0 else _FALLBACK_BUDGET_S
+        deadline = t0 + budget_s
+        attempts = self.retries + 1
+        last: Optional[Tuple[int, Dict[str, Any]]] = None
+        retry_after = 0.5
+        for attempt in range(attempts):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                return self._shed("deadline_expired", 0.0)
+            picked = None
+            for rank, ep in self._candidates():
+                # allow() claims the half-open probe slot; only the
+                # replica actually dispatched to may consume it
+                if self.breaker(rank).allow():
+                    picked = (rank, ep)
+                    break
+            if picked is None:
+                return self._shed("no_ready_replicas", retry_after)
+            rank, ep = picked
+            per_try = max(remaining / (attempts - attempt), _MIN_TRY_S)
+            fwd = dict(body)
+            fwd["deadline_ms"] = per_try * 1e3
+            br = self.breaker(rank)
+            try:
+                st, obj, _ = http_json(ep["host"], ep["port"], "POST",
+                                       "/predict", fwd, timeout=per_try)
+            except (OSError, http.client.HTTPException,
+                    ConnectionError) as e:
+                # killed replica -> reset; hung replica -> timeout: both
+                # are breaker food and retry on another replica
+                trips0 = br.trips
+                br.record_failure()
+                if br.trips > trips0:
+                    telemetry.inc("fleet/breaker_trips")
+                last = (503, {"error": "overload",
+                              "reason": f"replica {rank} unreachable: "
+                                        f"{type(e).__name__}"})
+                log_debug(f"front: attempt {attempt + 1} replica {rank} "
+                          f"failed: {type(e).__name__}: {e}")
+            else:
+                if st >= 500 and st != 503:
+                    # replica-side error: breaker food, retry a sibling
+                    trips0 = br.trips
+                    br.record_failure()
+                    if br.trips > trips0:
+                        telemetry.inc("fleet/breaker_trips")
+                    last = (st, obj)
+                else:
+                    # ANY prompt response proves the replica is alive —
+                    # including a 503 shed (overloaded is not broken);
+                    # this also releases a claimed half-open probe slot
+                    br.record_success()
+                    if st == 200:
+                        with self._lock:
+                            self.forwarded += 1
+                        obj["attempts"] = attempt + 1
+                        obj["latency_ms"] = round(
+                            (time.perf_counter() - t0) * 1e3, 3)
+                        return 200, obj, None
+                    if st != 503:
+                        # client errors (400/404/409) are not the
+                        # replica's fault: pass through, never retry
+                        return st, obj, None
+                    # overload/deadline shed: try a sibling
+                    retry_after = float(obj.get("retry_after_s",
+                                                retry_after) or retry_after)
+                    last = (st, obj)
+            if attempt + 1 < attempts:
+                with self._lock:
+                    self.retried += 1
+                telemetry.inc("fleet/retries")
+                backoff = self.retry_backoff_s * (2 ** attempt) \
+                    * (0.5 + self._rng.random())
+                backoff = min(backoff,
+                              max(deadline - time.perf_counter(), 0.0))
+                if backoff > 0:
+                    time.sleep(backoff)
+        if last is not None and last[0] == 503:
+            return self._shed(str(last[1].get("reason",
+                                              last[1].get("error",
+                                                          "overload"))),
+                              retry_after)
+        return self._shed("retries_exhausted", retry_after)
+
+    def _shed(self, reason: str, retry_after_s: float
+              ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        from .. import telemetry
+
+        with self._lock:
+            self.shed += 1
+        telemetry.inc("fleet/shed")
+        retry_after_s = min(max(retry_after_s, 0.0), 5.0)
+        return 503, {"error": "overload", "reason": reason,
+                     "retry_after_s": round(retry_after_s, 3)}, \
+            {"Retry-After": str(max(int(math.ceil(retry_after_s)), 0))}
+
+    def handle_reload(self, body: Dict[str, Any]
+                      ) -> Tuple[int, Dict[str, Any]]:
+        path = str(body.get("path", "") or "")
+        if not path:
+            p = self.fleet.current_pointer()
+            if p is None:
+                return 409, {"error": "fleet has no promoted model"}
+            path = str(p["path"])
+        try:
+            outcome = self.fleet.promote(path)
+        except LightGBMError as e:
+            # candidate failed validation: nothing was promoted anywhere
+            return 409, {"error": str(e),
+                         "generation": self.fleet.generation}
+        if not outcome["promoted"]:
+            return 409, {"error": "no replica accepted the candidate; "
+                                  "fleet stays on its previous version",
+                         **outcome}
+        return 200, outcome
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            ready = dict(self._ready)
+            counters = {"shed": self.shed, "retried": self.retried,
+                        "forwarded": self.forwarded}
+        breakers = {str(r): self.breaker(r).describe()
+                    for r in sorted(self.fleet.endpoints())}
+        # the cached /ready payloads stand in for fresh per-replica
+        # probes — a /stats scrape must not fan out N blocking HTTP
+        # calls when the background poller refreshes them anyway
+        cached = {r: (st if st.get("_reachable") else None)
+                  for r, st in ready.items()} or None
+        return {"uptime_s": round(time.time() - self.t0, 3),
+                **counters,
+                "breakers": breakers,
+                "replicas": {str(r): {k: v for k, v in st.items()
+                                      if not k.startswith("_")}
+                             for r, st in sorted(ready.items())},
+                "fleet": self.fleet.describe(states=cached)}
+
+    def ready_payload(self) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            ready = dict(self._ready)
+        rows = []
+        n_ready = 0
+        for rank in sorted(ready):
+            st = ready[rank]
+            ok = bool(st.get("ready"))
+            n_ready += int(ok)
+            rows.append({
+                "rank": rank, "ready": ok,
+                "breaker": self.breaker(rank).state,
+                **{k: st[k] for k in ("queue_depth", "model_version",
+                                      "model_sha256", "generation",
+                                      "seen_generation", "degraded",
+                                      "heartbeat_age_s") if k in st}})
+        return (200 if n_ready else 503), {
+            "ready": n_ready > 0, "replicas_ready": n_ready,
+            "generation": self.fleet.generation, "replicas": rows}
+
+
+class _FrontHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log_debug("fleet front http: " + fmt % args)
+
+    @property
+    def front(self) -> FanoutFront:
+        return self.server.front
+
+    def _send(self, code: int, obj: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            obj = json.loads(raw.decode("utf-8") or "{}")
+        except ValueError as e:
+            raise LightGBMError(f"request body is not valid JSON: {e}")
+        if not isinstance(obj, dict):
+            raise LightGBMError("request body must be a JSON object")
+        return obj
+
+    def do_GET(self):   # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        if path == "/health":
+            alive = sum(1 for _ in self.front.fleet.endpoints())
+            self._send(200 if alive else 503,
+                       {"status": "ok" if alive else "dead",
+                        "replicas_alive": alive,
+                        "uptime_s": round(time.time() - self.front.t0, 3)})
+        elif path == "/ready":
+            self._send(*self.front.ready_payload())
+        elif path == "/stats":
+            self._send(200, self.front.describe())
+        else:
+            self._send(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):   # noqa: N802
+        path = self.path.split("?")[0]
+        headers: Optional[Dict[str, str]] = None
+        try:
+            body = self._read_json()
+            if path == "/predict":
+                code, obj, headers = self.front.handle_predict(body)
+            elif path == "/reload":
+                code, obj = self.front.handle_reload(body)
+            else:
+                code, obj = 404, {"error": f"unknown path {self.path!r}"}
+        except LightGBMError as e:
+            code, obj = 400, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — the front must answer
+            code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+        self._send(code, obj, headers)

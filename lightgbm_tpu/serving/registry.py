@@ -182,6 +182,11 @@ class ModelRegistry:
         self._warmup = bool(warmup)
         self.reloads_ok = 0
         self.reloads_failed = 0
+        # version -> sha256 for every model this registry ever served:
+        # responses stamp both, so a fleet front (or an auditor) can map
+        # any response to the exact bytes that scored it even across
+        # replica-local version counters
+        self._sha_by_version: Dict[int, str] = {}
         if path:
             self.load(path)
 
@@ -216,6 +221,7 @@ class ModelRegistry:
             self._version += 1
             model.version = self._version
             self._current = model
+            self._sha_by_version[model.version] = sha
             self.reloads_ok += 1
         telemetry.inc("serve/reloads")
         telemetry.instant("serve:reload", version=model.version,
@@ -236,6 +242,10 @@ class ModelRegistry:
     def version(self) -> int:
         with self._lock:
             return self._version
+
+    def sha_for_version(self, version: int) -> Optional[str]:
+        with self._lock:
+            return self._sha_by_version.get(int(version))
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

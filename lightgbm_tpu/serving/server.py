@@ -9,14 +9,25 @@ Endpoints:
                      "batched_rows", "latency_ms"}.  A full queue replies
                      503 with the structured overload payload; shape
                      errors reply 400.
-  ``GET  /health``   liveness: worker thread state, heartbeat age, queue
-                     depth, model version (503 when the worker died).
+  ``GET  /health``   LIVENESS only: is the process up and the batch
+                     worker thread alive (503 when the worker died).
+  ``GET  /ready``    READINESS: queue depth, active model version +
+                     sha256, promotion generation, degraded state and
+                     heartbeat age — what a fleet front or supervisor
+                     keys routing off (503 while draining / dead /
+                     model-less).
   ``POST /reload``   {"path": optional} — validated atomic hot-swap; a
                      rejected candidate replies 409 and the old version
                      keeps serving.
   ``GET  /stats``    latency/queue-depth percentiles from the telemetry
                      registry, request counters, recompile watchdog
                      counts, model + registry info.
+
+Request resilience (docs/SERVING.md "Fleet architecture"): a ``/predict``
+body may carry ``deadline_ms`` — the client's remaining budget.  The
+budget propagates through queue admission and the batcher's pre-dispatch
+check, so expired requests are shed as structured 503s instead of being
+scored for nobody.  Every shed 503 carries a ``Retry-After`` header.
 
 Shutdown: ``shutdown(drain=True)`` (wired to SIGTERM/SIGINT by
 ``run_server``) stops accepting connections, lets the batcher drain
@@ -26,17 +37,21 @@ admitted requests.
 from __future__ import annotations
 
 import json
+import math
 import signal
+import socket
 import threading
 import time
 from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..robustness import chaos
 from ..utils.log import LightGBMError, log_debug, log_info
-from .batcher import MicroBatcher, OverloadError
+from .batcher import DeadlineError, MicroBatcher, OverloadError
 from .registry import ModelRegistry
 
 _REQUEST_TIMEOUT_S = 30.0
@@ -47,6 +62,33 @@ def _jsonable(values: np.ndarray):
     return v.tolist()
 
 
+def reuseport_available() -> bool:
+    """Can several sockets share one listen port on this platform?
+    (SO_REUSEPORT kernel load-balancing — Linux >= 3.9 and the BSDs;
+    absent on some platforms, where the fleet uses the fanout front.)"""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    try:
+        with socket.socket() as a, socket.socket() as b:
+            for s in (a, b):
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            a.bind(("127.0.0.1", 0))
+            b.bind(("127.0.0.1", a.getsockname()[1]))
+        return True
+    except OSError:
+        return False
+
+
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that joins an SO_REUSEPORT group before bind,
+    so N replica processes share one listen port and the kernel balances
+    accepted connections across them."""
+
+    def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        ThreadingHTTPServer.server_bind(self)
+
+
 class ServingApp:
     """Registry + batcher + HTTP server, wired together."""
 
@@ -54,7 +96,8 @@ class ServingApp:
                  port: int = 0, max_batch: int = 256,
                  max_delay_ms: float = 2.0, queue_size: int = 512,
                  buckets_spec: str = "", warmup: bool = True,
-                 heartbeat_path: str = ""):
+                 heartbeat_path: str = "", deadline_ms: float = 0.0,
+                 reuse_port: bool = False):
         self.registry = ModelRegistry(model_path, max_batch=max_batch,
                                       buckets_spec=buckets_spec,
                                       warmup=warmup)
@@ -62,11 +105,26 @@ class ServingApp:
                                     max_delay_ms=max_delay_ms,
                                     queue_size=queue_size,
                                     heartbeat_path=heartbeat_path)
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        server_cls = _ReusePortHTTPServer if reuse_port \
+            else ThreadingHTTPServer
+        self._httpd = server_cls((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.app = self          # handler back-pointer
         self._thread: Optional[threading.Thread] = None
         self._draining = False
+        # default per-request budget (ms) when the body carries no
+        # deadline_ms; 0 = unbounded (legacy 30 s future-wait only)
+        self.deadline_ms = float(deadline_ms or 0.0)
+        # fleet-runtime state (set by serving.fleet's replica loop;
+        # standalone servers keep the defaults)
+        self.replica_rank: Optional[int] = None
+        self.generation: Optional[int] = None
+        self.seen_generation: Optional[int] = None
+        self.degraded: Optional[str] = None
+        # fleet replicas route /reload through the shared promotion
+        # pointer so ANY replica's reload is fleet-wide; standalone
+        # servers keep the registry-local swap
+        self.promote_fn = None
         self.t0 = time.time()
 
     @property
@@ -114,13 +172,25 @@ class _Handler(BaseHTTPRequestHandler):
     def app(self) -> ServingApp:
         return self.server.app
 
-    def _send(self, code: int, obj: Dict[str, Any]) -> None:
+    def _send(self, code: int, obj: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _drop_connection(self) -> None:
+        """Chaos ``drop_conn``: reset the client socket mid-request —
+        the transport failure the fanout front must absorb as a retry."""
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.close_connection = True
 
     def _read_json(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -137,9 +207,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):   # noqa: N802 — http.server API
         from .. import telemetry
 
-        if self.path.split("?")[0] == "/health":
+        path = self.path.split("?")[0]
+        try:
+            chaos.request_hook()
+        except chaos.DropConnection:
+            self._drop_connection()
+            return
+        if path == "/health":
             self._send(*self._health())
-        elif self.path.split("?")[0] == "/stats":
+        elif path == "/ready":
+            self._send(*self._ready())
+        elif path == "/stats":
             with telemetry.span("serve/stats"):
                 self._send(200, self._stats())
         else:
@@ -149,11 +227,13 @@ class _Handler(BaseHTTPRequestHandler):
         from .. import telemetry
 
         path = self.path.split("?")[0]
+        headers: Dict[str, str] = {}
         try:
             # the body must be consumed on EVERY branch — HTTP/1.1
             # keep-alive leaves unread bytes in rfile and the next request
             # on the connection would parse mid-body
             body = self._read_json()
+            chaos.request_hook()
             if path == "/predict":
                 with telemetry.span("serve/predict"):
                     code, obj = self._predict(body)
@@ -162,8 +242,15 @@ class _Handler(BaseHTTPRequestHandler):
                     code, obj = self._reload(body)
             else:
                 code, obj = 404, {"error": f"unknown path {self.path!r}"}
+        except chaos.DropConnection:
+            self._drop_connection()
+            return
         except OverloadError as e:
             code, obj = 503, e.payload()
+            # RFC 7231 Retry-After is integer seconds; the structured
+            # body carries the float for backoff-aware clients
+            headers["Retry-After"] = str(
+                max(int(math.ceil(e.retry_after_s)), 0))
         except LightGBMError as e:
             code, obj = 400, {"error": str(e)}
         except CancelledError:
@@ -173,31 +260,63 @@ class _Handler(BaseHTTPRequestHandler):
             code, obj = 503, {"error": "shutting down"}
         except Exception as e:  # noqa: BLE001 — serving must answer
             code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
-        self._send(code, obj)
+        self._send(code, obj, headers or None)
 
     def _predict(self, body):
         app = self.app
         if app.draining:
-            return 503, {"error": "draining"}
+            raise OverloadError(app.batcher.queue_depth(),
+                                app.batcher.queue_size, reason="draining",
+                                retry_after_s=1.0)
         rows = body.get("rows", body.get("row"))
         if rows is None:
             return 400, {"error": 'predict body needs "rows" (matrix) '
                                   'or "row" (vector)'}
         t0 = time.perf_counter()
+        # client budget: body deadline_ms overrides the server default;
+        # <= 0 means "no deadline" either way
+        try:
+            budget_ms = float(body.get("deadline_ms", app.deadline_ms) or 0.0)
+        except (TypeError, ValueError):
+            return 400, {"error": "deadline_ms must be a number"}
+        deadline = t0 + budget_ms / 1e3 if budget_ms > 0 else None
         fut = app.batcher.submit(rows,
                                  raw_score=bool(body.get("raw_score", False)),
-                                 fast=bool(body.get("fast", False)))
-        res = fut.result(timeout=_REQUEST_TIMEOUT_S)
-        return 200, {
+                                 fast=bool(body.get("fast", False)),
+                                 deadline=deadline)
+        wait = _REQUEST_TIMEOUT_S if deadline is None else \
+            max(deadline - time.perf_counter(), 0.0)
+        try:
+            res = fut.result(timeout=wait)
+        except FutureTimeoutError:
+            # the wait itself ran out the budget: report it as the same
+            # structured deadline shed the batcher would have raised
+            fut.cancel()
+            raise DeadlineError(app.batcher.queue_depth(),
+                                app.batcher.queue_size)
+        sha = app.registry.sha_for_version(res.model_version)
+        out = {
             "predictions": _jsonable(res.values),
             "model_version": res.model_version,
+            "model_sha256": sha,
             "batched_rows": res.batched_rows,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
         }
+        if app.replica_rank is not None:
+            out["replica"] = app.replica_rank
+        return 200, out
 
     def _reload(self, body):
         app = self.app
         path = str(body.get("path") or app.registry.current().path)
+        if app.promote_fn is not None:
+            # fleet replica: validate + advance the shared pointer; every
+            # replica (this one included) applies it via its watcher
+            try:
+                return 200, app.promote_fn(path)
+            except LightGBMError as e:
+                return 409, {"error": str(e),
+                             "model_version": app.registry.version}
         try:
             model = app.registry.load(path)
         except LightGBMError as e:
@@ -209,6 +328,9 @@ class _Handler(BaseHTTPRequestHandler):
                      "sha256": model.sha256}
 
     def _health(self):
+        """LIVENESS: is this process worth keeping alive?  Deliberately
+        ignores model/queue state — a draining or degraded replica is
+        still alive; restarting it would lose work for nothing."""
         from ..robustness.heartbeat import heartbeat_age
 
         app = self.app
@@ -227,6 +349,48 @@ class _Handler(BaseHTTPRequestHandler):
                 out["heartbeat_age_s"] = round(age, 3)
         return (200 if alive else 503), out
 
+    def _ready(self):
+        """READINESS: should traffic be routed here right now?  The
+        fanout front and the fleet supervisor key off THIS (not
+        liveness): a replica that is draining, model-less, or whose
+        worker died gets no traffic but is reaped/restarted only on
+        liveness signals.  A degraded replica (rejected promotion
+        candidate) stays ready — it serves its old version — and
+        surfaces the reason here."""
+        from ..robustness.heartbeat import heartbeat_age
+
+        app = self.app
+        b = app.batcher
+        ready = (b.worker_alive and not app.draining
+                 and app.registry.version > 0)
+        out: Dict[str, Any] = {
+            "ready": ready,
+            "queue_depth": b.queue_depth(),
+            "queue_size": b.queue_size,
+            "model_version": app.registry.version,
+            "draining": app.draining,
+        }
+        cur = None
+        try:
+            cur = app.registry.current()
+        except LightGBMError:
+            pass
+        if cur is not None:
+            out["model_sha256"] = cur.sha256
+        if app.replica_rank is not None:
+            out["replica"] = app.replica_rank
+        if app.generation is not None:
+            out["generation"] = app.generation
+        if app.seen_generation is not None:
+            out["seen_generation"] = app.seen_generation
+        if app.degraded:
+            out["degraded"] = app.degraded
+        if b.heartbeat_path:
+            age = heartbeat_age(b.heartbeat_path)
+            if age is not None:
+                out["heartbeat_age_s"] = round(age, 3)
+        return (200 if ready else 503), out
+
     def _stats(self) -> Dict[str, Any]:
         from .. import telemetry
 
@@ -238,6 +402,9 @@ class _Handler(BaseHTTPRequestHandler):
             "served": app.batcher.served,
             "batches": app.batcher.batches,
             "rejected": app.batcher.rejected,
+            "deadline_expired": app.batcher.expired,
+            "degraded": app.degraded,
+            "generation": app.generation,
             "latency": telemetry.quantiles("serve/latency_s"),
             "dispatch": telemetry.quantiles("serve/dispatch_s"),
             "batch_rows": telemetry.quantiles("serve/batch_rows"),
@@ -264,13 +431,21 @@ def serve_from_params(params: Dict[str, Any]) -> ServingApp:
         queue_size=cfg.serve_queue_size,
         buckets_spec=cfg.serve_buckets,
         warmup=cfg.serve_warmup,
-        heartbeat_path=cfg.serve_heartbeat)
+        heartbeat_path=cfg.serve_heartbeat,
+        deadline_ms=cfg.serve_deadline_ms)
 
 
 def run_server(params: Dict[str, Any]) -> int:
-    """Blocking CLI entry: serve until SIGTERM/SIGINT, then drain."""
+    """Blocking CLI entry: serve until SIGTERM/SIGINT, then drain.
+    ``serve_replicas > 1`` dispatches to the fleet supervisor
+    (docs/SERVING.md "Fleet architecture") instead of one in-process
+    server."""
     from .. import telemetry
+    from ..config import Config
 
+    if Config.from_params(params).serve_replicas > 1:
+        from .fleet import run_fleet
+        return run_fleet(params)
     if not telemetry.enabled():
         # serving without its latency histograms is flying blind; the
         # CLI turns the registry on (spans stay off unless trace_out set)

@@ -27,6 +27,11 @@ python -m pytest tests/test_robustness.py -x -q -m 'not slow'
 # regression here flags scoring breakage before the long suites run
 echo "=== stage: serving fast tier ==="
 python -m pytest tests/test_serving.py -x -q -m 'not slow'
+# fleet resilience fast tier: deadline propagation, bounded overload
+# shedding, circuit breaker, replica restart-with-backoff, and the
+# poisoned-candidate fleet-wide reload (docs/SERVING.md fleet section)
+echo "=== stage: serving fleet fast tier ==="
+python -m pytest tests/test_fleet.py -x -q -m 'not slow'
 # distributed fast tier on a 4-device CPU mesh: the reduce-scatter comms
 # path (psum vs reduce_scatter bit-identity, comms-bytes counters,
 # straggler split) runs on every CPU verify at a second device count —
@@ -49,6 +54,17 @@ echo "=== stage: GOSS sampling bench (BENCH_TASK=goss) ==="
 BENCH_TASK=goss \
 BENCH_ROWS="${BENCH_ROWS:-100000}" \
 BENCH_GOSS_ITERS="${BENCH_GOSS_ITERS:-5}" \
+    python bench.py
+# fleet chaos bench: 3 replicas under sustained loopback load while
+# chaos SIGKILLs one and wedges another mid-run, with a mid-chaos
+# fleet-wide /reload — gates on zero non-503 errors, bitwise-exact
+# responses per claimed model sha256, bounded p99, replica restarts,
+# and promotion convergence; writes BENCH_FLEET.json
+echo "=== stage: fleet chaos bench (BENCH_FLEET=1) ==="
+BENCH_FLEET=1 \
+BENCH_FLEET_ROWS="${BENCH_FLEET_ROWS:-20000}" \
+BENCH_FLEET_MODEL_ITERS="${BENCH_FLEET_MODEL_ITERS:-10}" \
+BENCH_FLEET_SECS="${BENCH_FLEET_SECS:-8}" \
     python bench.py
 # native sanitizer tier: builds native/binner.cpp under ASan/UBSan and
 # drives every extern-C entry point (incl. the categorical bitset

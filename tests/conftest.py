@@ -80,3 +80,84 @@ def make_synthetic_ranking(nq=100, docs_per_q=(5, 40), f=10, seed=0):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+
+
+# ---------------------------------------------------------------------------
+# two-process collective capability probe (slow tier)
+# ---------------------------------------------------------------------------
+#
+# The localhost multi-process suites need the jax CPU backend to run
+# cross-process collectives (the gloo implementation; the default CPU
+# client refuses with "Multiprocess computations aren't implemented on
+# the CPU backend", and very old jax lacks the gloo option entirely).
+# Probe it ONCE per session with a minimal 2-process allgather and skip
+# the dependent tests with the root cause in the reason — the slow tier
+# must be green-or-skipped, never red, on hosts without the capability.
+
+_PROBE_CHILD = r"""
+import os, sys
+os.environ.pop("XLA_FLAGS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+try:
+    from jax.extend.backend import clear_backends; clear_backends()
+except Exception:
+    pass
+jax.distributed.initialize(f"localhost:{sys.argv[1]}", num_processes=2,
+                           process_id=int(sys.argv[2]))
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+multihost_utils.process_allgather(jnp.ones((2,)))
+"""
+
+_two_process_probe_result = []   # memo: [error-string-or-None]
+
+
+def two_process_collectives_error():
+    """None when 2-process jax CPU collectives work here; otherwise the
+    root-cause line from the failing probe."""
+    if _two_process_probe_result:
+        return _two_process_probe_result[0]
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [_sys.executable, "-c", _PROBE_CHILD, str(port), str(r)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs, err = [], None
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=180)[0].decode())
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0].decode())
+            err = "2-process collective probe timed out"
+    if err is None and any(p.returncode != 0 for p in procs):
+        tail = next(o for p, o in zip(procs, outs) if p.returncode != 0)
+        lines = [ln.strip() for ln in tail.splitlines() if ln.strip()]
+        root = [ln for ln in lines if "rror" in ln]
+        err = (root or lines or ["probe failed"])[-1]
+    _two_process_probe_result.append(err)
+    return err
+
+
+@pytest.fixture
+def require_two_process_collectives():
+    """Skip (root cause in the reason) when this host's jax CPU backend
+    cannot run cross-process collectives."""
+    err = two_process_collectives_error()
+    if err is not None:
+        pytest.skip("jax CPU backend refuses 2-process collectives on "
+                    f"this host: {err}")

@@ -18,6 +18,8 @@ from lightgbm_tpu.analysis.rules.determinism import DeterminismRule
 from lightgbm_tpu.analysis.rules.host_sync import HostSyncRule
 from lightgbm_tpu.analysis.rules.jit_discipline import JitDisciplineRule
 from lightgbm_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from lightgbm_tpu.analysis.rules.subprocess_discipline import (
+    SubprocessDisciplineRule)
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -196,6 +198,74 @@ def test_lgb007_respects_changed_only_trigger(tmp_path):
         tmp_path, [], changed=["lightgbm_tpu/ops/grow.py"])) == []
 
 
+def run_scoped_snippet(tmp_path, source, rule,
+                       name="lightgbm_tpu/serving/mod.py"):
+    """Like run_snippet but at a nested repo-relative path (LGB008 only
+    applies inside the supervisor directories)."""
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return eng.run_analysis(tmp_path, files=[p], rules=[rule])
+
+
+def test_lgb008_unsupervised_subprocess_trips(tmp_path):
+    src = ("import subprocess\n"
+           "def fire_and_forget(cmd):\n"
+           "    subprocess.run(cmd, check=True)\n"               # line 3
+           "    return subprocess.Popen(cmd)\n"                  # line 4
+           "def bounded(cmd):\n"
+           "    subprocess.run(cmd, timeout=30)\n"               # ok
+           "def polled(cmd):\n"
+           "    p = subprocess.Popen(cmd)\n"                     # ok: polled
+           "    while p.poll() is None:\n"
+           "        pass\n"
+           "def waited(cmd):\n"
+           "    p = subprocess.Popen(cmd)\n"                     # ok: deadline
+           "    p.wait(timeout=10)\n")
+    found = run_scoped_snippet(tmp_path, src, SubprocessDisciplineRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB008", 3), ("LGB008", 4)]
+    assert "timeout" in found[0].message
+    assert "unsupervised" in found[1].message
+
+
+def test_lgb008_class_level_supervision_clean(tmp_path):
+    # the fleet-supervisor shape: _spawn Popens, _supervise polls — the
+    # poll loop lives in ANOTHER method of the same class
+    src = ("import subprocess\n"
+           "class Supervisor:\n"
+           "    def spawn(self, cmd):\n"
+           "        self.proc = subprocess.Popen(cmd)\n"
+           "    def babysit(self):\n"
+           "        while self.proc.poll() is None:\n"
+           "            pass\n")
+    assert run_scoped_snippet(tmp_path, src,
+                              SubprocessDisciplineRule()) == []
+
+
+def test_lgb008_unbounded_wait_not_supervision(tmp_path):
+    # wait() WITHOUT a timeout is exactly the unbounded block the rule
+    # exists to catch — it must not count as supervision
+    src = ("import subprocess\n"
+           "def forever(cmd):\n"
+           "    p = subprocess.Popen(cmd)\n"                     # line 3
+           "    p.wait()\n")
+    found = run_scoped_snippet(tmp_path, src, SubprocessDisciplineRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB008", 3)]
+
+
+def test_lgb008_out_of_scope_dirs_clean(tmp_path):
+    # bench/scripts/tests run subprocesses unbounded by design: a hung
+    # bench is an operator's Ctrl-C, not a production outage
+    src = ("import subprocess\n"
+           "def bench(cmd):\n"
+           "    subprocess.run(cmd, check=True)\n")
+    assert run_scoped_snippet(tmp_path, src, SubprocessDisciplineRule(),
+                              name="bench.py") == []
+    assert run_scoped_snippet(tmp_path, src, SubprocessDisciplineRule(),
+                              name="lightgbm_tpu/ops/mod.py") == []
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: baseline round-trip, stale entries, parse errors
 # ---------------------------------------------------------------------------
@@ -262,12 +332,12 @@ def test_cli_json_output(capsys, monkeypatch):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["findings"] == [] and out["stale_baseline"] == []
-    assert len(out["checked_rules"]) == 7
+    assert len(out["checked_rules"]) == 8
 
 
 def test_cli_list_rules(capsys):
     assert eng.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("LGB001", "LGB002", "LGB003", "LGB004", "LGB005",
-                "LGB006", "LGB007"):
+                "LGB006", "LGB007", "LGB008"):
         assert rid in out

@@ -50,6 +50,10 @@ import os, sys
 os.environ.pop("XLA_FLAGS", None)
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (older jax: option absent)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 try:
     from jax.extend.backend import clear_backends; clear_backends()
 except Exception:
@@ -90,7 +94,7 @@ def _models_structurally_equal(a: str, b: str):
 
 
 @pytest.mark.slow
-def test_two_process_distributed_training(tmp_path):
+def test_two_process_distributed_training(tmp_path, require_two_process_collectives):
     data = str(tmp_path / "train.csv")
     _write_csv(data)
     out = str(tmp_path / "dist_model.txt")
@@ -124,6 +128,10 @@ import os, sys, json
 os.environ.pop("XLA_FLAGS", None)
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (older jax: option absent)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 try:
     from jax.extend.backend import clear_backends; clear_backends()
 except Exception:
@@ -151,7 +159,8 @@ if rank == 0:
 
 
 @pytest.mark.slow
-def test_two_process_valid_early_stopping_matches_single(tmp_path):
+def test_two_process_valid_early_stopping_matches_single(
+        tmp_path, require_two_process_collectives):
     """Rank-aligned validation under distributed loading (reference:
     LoadFromFileAlignWithOtherDataset): early stopping must pick the same
     best_iteration as single-process training on the full files."""
@@ -198,6 +207,10 @@ import os, sys
 os.environ.pop("XLA_FLAGS", None)
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (older jax: option absent)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 try:
     from jax.extend.backend import clear_backends; clear_backends()
 except Exception:
@@ -237,7 +250,8 @@ def _write_ranking_csv(path, nq=120, seed=3):
 
 
 @pytest.mark.slow
-def test_two_process_lambdarank_matches_single(tmp_path):
+def test_two_process_lambdarank_matches_single(
+        tmp_path, require_two_process_collectives):
     """Query-boundary-respecting sharding: lambdarank under multi-process
     tree_learner=data must reproduce single-process training."""
     data = str(tmp_path / "rank.csv")
@@ -294,7 +308,8 @@ def test_shard_loading_skips_blank_and_comment_lines(tmp_path):
 
 
 @pytest.mark.slow
-def test_train_distributed_launcher(tmp_path):
+def test_train_distributed_launcher(tmp_path,
+                                    require_two_process_collectives):
     """lgb.train_distributed — the dask.py `_train` analog (dask.py:124-215):
     spawns local workers, shards the file by rows, trains data-parallel, and
     returns rank 0's Booster with evals_result_ attached. Must reproduce the

@@ -1,0 +1,525 @@
+"""Serving-fleet resilience (docs/SERVING.md "Fleet architecture").
+
+The contract under test:
+
+  * deadline propagation: an expired request is shed at admission AND at
+    the batcher's pre-dispatch check — the device never scores a request
+    whose client gave up;
+  * overload shedding stays BOUNDED under a 10x burst and every shed 503
+    carries ``Retry-After`` + the structured reason;
+  * the circuit breaker walks closed -> open -> half-open -> closed
+    deterministically, and the fanout front routes around a dead
+    replica without surfacing client errors;
+  * the fleet supervisor restarts killed replicas (with backoff) while
+    traffic keeps flowing through the front;
+  * fleet-wide promotion through the shared pointer: a poisoned
+    candidate is rejected by every replica's re-validation — the fleet
+    keeps serving its old version and surfaces degraded state — while a
+    valid candidate converges everywhere, including on replicas
+    restarted mid-promotion.
+"""
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (CircuitBreaker, DeadlineError,
+                                  FanoutFront, MicroBatcher, ModelRegistry,
+                                  OverloadError, ServingApp, ServingFleet,
+                                  reuseport_available)
+from lightgbm_tpu.serving.fleet import (promote_pointer, read_pointer,
+                                        validate_candidate)
+from lightgbm_tpu.serving.front import http_json
+
+
+def _make_data(seed=7, n=400):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    y = ((X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float64)
+    return X, y
+
+
+def _train_to_file(path, seed=3, num_boost_round=4):
+    X, y = _make_data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5, "seed": seed},
+                    lgb.Dataset(X, label=y),
+                    num_boost_round=num_boost_round)
+    bst.save_model(str(path))
+    return X
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    td = tmp_path_factory.mktemp("fleet")
+    pa, pb = td / "model_a.txt", td / "model_b.txt"
+    X = _train_to_file(pa, seed=3)
+    _train_to_file(pb, seed=11, num_boost_round=6)
+    return (str(pa), str(pb), X,
+            lgb.Booster(model_file=str(pa)), lgb.Booster(model_file=str(pb)))
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation (batcher level: never reaches the device)
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_at_admission(served):
+    pa, _, X, _, _ = served
+    b = MicroBatcher(ModelRegistry(pa), max_delay_ms=1.0)
+    with pytest.raises(DeadlineError) as ei:
+        b.submit(X[:2], deadline=time.perf_counter() - 0.01)
+    payload = ei.value.payload()
+    assert payload["error"] == "deadline_expired"
+    assert payload["retry_after_s"] == 0.0
+    assert b.expired == 1 and b.batches == 0
+
+
+def test_deadline_expired_in_queue_never_dispatches(served):
+    """Requests whose budget lapses while queued are expired by the
+    worker WITHOUT a device dispatch (zero batches processed)."""
+    pa, _, X, _, _ = served
+    b = MicroBatcher(ModelRegistry(pa), max_delay_ms=1.0)   # worker OFF
+    futs = [b.submit(X[i:i + 2], deadline=time.perf_counter() + 0.05)
+            for i in range(3)]
+    time.sleep(0.15)          # all three budgets lapse while queued
+    b.start()
+    for f in futs:
+        with pytest.raises(DeadlineError):
+            f.result(timeout=5)
+    b.stop()
+    assert b.batches == 0     # nothing reached the model/device
+    assert b.expired == 3
+
+
+def test_live_deadline_still_served(served):
+    pa, _, X, ref, _ = served
+    b = MicroBatcher(ModelRegistry(pa), max_delay_ms=1.0).start()
+    try:
+        res = b.submit(X[:3], raw_score=True,
+                       deadline=time.perf_counter() + 10.0).result(timeout=5)
+        assert np.array_equal(res.values, ref.predict(X[:3], raw_score=True))
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# overload: bounded queue under burst, structured Retry-After
+# ---------------------------------------------------------------------------
+
+def test_burst_10x_queue_stays_bounded(served):
+    """Satellite regression: a burst of 10x serve_queue_size requests
+    must shed the overflow at the door — the queue depth never exceeds
+    its bound and every rejection is a structured Retry-After 503."""
+    pa, _, X, _, _ = served
+    qs = 16
+    b = MicroBatcher(ModelRegistry(pa), queue_size=qs,
+                     max_delay_ms=1.0)    # worker OFF: pure admission
+    admitted, shed = 0, 0
+    for i in range(10 * qs):
+        try:
+            b.submit(X[:1])
+            admitted += 1
+        except OverloadError as e:
+            shed += 1
+            payload = e.payload()
+            assert payload["error"] == "overload"
+            assert payload["reason"] == "queue_full"
+            assert payload["queue_depth"] <= qs
+            assert payload["retry_after_s"] > 0
+        assert b.queue_depth() <= qs      # bounded THROUGHOUT the burst
+    assert admitted == qs and shed == 9 * qs
+    assert b.rejected == shed
+    b.start()
+    b.stop(drain=True)                    # admitted requests still serve
+    assert b.served == admitted
+
+
+def test_server_retry_after_header_and_ready(served):
+    pa, _, X, _, _ = served
+    app = ServingApp(pa, port=0, max_batch=16, max_delay_ms=1.0).start()
+    try:
+        # readiness: up + model loaded -> 200 with routing fields
+        st, obj, _ = http_json(app.host, app.port, "GET", "/ready",
+                               timeout=5)
+        assert st == 200 and obj["ready"]
+        assert obj["queue_depth"] == 0 and obj["model_version"] == 1
+        assert "model_sha256" in obj
+        # liveness stays its own endpoint
+        st, obj, _ = http_json(app.host, app.port, "GET", "/health",
+                               timeout=5)
+        assert st == 200 and obj["status"] == "ok"
+        # a pre-expired budget is shed with the structured 503 + header
+        st, obj, headers = http_json(
+            app.host, app.port, "POST", "/predict",
+            {"rows": X[:2].tolist(), "deadline_ms": 1e-6}, timeout=5)
+        assert st == 503
+        assert obj["error"] == "deadline_expired"
+        assert "Retry-After" in headers
+    finally:
+        app.shutdown()
+    # draining flips readiness off
+    assert app.draining
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_trip_halfopen_recover():
+    clock = [0.0]
+    br = CircuitBreaker(failures=3, cooldown_s=5.0, clock=lambda: clock[0])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"           # under threshold
+    br.record_failure()                   # 3rd consecutive: trip
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow()
+    clock[0] = 4.9
+    assert not br.allow()                 # still cooling down
+    clock[0] = 5.1
+    assert br.state == "half_open"
+    assert br.allow()                     # ONE probe claims the slot
+    assert not br.allow() and not br.peek()
+    br.record_failure()                   # failed probe: re-open
+    assert br.state == "open" and br.trips == 2
+    clock[0] = 10.3
+    assert br.allow()                     # next probe
+    br.record_success()                   # probe succeeded: close
+    assert br.state == "closed" and br.allow()
+    assert br.describe()["consecutive_failures"] == 0
+
+
+def test_circuit_breaker_success_resets_count():
+    br = CircuitBreaker(failures=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()                   # consecutive means consecutive
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+class _StubFleet:
+    """Just enough fleet for a FanoutFront: a static endpoint table."""
+
+    def __init__(self, eps):
+        self._eps = dict(eps)
+        self.replicas = len(eps)
+
+    def endpoints(self):
+        return dict(self._eps)
+
+    @property
+    def generation(self):
+        return 1
+
+    def describe(self, states=None):
+        return {"stub": True}
+
+
+class _FlakyReplica:
+    """Answers /ready 200 but resets every /predict connection — a
+    replica crashing mid-request, the case readiness polling alone
+    cannot catch (only the breaker can)."""
+
+    def __init__(self):
+        import socket
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"ready": True, "queue_depth": 0,
+                                   "model_version": 1}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        import threading
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_front_routes_around_dead_replica(served):
+    """One live replica + one that resets every /predict: every request
+    lands 200 on the live one; the flaky rank's breaker trips open and
+    stops eating attempts; client-visible errors stay zero."""
+    pa, _, X, ref, _ = served
+    app = ServingApp(pa, port=0, max_batch=16, max_delay_ms=1.0).start()
+    flaky = _FlakyReplica()
+    fleet = _StubFleet({0: {"host": "127.0.0.1", "port": flaky.port},
+                        1: {"host": app.host, "port": app.port}})
+    front = FanoutFront(fleet, port=0, retries=2, retry_backoff_ms=1.0,
+                        breaker_failures=2, breaker_cooldown_s=30.0,
+                        deadline_ms=5000.0).start()
+    try:
+        want = ref.predict(X[:2], raw_score=True)
+        oks = 0
+        for _ in range(12):
+            st, obj, _ = http_json(front.host, front.port, "POST",
+                                   "/predict",
+                                   {"rows": X[:2].tolist(),
+                                    "raw_score": True}, timeout=10)
+            assert st == 200, obj
+            assert np.array_equal(np.asarray(obj["predictions"]), want)
+            oks += 1
+        assert oks == 12
+        # the dead rank's breaker tripped and now pre-filters it
+        assert front.breaker(0).state == "open"
+        assert front.breaker(0).trips >= 1
+        assert front.breaker(1).state == "closed"
+        st, obj, _ = http_json(front.host, front.port, "GET", "/stats",
+                               timeout=5)
+        assert obj["forwarded"] == 12
+        assert obj["breakers"]["0"]["state"] == "open"
+    finally:
+        front.stop()
+        flaky.stop()
+        app.shutdown()
+
+
+def test_front_sheds_when_no_replica_ready(served):
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    front = FanoutFront(
+        _StubFleet({0: {"host": "127.0.0.1", "port": dead_port}}),
+        port=0, retries=1, retry_backoff_ms=1.0, breaker_failures=1,
+        breaker_cooldown_s=30.0, deadline_ms=2000.0).start()
+    try:
+        st, obj, headers = http_json(front.host, front.port, "POST",
+                                     "/predict", {"rows": [[0.0] * 6]},
+                                     timeout=10)
+        assert st == 503
+        assert obj["error"] == "overload"
+        assert "Retry-After" in headers
+        assert front.shed >= 1
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# promotion pointer mechanics (no processes)
+# ---------------------------------------------------------------------------
+
+def test_validate_candidate_rejects_truncation(served, tmp_path):
+    pa, _, _, _, _ = served
+    text = open(pa).read()
+    bad = tmp_path / "trunc.txt"
+    bad.write_text(text[:len(text) // 2])
+    with pytest.raises(lgb.LightGBMError, match="truncated"):
+        validate_candidate(str(bad))
+    with pytest.raises(lgb.LightGBMError, match="cannot read"):
+        validate_candidate(str(tmp_path / "missing.txt"))
+
+
+def test_promote_pointer_generations(served, tmp_path):
+    pa, pb, _, _, _ = served
+    d = str(tmp_path)
+    p1 = promote_pointer(d, pa)
+    assert p1["generation"] == 1
+    p2 = promote_pointer(d, pb)
+    assert p2["generation"] == 2
+    assert read_pointer(d)["path"] == pb
+    # a poisoned candidate never touches the pointer
+    bad = tmp_path / "bad.txt"
+    bad.write_text(open(pa).read()[:100])
+    with pytest.raises(lgb.LightGBMError):
+        promote_pointer(d, str(bad))
+    assert read_pointer(d)["generation"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the real fleet: restart-with-backoff + fleet-wide reload (subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_fleet_restart_reload_and_poisoned_candidate(served, tmp_path):
+    pa, pb, X, ref_a, ref_b = served
+    oracle = {}
+    for path, ref in ((pa, ref_a), (pb, ref_b)):
+        sha = validate_candidate(path)
+        oracle[sha] = ref.predict(X[:64], raw_score=True)
+    fleet = ServingFleet(pa, replicas=2, max_batch=16, buckets_spec="16",
+                         max_delay_ms=1.0, deadline_ms=5000.0, retries=2,
+                         retry_backoff_ms=5.0, breaker_failures=3,
+                         breaker_cooldown_s=0.5, restart_backoff_s=0.2,
+                         hang_timeout_s=10.0).start()
+    try:
+        def predict(n=3, timeout=10):
+            return http_json(fleet.host, fleet.port, "POST", "/predict",
+                             {"rows": X[:n].tolist(), "raw_score": True,
+                              "deadline_ms": 4000}, timeout=timeout)
+
+        # ---- baseline: exact + sha-stamped
+        st, obj, _ = predict()
+        assert st == 200, obj
+        assert np.array_equal(np.asarray(obj["predictions"]),
+                              oracle[obj["model_sha256"]][:3])
+
+        # ---- kill replica 0: traffic keeps flowing (retry/breaker),
+        # the supervisor restarts it with backoff
+        os.kill(fleet.endpoint(0)["pid"], signal.SIGKILL)
+        t0 = time.time()
+        while time.time() - t0 < 3:
+            st, obj, _ = predict(n=2, timeout=8)
+            assert st in (200, 503), obj      # zero non-503 errors
+            if st == 200:
+                assert np.array_equal(np.asarray(obj["predictions"]),
+                                      oracle[obj["model_sha256"]][:2])
+            time.sleep(0.02)
+
+        def wait_restarted(deadline_s=30):
+            t0 = time.time()
+            while time.time() - t0 < deadline_s:
+                d = fleet.describe()
+                r0 = next(r for r in d["replicas"] if r["rank"] == 0)
+                if r0["reachable"] and r0.get("ready"):
+                    return d
+                time.sleep(0.2)
+            raise AssertionError(f"replica 0 never came back: {d}")
+
+        d = wait_restarted()
+        assert d["restarts_total"] >= 1
+        assert next(r for r in d["replicas"]
+                    if r["rank"] == 0)["restarts"] >= 1
+
+        # ---- fleet-wide reload through the front: both replicas land
+        # on the new generation and serve model B
+        st, obj, _ = http_json(fleet.host, fleet.port, "POST", "/reload",
+                               {"path": pb}, timeout=60)
+        assert st == 200, obj
+        assert sorted(obj["promoted"]) == [0, 1]
+        assert obj["rejected"] == {}
+        gen_b = obj["generation"]
+        sha_b = obj["sha256"]
+        st, obj, _ = predict()
+        assert st == 200 and obj["model_sha256"] == sha_b
+        assert np.array_equal(np.asarray(obj["predictions"]),
+                              oracle[sha_b][:3])
+
+        # ---- poisoned candidate: passes the pointer (written directly,
+        # as an external deploy tool could) but fails every replica's
+        # re-validation -> fleet stays on B, degraded state surfaces
+        poisoned = tmp_path / "poisoned.txt"
+        poisoned.write_text(open(pa).read())
+        sha_ok = validate_candidate(str(poisoned))
+        from lightgbm_tpu.serving.fleet import write_pointer
+        write_pointer(fleet.dir, str(poisoned), sha_ok, gen_b + 1)
+        poisoned.write_text(open(pa).read() + "# tampered\n")   # sha drifts
+        t0 = time.time()
+        while time.time() - t0 < 20:
+            d = fleet.describe()
+            degraded = [r for r in d["replicas"] if r.get("degraded")]
+            if len(degraded) == 2:
+                break
+            time.sleep(0.2)
+        assert len(degraded) == 2, d
+        assert all("rejected" in r["degraded"] for r in degraded)
+        assert all(r.get("generation") == gen_b for r in d["replicas"])
+        st, obj, _ = predict()            # still serving B, bit-exact
+        assert st == 200 and obj["model_sha256"] == sha_b
+        assert np.array_equal(np.asarray(obj["predictions"]),
+                              oracle[sha_b][:3])
+        # front /ready surfaces the degraded ranks + breaker states
+        # (its readiness cache refreshes every ~0.5 s — poll, don't race)
+        t0 = time.time()
+        while time.time() - t0 < 10:
+            st, obj, _ = http_json(fleet.host, fleet.port, "GET",
+                                   "/ready", timeout=5)
+            assert st == 200 and obj["ready"]
+            if all(r.get("degraded") for r in obj["replicas"]):
+                break
+            time.sleep(0.2)
+        assert all(r.get("degraded") for r in obj["replicas"]), obj
+        assert all(r["breaker"] in ("closed", "open", "half_open")
+                   for r in obj["replicas"])
+
+        # ---- restart UNDER the poisoned pointer: the rebooted replica
+        # must re-validate at boot (not serve the tampered bytes) and
+        # wait for a valid promotion instead of crash-looping
+        os.kill(fleet.endpoint(1)["pid"], signal.SIGKILL)
+        time.sleep(1.0)           # replica 1 is now booting, pointer bad
+
+        # ---- a good promotion clears degraded everywhere, including
+        # the replica that rebooted while the pointer was poisoned
+        st, obj, _ = http_json(fleet.host, fleet.port, "POST", "/reload",
+                               {"path": pa}, timeout=60)
+        assert st == 200 and 0 in obj["promoted"], obj
+        sha_a = obj["sha256"]
+        t0 = time.time()
+        while time.time() - t0 < 40:
+            d = fleet.describe()
+            if (all(r["reachable"] and not r.get("degraded")
+                    and r.get("model_sha256") == sha_a
+                    for r in d["replicas"])):
+                break
+            time.sleep(0.3)
+        assert all(r["reachable"] and not r.get("degraded")
+                   and r.get("model_sha256") == sha_a
+                   for r in d["replicas"]), d
+        st, obj, _ = predict()
+        assert st == 200 and obj["model_sha256"] == sha_a
+        assert np.array_equal(np.asarray(obj["predictions"]),
+                              oracle[sha_a][:3])
+    finally:
+        fleet.stop()
+    assert not os.path.isdir(fleet.dir)   # owned tmpdir cleaned up
+
+
+@pytest.mark.skipif(not reuseport_available(),
+                    reason="SO_REUSEPORT unavailable on this platform")
+def test_reuseport_two_servers_share_port(served):
+    pa, _, X, ref, _ = served
+    a = ServingApp(pa, port=0, max_batch=8, max_delay_ms=1.0,
+                   reuse_port=True).start()
+    b = ServingApp(pa, port=a.port, max_batch=8, max_delay_ms=1.0,
+                   reuse_port=True).start()
+    try:
+        assert a.port == b.port
+        want = ref.predict(X[:2], raw_score=True)
+        for _ in range(6):   # kernel picks a listener per connection
+            st, obj, _ = http_json(a.host, a.port, "POST", "/predict",
+                                   {"rows": X[:2].tolist(),
+                                    "raw_score": True}, timeout=10)
+            assert st == 200
+            assert np.array_equal(np.asarray(obj["predictions"]), want)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_fleet_rejects_bad_config(served):
+    pa, _, _, _, _ = served
+    with pytest.raises(lgb.LightGBMError, match="serve_replicas"):
+        ServingFleet(pa, replicas=0)
+    with pytest.raises(lgb.LightGBMError, match="serve_fleet_mode"):
+        ServingFleet(pa, replicas=1, mode="carrier_pigeon")

@@ -48,7 +48,8 @@ def _free_ports(k):
 
 
 @pytest.mark.slow
-def test_two_machine_cli_matches_single(tmp_path):
+def test_two_machine_cli_matches_single(tmp_path,
+                                        require_two_process_collectives):
     rng = np.random.RandomState(0)
     n = 3000
     X = rng.randn(n, 5)

@@ -335,7 +335,8 @@ def test_cli_kill_then_resume_bit_identity(tmp_path):
 
 
 @pytest.mark.slow
-def test_dist_failfast_on_worker_crash(tmp_path, monkeypatch):
+def test_dist_failfast_on_worker_crash(tmp_path, monkeypatch,
+                                       require_two_process_collectives):
     """Regression for the sequential rank-order await: a crashed rank 1
     must fail the run immediately, not after rank 0's full timeout."""
     csv = _write_csv(tmp_path, n=1200)
@@ -349,7 +350,8 @@ def test_dist_failfast_on_worker_crash(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
-def test_dist_kill_retry_resume_bit_identity(tmp_path, monkeypatch):
+def test_dist_kill_retry_resume_bit_identity(
+        tmp_path, monkeypatch, require_two_process_collectives):
     csv = _write_csv(tmp_path, n=1200)
     params = {"objective": "binary", "verbosity": -1}
     clean = lgb.train_distributed(dict(params), str(csv), num_boost_round=6,
@@ -367,7 +369,8 @@ def test_dist_kill_retry_resume_bit_identity(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
-def test_dist_hang_detector_fires_and_recovers(tmp_path, monkeypatch):
+def test_dist_hang_detector_fires_and_recovers(
+        tmp_path, monkeypatch, require_two_process_collectives):
     csv = _write_csv(tmp_path, n=1000)
     marker = tmp_path / "hang.marker"
     monkeypatch.setenv(chaos.ENV_VAR, f"hang:iter=3,rank=1,once={marker}")
