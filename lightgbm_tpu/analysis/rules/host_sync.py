@@ -30,6 +30,17 @@ NP_CONVERTER_ATTRS = {"asarray", "array", "ascontiguousarray",
                       "float64", "float32", "int32", "int64"}
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
 
+# The GBDT ITERATION LOOP (docs/DISTRIBUTED.md "readback policy"): these
+# engine functions run once per boosting iteration on the host side of
+# the fused pipeline, where every device->host transfer — jax.device_get,
+# .block_until_ready(), np.asarray on sharded state — stalls the
+# one-launch-per-iteration pipeline for a full round trip.  Reads belong
+# in the batched once-per-eval_fetch_freq fetch (_poll_device_flags);
+# that single sanctioned site is pinned in the baseline with its reason.
+ITER_LOOP_FUNCS = {"train_one_iter", "_train_one_iter_impl", "_iter_fused",
+                   "_poll_device_flags", "_row_compaction_capacity",
+                   "_fused_compact_rows"}
+
 
 def _names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
@@ -55,6 +66,10 @@ class HostSyncRule(Rule):
 
     def check_module(self, module) -> Iterable:
         m = module.model
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, FuncDef) and fn.name in ITER_LOOP_FUNCS \
+                    and fn not in m.jit_functions:
+                yield from self._check_iteration_fn(module, fn)
         taint_of: Dict[ast.AST, Set[str]] = {}
         # outer-first so nested closures inherit the enclosing taint —
         # ast.walk yields parents before their children
@@ -116,3 +131,38 @@ class HostSyncRule(Rule):
                 f"{what} on a traced value inside jitted function "
                 f"{fn.name!r} forces a host sync (or fails to trace)",
                 self.hint)
+
+    def _check_iteration_fn(self, module, fn) -> Iterable:
+        """Blocking device->host reads inside the GBDT iteration loop —
+        each one stalls the one-launch-per-iteration pipeline; reads
+        belong in the batched _poll_device_flags fetch (that sanctioned
+        site itself is pinned in the baseline with a reason)."""
+        m = module.model
+        iter_hint = ("move the read into the batched "
+                     "once-per-eval_fetch_freq fetch "
+                     "(_poll_device_flags) or off the iteration path")
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            if m.enclosing_function(call) is not fn:
+                continue   # nested jit bodies are checked by the jit scan
+            f = call.func
+            what = None
+            if isinstance(f, ast.Attribute) and f.attr == "device_get" \
+                    and m.resolves_to_module(f, "jax"):
+                what = "jax.device_get()"
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "block_until_ready" and not call.args:
+                what = ".block_until_ready()"
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in NP_CONVERTER_ATTRS and call.args \
+                    and m.resolves_to_module(f, "numpy") \
+                    and not _is_static_expr(call.args[0]):
+                what = f"np.{f.attr}()"
+            if what is None:
+                continue
+            yield module.finding(
+                self.rule_id, call,
+                f"{what} inside iteration-loop function {fn.name!r} "
+                "blocks the host on the device pipeline every boosting "
+                "iteration", iter_hint)

@@ -180,7 +180,10 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "mesh_shape": [],            # e.g. "data:8" or "data:4,feature:2"
     "hist_comms": ["histogram_comms"],        # psum | reduce_scatter
     "hist_comms_dtype": ["histogram_comms_dtype"],  # f32 | bf16_pair
+    "hist_comms_pipeline": ["histogram_comms_pipeline"],  # scatter chunks
     "row_compaction": ["sample_compaction"],  # auto | off | pad
+    "fused_iter": ["fused_iteration"],        # auto | on | off
+    "eval_fetch_freq": ["fetch_freq", "flag_poll_freq"],
     "tpu_dtype": [],             # f32 | bf16 accumulate dtype for histograms
     # --- robustness (docs/ROBUSTNESS.md) ---
     "nan_guard": ["nan_policy"],
@@ -489,6 +492,33 @@ class Config:
     # and the cross-device accumulation runs in f32. Halves the wire
     # payload; opt-in (not bit-identical to psum).
     hist_comms_dtype: str = "f32"
+    # double-buffered reduce_scatter (docs/DISTRIBUTED.md "fused
+    # iteration"): the per-round histogram psum_scatter is issued as this
+    # many independent chunks along the slot/class axis so the XLA
+    # scheduler overlaps one chunk's wire time against the next chunk's
+    # packing/copy compute. Every element rides the same rank-ordered
+    # reduction, so any value is BITWISE identical to 1; 0 = auto (2 in
+    # reduce_scatter mode, 1 under psum; the bf16_pair wire pipelines
+    # through its all_to_all instead, so the knob resolves to 1 there).
+    # LGBTPU_HIST_COMMS_PIPELINE overrides for A/B experiments.
+    hist_comms_pipeline: int = 0
+    # whole-iteration fusion (docs/DISTRIBUTED.md "fused iteration &
+    # sharded state"): gradients -> sampling -> tree growth -> score
+    # update as ONE compiled launch per boosting iteration, with every
+    # row-indexed array held permanently device-sharded across iterations
+    # (explicit out-sharding == in-sharding, no host round trips on the
+    # critical path). auto = on for single-chip TPU and for any
+    # row-sharded stream mesh (single-chip CPU keeps the unfused path —
+    # XLA:CPU re-fuses the gradient chain with last-ulp differences,
+    # which would break the serial byte-identity suite); on/off force.
+    # LGBTPU_FUSE_ITER=1/0 overrides for A/B experiments.
+    fused_iter: str = "auto"
+    # batched device-flag fetch cadence (iterations): the fused path
+    # reads the finished flag, nan_guard flag, and sampled-row counters
+    # in ONE device_get every this-many iterations instead of per-iter
+    # blocking reads. 0 = auto (16 on TPU or under a fused mesh, 1
+    # otherwise — matching the legacy finished-poll cadence).
+    eval_fetch_freq: int = 0
     # GOSS/bagging row compaction (docs/PERF.md "sample-strategy
     # speedups"): auto = when a sampling mask is sparse enough, one
     # stable partition per tree compacts the in-bag rows so histogram
@@ -629,6 +659,18 @@ class Config:
             raise LightGBMError(
                 f"row_compaction={self.row_compaction!r} is not one of "
                 "'auto', 'off', 'pad'")
+        if str(self.fused_iter).strip().lower() not in ("auto", "on", "off"):
+            raise LightGBMError(
+                f"fused_iter={self.fused_iter!r} is not one of "
+                "'auto', 'on', 'off'")
+        if self.eval_fetch_freq < 0:
+            raise LightGBMError(
+                f"eval_fetch_freq={self.eval_fetch_freq} must be >= 0 "
+                "(0 = auto)")
+        if self.hist_comms_pipeline < 0:
+            raise LightGBMError(
+                f"hist_comms_pipeline={self.hist_comms_pipeline} must be "
+                ">= 0 (0 = auto)")
         # GOSS parameter conflicts (reference: Config::CheckParamConflict,
         # src/io/config.cpp — "cannot use bagging in GOSS" and the sampled
         # fractions must partition the data)

@@ -319,8 +319,25 @@ class GBDT:
             self._voting = True
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
-        self._finished_check_every = (
-            16 if jax.default_backend() in ("tpu", "axon") else 1)
+        # fused-sharded iteration state (docs/DISTRIBUTED.md "fused
+        # iteration & sharded state")
+        self._train_state = None
+        self._fused_last = False
+        self._compact_overflow = False
+        self._overflow_seen = 0
+        # batched device-flag fetch cadence: eval_fetch_freq, or auto —
+        # 16 wherever the fused one-launch path is the default (TPU, any
+        # row-sharded stream mesh: each blocking flag read costs a full
+        # pipeline stall there), 1 on the eager CPU paths (a sync is
+        # free when every op already runs synchronously)
+        eff = int(config.eval_fetch_freq or 0)
+        if eff > 0:
+            self._finished_check_every = eff
+        elif jax.default_backend() in ("tpu", "axon") \
+                or self._can_fuse_iteration():
+            self._finished_check_every = 16
+        else:
+            self._finished_check_every = 1
         # Pallas leaf-value gather: single-device TPU only (a mesh shards the
         # row axis; XLA partitions the plain gather there instead). The
         # kernel holds an (L, T) one-hot in VMEM, so bound L like the stream
@@ -342,6 +359,10 @@ class GBDT:
         # slow device in the skew report)
         self._tel_iter_times: List[float] = []
         self._tel_comms_waits: List[float] = []
+        self._tel_launches: List[int] = []
+        self._tel_syncs: List[int] = []
+        from ..telemetry import host_sync_count as _hsc, launch_count as _lc
+        self._tel_disp0 = (_lc(), _hsc())
         self._comms_model_cache: Optional[Dict[str, Any]] = None
         cmdl = self._comms_model()
         if cmdl is not None:
@@ -372,6 +393,8 @@ class GBDT:
         with global_timer.scope("GBDT::FinalizeTrees"), \
                 _tel_tracer.span("GBDT::FinalizeTrees", trees=len(pending)):
             got = jax.device_get([e["arrays"] for e in pending])
+        from ..telemetry import note_host_sync
+        note_host_sync()
         mappers = self.train_data.bin_mappers()
         for e, arrays in zip(pending, got):
             tree = finalize_tree(arrays, mappers, None, learning_rate=e["rate"])
@@ -447,6 +470,8 @@ class GBDT:
                     _tel_tracer.span("GBDT::SampleCount"):
                 counts = np.asarray(jax.device_get(
                     (mask > 0).reshape(D, local).sum(axis=1)))
+            from ..telemetry import note_host_sync
+            note_host_sync()
             self._sample_count_cache = (ck, counts)
         self._last_sampled_rows = int(counts.sum())
         if not eligible:
@@ -718,7 +743,22 @@ class GBDT:
             cegb_penalty_split=c.cegb_penalty_split,
         )
         mode, cdtype = self._resolve_hist_comms(gp)
-        return gp._replace(hist_comms=mode, hist_comms_dtype=cdtype)
+        # double-buffered scatter (parallel/comms.reduce_hist): bitwise
+        # identical at any chunk count, so auto (0) defaults to 2 whenever
+        # the exact psum_scatter wire engages — the collective for one
+        # slot chunk overlaps the next chunk's packing/copy compute.  The
+        # bf16_pair wire pipelines through its all_to_all instead, so the
+        # chunk knob resolves to 1 there rather than dangling unused.
+        import os as _os
+        env = _os.environ.get("LGBTPU_HIST_COMMS_PIPELINE", "")
+        pipe = int(env) if env else int(c.hist_comms_pipeline or 0)
+        if cdtype == "bf16_pair" and not gp.int_hist \
+                and mode == "reduce_scatter":
+            pipe = 1
+        elif pipe <= 0:
+            pipe = 2 if mode == "reduce_scatter" else 1
+        return gp._replace(hist_comms=mode, hist_comms_dtype=cdtype,
+                           hist_comms_chunks=pipe)
 
     def _resolve_hist_comms(self, gp: GrowParams) -> Tuple[str, str]:
         """Data-parallel histogram collective (docs/DISTRIBUTED.md).
@@ -979,6 +1019,8 @@ class GBDT:
         their per-rank shards (rank-major row order) to every host so
         metrics — and therefore early stopping — agree on all ranks
         (reference: metrics Allreduce their sums, e.g. Network::GlobalSum)."""
+        from ..telemetry import note_host_sync
+        note_host_sync()
         if not getattr(self, "_dist_mode", False):
             return np.asarray(score[:n])
         from jax.experimental import multihost_utils
@@ -1037,8 +1079,15 @@ class GBDT:
                 setattr(self.objective, a, jnp.where(ok, new, old))
 
     def flush_nan_guard(self) -> None:
-        """Resolve any deferred nan_guard flags (called at end of train())."""
-        self._nan_guard.poll()
+        """Resolve any deferred device flags (called at end of train()):
+        the nan_guard backlog plus — on the fused-sharded path — the
+        batched sampled-rows / overflow / finished fetch, so host-visible
+        telemetry is final when train() returns."""
+        if getattr(self, "_train_state", None) is not None \
+                and self._fused_last:
+            self._poll_device_flags()
+        else:
+            self._nan_guard.poll()
 
     @property
     def nan_iterations(self) -> int:
@@ -1062,6 +1111,11 @@ class GBDT:
                 hess = hess.astype(jnp.float32)
         else:
             grad, hess = self.objective.get_gradients(self._unpad_score())
+        # eager-chain dispatch accounting (telemetry launches counter):
+        # slice + grad + hess + pads is a LOWER bound — each eager jnp op
+        # is its own XLA execution and real objectives run ~10
+        from ..telemetry import note_launch
+        note_launch(4)
         return self._pad_gh(grad), self._pad_gh(hess)
 
     def _unpad_score(self):
@@ -1084,14 +1138,16 @@ class GBDT:
             # through the jit as argument + output so the trace stays pure
             self._grad_state_names = list(objective.state_attrs())
 
-    def _gradient_graph(self, score, bound, pad_mask, qkey):
+    def _gradient_graph(self, score, bound, pad_mask, qkey, quantize=True):
         """Traced gradient chain shared by the fused-gradient and
         fused-iteration jits: rebinds the objective's captured arrays from
         `bound`, evaluates gradients (in double under hist_precision=double
         — the reference's score_t arithmetic), pads/masks, optionally
-        quantizes. Returns (g, h, gq, hq, scales_or_None, new_state)."""
+        quantizes (``quantize=False`` defers it — the fused sampled path
+        must scale gradients BEFORE the quantization grid, matching the
+        eager order). Returns (g, h, gq, hq, scales_or_None, new_state)."""
         objective, num_data = self.objective, self.num_data
-        quant = self.config.use_quantized_grad
+        quant = self.config.use_quantized_grad and quantize
         qbins = self.config.num_grad_quant_bins
         qstoch = self.config.stochastic_rounding
         double = self._grow_params.hist_double
@@ -1264,89 +1320,339 @@ class GBDT:
                 for kk in range(k)]
 
     def _can_fuse_iteration(self) -> bool:
-        """Whole-iteration fusion (gradients -> grow -> score update as ONE
-        launch): k=1, no host-synced leaf work, no per-tree feature-usage
-        carry."""
+        """Whole-iteration fusion (gradients -> sampling -> grow -> score
+        update as ONE launch per iteration, docs/DISTRIBUTED.md "fused
+        iteration & sharded state").
+
+        Default ON for single-chip TPU (the launch count win through the
+        tunnel) and for ANY row-sharded stream mesh — under a mesh every
+        extra dispatch pays per-device coordination on top of the fixed
+        launch latency, exactly the regime docs/PERF.md:290-296 predicted
+        would dominate after the comms payload fix.  Single-chip CPU
+        keeps the unfused path (XLA:CPU re-fuses the gradient chain with
+        last-ulp differences, which would break the serial byte-identity
+        suite).  config ``fused_iter=on|off`` and ``LGBTPU_FUSE_ITER=1/0``
+        force the choice (A/B experiments, tests)."""
         c = self.config
-        # TPU only: the win is launch count (~3x fewer dispatches through
-        # the tunnel); on CPU the wider fused program lets XLA re-fuse the
-        # gradient chain with last-ulp differences, which would break the
-        # serial-vs-distributed byte-identical property the tests assert.
-        # LGBTPU_FUSE_ITER=1/0 forces the choice (tests, experiments)
         import os as _os
         force = _os.environ.get("LGBTPU_FUSE_ITER", "")
-        if force == "0":
+        mode = str(c.fused_iter).strip().lower()
+        if force == "0" or (mode == "off" and force != "1"):
             return False
-        return ((force == "1" or jax.default_backend() in ("tpu", "axon"))
-                and self.num_tree_per_iteration == 1
-                and not _chaos.has("nan_grad")   # chaos injects eagerly
+        base = (not _chaos.has("nan_grad")   # chaos injects eagerly
                 and not c.linear_tree
                 and not self._voting
                 and self._cegb_used is None
+                and not self._dist_mode     # multi-process keeps the
+                                            # eager path (rank-local numpy
+                                            # rebinds, barrier telemetry)
                 and self.objective is not None
+                and self.objective.jit_safe_gradients
                 and not self.objective.need_renew_leaf
                 and not (c.use_quantized_grad and c.quant_train_renew_leaf))
+        if not base:
+            return False
+        if self.num_tree_per_iteration > 1 \
+                and not self._use_batched_multiclass():
+            return False   # the per-class scan stays on the eager path
+        return (force == "1" or mode == "on"
+                or jax.default_backend() in ("tpu", "axon")
+                or (self.mesh is not None and self._mesh_stream))
+
+    # ------------------------------------------------------------------
+    def _shard_leaf_array(self, a):
+        """Place a (K, N) class-major leaf-id array on the mesh (rows are
+        the LAST axis, unlike _shard_row_array's (N, K) scores)."""
+        if self._row_sharding is None or a.ndim == 1:
+            return self._shard_row_array(a)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(
+            a, NamedSharding(self._row_sharding.mesh,
+                             P(None, self._row_sharding.spec[0])))
+
+    def _ensure_train_state(self):
+        """The ShardedTrainState this run's fused iterations thread.
+
+        Rebuilt whenever ``self.score`` was reassigned outside the fused
+        step (checkpoint restore, rollback, DART/RF score juggling) —
+        the identity check makes external score surgery safe without any
+        explicit invalidation protocol."""
+        from ..parallel.sharded_state import ShardedTrainState
+        st = getattr(self, "_train_state", None)
+        if st is not None and st.score is self.score:
+            return st
+        k = self.num_tree_per_iteration
+        n = self.dd.bins.shape[0]
+        zs = self._shard_row_array(jnp.zeros_like(self.score))
+        lid = self._shard_leaf_array(
+            jnp.zeros(n if k == 1 else (k, n), jnp.int32))
+        st = ShardedTrainState(
+            score=self.score, grad=zs, hess=zs, leaf_id=lid,
+            mask=self._pad_mask,
+            key=jax.random.PRNGKey(0),
+            sampled=jnp.asarray(0, jnp.int32),
+            overflow=jnp.asarray(0, jnp.int32),
+            finished=jnp.asarray(False),
+            ok=jnp.asarray(True))
+        self._train_state = st
+        self._overflow_seen = 0
+        return st
+
+    def _fused_compact_rows(self, sample_mode: str, mask_arg=None) -> int:
+        """Static per-shard compaction capacity for the fused path.
+
+        Bagging reuses the eager per-epoch count readback (the mask is
+        epoch-cached host-side, so the sync amortizes over bagging_freq
+        iterations).  GOSS draws a fresh in-jit mask every iteration, so
+        the capacity is ANALYTIC — expected in-bag fraction plus a
+        binomial + top-skew margin — and the fused program counts
+        overflows into the state so the batched poll can disable
+        compaction and warn if the margin is ever breached (out-of-bag
+        pad rows carry exact-zero weights, so any covering capacity grows
+        the identical tree)."""
+        if sample_mode == "none" or getattr(self, "_compact_overflow", False):
+            return 0
+        import os as _os
+        cmode = str(_os.environ.get("LGBTPU_COMPACT", "")
+                    or self.config.row_compaction).strip().lower()
+        if cmode not in ("auto", "off", "pad"):
+            # same contract as the eager path: an LGBTPU_COMPACT typo must
+            # not silently run as "auto" (or silently disable compaction)
+            raise LightGBMError(
+                f"LGBTPU_COMPACT={cmode!r} is not one of 'auto', 'off', "
+                "'pad'")
+        gp = self._grow_params
+        eligible = (cmode in ("auto", "pad")
+                    and gp.hist_backend in ("stream", "segsum", "onehot")
+                    and not self._voting
+                    and (self.mesh is None or self._mesh_stream))
+        if not eligible:
+            return 0
+        n_rows = self.dd.bins.shape[0]
+        D = 1
+        if self._mesh_stream and self._row_axis is not None:
+            D = int(self.mesh.shape[self._row_axis])
+        local = n_rows // D
+        unit = self._pack_block
+        if cmode == "pad":
+            return -(-local // unit) * unit
+        if sample_mode == "bagging":
+            # identical capacity rule to the eager path — the per-epoch
+            # mask is host-known (built once per iteration by _iter_fused,
+            # passed in here) and its count readback is cached
+            return self._row_compaction_capacity(mask_arg * self._pad_mask)
+        frac = self.sample_strategy.expected_fraction(self.iter_)
+        exp = frac * local
+        # top-a rows are chosen by a GLOBAL threshold, so a shard may hold
+        # more than its share; 25% relative headroom plus six binomial
+        # sigma covers both the b-sample jitter and moderate top skew —
+        # a breach only costs a warning + fallback, never a wrong tree
+        # left unflagged (the poll checks state.overflow)
+        sigma = float(np.sqrt(max(local * frac * (1.0 - frac), 1.0)))
+        q = max(unit, -(-local // (32 * unit)) * unit)
+        cap = -(-int(1.25 * exp + 6.0 * sigma) // q) * q
+        cap = max(unit, cap)
+        if cap * 4 >= local * 3 or cap >= local:
+            return 0   # <25% savings: the partition + route pass would eat it
+        if not (self._compact_cap and cap <= self._compact_cap < local):
+            self._compact_cap = cap
+        return self._compact_cap
 
     def _iter_fused(self):
-        """gradients + tree growth + train-score update as ONE compiled
-        program — each separate launch costs fixed dispatch latency on a
-        tunneled TPU, and the fast path needs only one."""
+        """Gradients + sampling + tree growth + train-score update as ONE
+        compiled launch per boosting iteration, with the training state
+        held permanently device-sharded (ShardedTrainState; out-sharding
+        == in-sharding so no implicit re-shard or host round trip ever
+        touches a row-axis array between iterations).  Returns the new
+        state and the stacked TreeArrays."""
+        k = self.num_tree_per_iteration
+        strategy = self.sample_strategy
+        mode = ("none" if not strategy.is_active()
+                else strategy.fused_mode(self.iter_))
+        if mode not in ("none", "mask_arg", "traced"):
+            raise LightGBMError(
+                f"unknown fused sample mode {mode!r} from "
+                f"{type(strategy).__name__}")
+        # static program variants: "bagging" takes the epoch mask as an
+        # argument, "goss" derives its mask in-trace from the gradients
+        sample_mode = {"mask_arg": "bagging", "traced": "goss"}[mode] \
+            if mode != "none" else "none"
+        mask_arg = self._pad_mask
+        if sample_mode == "bagging":
+            mask_arg = self._shard_row_array(
+                strategy.epoch_mask(self.iter_))
+        compact = self._fused_compact_rows(sample_mode, mask_arg)
         if self._iter_fn is None:
             self._ensure_grad_meta()
+            from ..parallel.sharded_state import (ShardedTrainState,
+                                                  state_shardings)
             grow = self._grow_partial
             guarded = self._nan_guard.enabled
+            quant = self.config.use_quantized_grad
+            qbins = self.config.num_grad_quant_bins
+            qstoch = self.config.stochastic_rounding
+            dd, gp = self.dd, self._grow_params
+            mesh = self.mesh if self._mesh_stream else None
+            row_axis = self._row_axis
+            D = (int(self.mesh.shape[row_axis])
+                 if mesh is not None and row_axis is not None else 1)
             gather = None
             if self._use_leaf_gather_kernel:
                 from ..pallas.stream_kernel import leaf_gather
                 gather = leaf_gather
 
-            def _fn(score, bound, pad_mask, qkey, bins, colm, packed, rate,
-                    gkey):
-                g, h, gq, hq, sc, new_state = self._gradient_graph(
-                    score, bound, pad_mask, qkey)
-                ok = None
+            def _fn(state, bound, pad_mask, mask_arg, qkey, skey, gkey,
+                    bins, colm, packed, rate, compact_rows=0,
+                    sample_mode="none"):
+                g, h, gq, hq, sc, new_obj = self._gradient_graph(
+                    state.score, bound, pad_mask, qkey,
+                    quantize=(sample_mode == "none"))
+                ok = jnp.asarray(True)
                 if guarded:
                     # nan_guard inside the one-launch program: a tripped
                     # check zeroes the growing inputs (exact no-op tree,
                     # score delta 0) and keeps the objective's PREVIOUS
-                    # state (a poisoned pos_biases update would re-poison
-                    # every later iteration); the flag is read lazily at
-                    # the finished-flag polls so the fused path keeps its
-                    # async pipeline
+                    # state; the flag is read at the batched poll so the
+                    # fused path keeps its async pipeline
                     ok = jnp.isfinite(g).all() & jnp.isfinite(h).all()
+                    g = jnp.where(ok, g, jnp.zeros_like(g))
+                    h = jnp.where(ok, h, jnp.zeros_like(h))
                     gq = jnp.where(ok, gq, jnp.zeros_like(gq))
                     hq = jnp.where(ok, hq, jnp.zeros_like(hq))
                     if sc is not None:
                         sc = jnp.where(ok, sc, jnp.zeros_like(sc))
-                    new_state = {a: jnp.where(ok, v, bound[a])
-                                 for a, v in new_state.items()}
-                arrays, leaf_id = grow(bins, gq, hq, pad_mask, colm,
-                                       key=gkey, packed=packed,
-                                       cegb_used=None, gh_scales=sc)
-                lv = arrays.leaf_value * rate
-                if gather is not None:
-                    delta = gather(leaf_id, lv)
+                    new_obj = {a: jnp.where(ok, v, bound[a])
+                               for a, v in new_obj.items()}
+                # ---- sampling (same keys/arithmetic as the eager path,
+                # so fused and unfused draws are identical) ----
+                mask = pad_mask
+                if sample_mode == "bagging":
+                    m = mask_arg
+                    gq = gq * m if gq.ndim == 1 else gq * m[:, None]
+                    hq = hq * m if hq.ndim == 1 else hq * m[:, None]
+                    mask = m * pad_mask
+                elif sample_mode == "goss":
+                    m, gq, hq = strategy.sample_traced(skey, gq, hq)
+                    mask = m * pad_mask
+                if sample_mode != "none" and quant:
+                    gq, hq, sc = quantize_gh(gq, hq, qkey, qbins, qstoch)
+                # per-shard in-bag counts: the compaction capacity is per
+                # shard, so overflow detection must see the FULLEST shard
+                per_shard = (mask > 0).reshape(D, -1).sum(axis=1,
+                                                          dtype=jnp.int32)
+                nc = jnp.sum(per_shard)
+                over = state.overflow
+                if compact_rows:
+                    over = over + (jnp.max(per_shard)
+                                   > compact_rows).astype(jnp.int32)
+                # ---- growth + score update ----
+                rate32 = jnp.float32(rate)
+                if k == 1:
+                    arrays, leaf_id = grow(
+                        bins, gq, hq, mask, colm, key=gkey, packed=packed,
+                        cegb_used=None, gh_scales=sc,
+                        compact_rows=compact_rows)
+                    lv = arrays.leaf_value * rate32
+                    delta = (gather(leaf_id, lv) if gather is not None
+                             else lv[leaf_id])
+                    new_score = state.score + delta
+                    fin = arrays.num_leaves <= 1
                 else:
-                    delta = lv[leaf_id]
-                return score + delta, arrays, leaf_id, new_state, ok
+                    from ..ops.grow import grow_tree_k
+                    scales = (jnp.transpose(sc) if sc is not None
+                              else jnp.zeros((k, 2), jnp.float32))
+                    arrays, leaf_id = grow_tree_k(
+                        bins, gq.T, hq.T, mask, colm, layout=dd.layout,
+                        routing=dd.routing, params=gp, packed=packed,
+                        gh_scales=scales, mesh=mesh, row_axis=row_axis,
+                        compact_rows=compact_rows)
+                    # stacked score add — same arithmetic as score_add_k
+                    Lk = arrays.leaf_value.shape[1]
+                    flat = arrays.leaf_value.reshape(-1) * rate32
+                    off = (jnp.arange(k) * Lk)[:, None]
+                    new_score = state.score + flat[leaf_id + off].T
+                    fin = jnp.all(arrays.num_leaves <= 1)
+                if guarded:
+                    # a nan-skipped iteration grows a trivial tree by
+                    # design — it must not read as "no more splits"
+                    fin = fin & ok
+                new_state = ShardedTrainState(
+                    score=new_score, grad=g, hess=h, leaf_id=leaf_id,
+                    mask=mask, key=qkey, sampled=nc, overflow=over,
+                    finished=fin, ok=ok)
+                return new_state, arrays, new_obj
 
-            self._iter_fn = watched_jit(_fn, name="fused_iter", owner=self)
+            out_sh = None
+            st_sh = state_shardings(self.mesh if self._row_sharding
+                                    is not None else None,
+                                    self._row_axis, k)
+            if st_sh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from ..tree import TreeArrays as _TA
+                rep = NamedSharding(self.mesh, P())
+                arrays_sh = _TA(*([rep] * len(_TA._fields)))
+                obj_sh = {a: rep for a in self._grad_state_names}
+                out_sh = (st_sh, arrays_sh, obj_sh)
+            jit_kw = {"out_shardings": out_sh} if out_sh is not None else {}
+            self._iter_fn = watched_jit(
+                _fn, name="fused_iter", owner=self,
+                static_argnames=("compact_rows", "sample_mode"), **jit_kw)
+        state = self._ensure_train_state()
         qkey = jax.random.PRNGKey(
             (self.config.data_random_seed + 11) * 131071 + self.iter_)
         gkey = None
         if self._needs_grow_key:
             gkey = jax.random.PRNGKey(
                 (self.config.extra_seed or 3) * 1000003 + self.iter_ * 2)
+        skey = strategy.traced_key(self.iter_)
+        if skey is None:
+            skey = jnp.zeros(2, jnp.uint32)
         bound = {a: getattr(self.objective, a)
                  for a in self._grad_attr_names + self._grad_state_names}
         with self._grow_x64_ctx():
-            new_score, arrays, leaf_id, new_state, ok = self._iter_fn(
-                self.score, bound, self._pad_mask, qkey, self.dd.bins,
-                self._feature_mask(), self._packed,
-                jnp.float32(self._shrinkage_rate()), gkey)
-        for a, v in new_state.items():
+            new_state, arrays, new_obj = self._iter_fn(
+                state, bound, self._pad_mask, mask_arg, qkey, skey, gkey,
+                self.dd.bins, self._feature_mask(), self._packed,
+                self._shrinkage_rate(), compact_rows=compact,
+                sample_mode=sample_mode)
+        for a, v in new_obj.items():
             setattr(self.objective, a, v)
-        return new_score, arrays, leaf_id, ok
+        self._train_state = new_state
+        self._last_compact_rows = compact
+        self._fused_last = True
+        return new_state, arrays
+
+    def _poll_device_flags(self) -> bool:
+        """ONE batched device->host fetch for every flag the host loop
+        needs — the finished flag, the nan_guard backlog, the in-bag row
+        count, and the compaction-overflow counter — issued once per
+        ``eval_fetch_freq`` iterations instead of one blocking read per
+        flag per iteration (each readback costs ~90 ms through a
+        tunneled TPU and serializes the pipelined step)."""
+        st = getattr(self, "_train_state", None)
+        pending = self._nan_guard.take_pending()
+        fetch = [self._finished_dev] + [ok for _, ok in pending]
+        if st is not None:
+            fetch += [st.sampled, st.overflow]
+        got = jax.device_get(fetch)
+        from ..telemetry import note_host_sync
+        note_host_sync()
+        self._nan_guard.resolve(pending, got[1:1 + len(pending)])
+        if st is not None:
+            self._last_sampled_rows = int(got[-2])
+            overflow = int(got[-1])
+            if overflow > getattr(self, "_overflow_seen", 0):
+                self._overflow_seen = overflow
+                if not getattr(self, "_compact_overflow", False):
+                    self._compact_overflow = True
+                    log_warning(
+                        "fused iteration: a shard's in-bag row count "
+                        "exceeded the analytic compaction capacity "
+                        f"({self._last_compact_rows}); trees since the "
+                        "last poll trained on a truncated sample — "
+                        "disabling row compaction for the rest of this "
+                        "run (set row_compaction=off to silence)")
+        return bool(got[0])
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
@@ -1389,12 +1695,19 @@ class GBDT:
                 phases[key] = round(d, 6)
         k = self.num_tree_per_iteration
         num_leaves = None
+        # on the fused-sharded path the per-iteration leaf-count readback
+        # would serialize the one-launch pipeline — fetch it only at the
+        # batched-poll iterations (docs/DISTRIBUTED.md readback policy)
+        fused_skip = (getattr(self, "_fused_last", False)
+                      and self.iter_ % self._finished_check_every != 0)
         try:
-            if self._lazy_trees:
+            if self._lazy_trees and not fused_skip:
                 tail = self._lazy_trees[-min(k, len(self._lazy_trees)):]
                 got = jax.device_get([e["arrays"].num_leaves for e in tail])
+                from ..telemetry import note_host_sync
+                note_host_sync()
                 num_leaves = int(np.sum(got))
-            elif self._models_list:
+            elif self._models_list and not fused_skip:
                 num_leaves = int(sum(t.num_leaves
                                      for t in self._models_list[-k:]))
         except Exception:
@@ -1440,6 +1753,20 @@ class GBDT:
         self._tel_comms_waits.append(comms_wait or 0.0)
         if len(self._tel_comms_waits) > 1024:
             del self._tel_comms_waits[:512]
+        # dispatch accounting: watched_jit launches and noted host syncs
+        # this iteration consumed (window means feed the straggler
+        # report's `bottleneck: dispatch` classification)
+        from ..telemetry import host_sync_count, launch_count
+        l1, s1 = launch_count(), host_sync_count()
+        l0, s0 = getattr(self, "_tel_disp0", (l1, s1))
+        self._tel_disp0 = (l1, s1)
+        rec["launches"] = l1 - l0
+        rec["host_syncs"] = s1 - s0
+        self._tel_launches.append(l1 - l0)
+        self._tel_syncs.append(s1 - s0)
+        if len(self._tel_launches) > 1024:
+            del self._tel_launches[:512]
+            del self._tel_syncs[:512]
         _tel_registry.record(rec)
         _tel_registry.inc("train/iterations")
         _tel_registry.observe("train/iteration", wall)
@@ -1460,7 +1787,9 @@ class GBDT:
             straggler_report(
                 self._tel_iter_times[-K:],
                 warn_skew=self.config.telemetry_straggler_skew,
-                comms_waits=self._tel_comms_waits[-K:])
+                comms_waits=self._tel_comms_waits[-K:],
+                launches_per_iter=float(np.mean(self._tel_launches[-K:])),
+                host_syncs_per_iter=float(np.mean(self._tel_syncs[-K:])))
 
     def _train_one_iter_impl(self, grad: Optional[jax.Array] = None,
                              hess: Optional[jax.Array] = None) -> bool:
@@ -1474,37 +1803,44 @@ class GBDT:
                      and self.objective.jit_safe_gradients
                      and not self.sample_strategy.is_active()
                      and self._row_sharding is None)
-        if fast_path and self._can_fuse_iteration():
+        if grad is None and hess is None and self._can_fuse_iteration():
+            k = self.num_tree_per_iteration
             with global_timer.scope("GBDT::FusedIter"), \
                     _tel_tracer.span("GBDT::FusedIter"):
-                new_score, arrays, leaf_id, ok_dev = self._iter_fused()
-            bias = 0.0
-            if (self.iter_ == 0 or self._average_output) and \
-                    self.init_scores[0] != 0.0:
-                bias = self.init_scores[0]
-            self.score = new_score
-            self._lazy_trees.append({"arrays": arrays,
-                                     "rate": self._shrinkage_rate(),
-                                     "bias": bias})
+                state, arrays_k = self._iter_fused()
+            self.score = state.score
+            rate = self._shrinkage_rate()
+            if k == 1:
+                arrays_list = [arrays_k]
+            else:
+                self._mc_batched_last = True
+                self._mc_stacked = (arrays_k, state.leaf_id)
+                arrays_list = [jax.tree.map(lambda a, i=kk: a[i], arrays_k)
+                               for kk in range(k)]
+            for kk, arrays in enumerate(arrays_list):
+                bias = 0.0
+                if (self.iter_ == 0 or self._average_output) and \
+                        self.init_scores[kk] != 0.0:
+                    bias = self.init_scores[kk]
+                self._lazy_trees.append({"arrays": arrays, "rate": rate,
+                                         "bias": bias})
             for vi, vset in enumerate(self.valid_sets):
                 vdd = self._valid_device_data(vset)
-                self._valid_scores[vi] = self._add_tree_arrays_to_score(
-                    self._valid_scores[vi], arrays, vdd, 0,
-                    self._shrinkage_rate())
-            fin = arrays.num_leaves <= 1
-            if ok_dev is not None:
-                # a nan-skipped iteration grows a trivial tree by design —
-                # it must not read as "no more splits possible"
-                fin = fin & ok_dev
-                self._nan_guard.note(ok_dev, self.iter_, defer=True)
-            self._finished_dev = fin
+                vs = self._valid_scores[vi]
+                for kk, arrays in enumerate(arrays_list):
+                    vs = self._add_tree_arrays_to_score(vs, arrays, vdd,
+                                                        kk, rate)
+                self._valid_scores[vi] = vs
+            if self._nan_guard.enabled:
+                self._nan_guard.note(state.ok, self.iter_, defer=True)
+            self._finished_dev = state.finished
             self.iter_ += 1
             if self.iter_ % self._finished_check_every == 0:
-                self._nan_guard.poll()
-                if bool(self._finished_dev):
+                if self._poll_device_flags():
                     self._trim_trailing_trivial()
                     return True
             return False
+        self._fused_last = False
         quant_done = False
         ok_dev = None
         old_state = ({a: getattr(self.objective, a, None)
@@ -1532,6 +1868,9 @@ class GBDT:
                 grad = self._pad_gh(jnp.asarray(grad, jnp.float32))
                 hess = self._pad_gh(jnp.asarray(hess, jnp.float32))
             mask, grad, hess = self.sample_strategy.sample(self.iter_, grad, hess)
+            if self.sample_strategy.is_active():
+                from ..telemetry import note_launch
+                note_launch(2)   # eager mask draw + scale (lower bound)
             mask = self._shard_row_array(mask) * self._pad_mask
             grad = self._shard_row_array(grad)
             hess = self._shard_row_array(hess)
@@ -1697,6 +2036,8 @@ class GBDT:
                     continue
                 lv = arrays.leaf_value * self._shrinkage_rate()
                 delta = lv[leaf_id]
+                from ..telemetry import note_launch
+                note_launch(3)   # eager scale + gather + add dispatches
                 # tree finalization is DEFERRED (see `models` property);
                 # record the init-score bias to fold at materialization time
                 # so saved models stay self-contained (reference: gbdt.cpp:425)
@@ -1742,6 +2083,8 @@ class GBDT:
         flags = [a.num_leaves <= 1 for a in new_arrays]
         fin = (flags[0] if len(flags) == 1
                else jnp.all(jnp.stack(flags)))
+        from ..telemetry import note_launch
+        note_launch(1)           # eager finished-flag combine
         if ok_dev is not None:
             # a nan-skipped iteration grows trivial trees by design — it
             # must not read as "no more splits possible"; the flag read is
@@ -1756,6 +2099,8 @@ class GBDT:
         # single-leaf trees accumulated between polls are dropped on stop so
         # num_trees()/model files match the reference's immediate stop
         if self.iter_ % self._finished_check_every == 0:
+            from ..telemetry import note_host_sync
+            note_host_sync()
             self._nan_guard.poll()
             if bool(self._finished_dev):
                 self._trim_trailing_trivial()

@@ -48,6 +48,33 @@ class SampleStrategy:
         mask = jnp.ones(grad.shape[0], jnp.float32)
         return mask, grad, hess
 
+    # ---- fused-iteration support (docs/DISTRIBUTED.md "fused iteration
+    # & sharded state"): the one-launch training step cannot run the
+    # eager sample() host logic mid-program, so each strategy declares
+    # how the fused caller gets its mask ----
+    def fused_mode(self, iteration: int) -> str:
+        """How the fused program obtains this iteration's mask:
+        ``none`` (no sampling), ``mask_arg`` (the eager epoch-cached mask
+        is passed in as a jit argument — bagging), or ``traced`` (the
+        mask is a pure in-program function of ``traced_key`` and the
+        gradients — GOSS)."""
+        return "none"
+
+    def traced_key(self, iteration: int) -> Optional[jax.Array]:
+        """PRNG key for ``sample_traced`` (host-derived per iteration so
+        fused and eager paths draw the identical mask)."""
+        return None
+
+    def sample_traced(self, key, grad, hess):
+        """Pure jit-safe form of :meth:`sample` (fused_mode='traced')."""
+        raise NotImplementedError
+
+    def expected_fraction(self, iteration: int) -> float:
+        """Expected in-bag row fraction of this iteration's mask — the
+        analytic input to the fused path's compaction capacity (which
+        cannot read the count back mid-pipeline)."""
+        return 1.0
+
 
 class BaggingSampleStrategy(SampleStrategy):
     """reference: bagging.hpp — fraction/freq bagging, pos/neg balanced, by-query."""
@@ -118,6 +145,21 @@ class BaggingSampleStrategy(SampleStrategy):
             return m, grad * m[:, None], hess * m[:, None]
         return m, grad * m, hess * m
 
+    def fused_mode(self, iteration: int) -> str:
+        # the bagging mask is a pure function of the epoch (cached, one
+        # small draw per bagging_freq iterations), so the fused program
+        # takes it as an argument instead of re-deriving it in-trace
+        return "mask_arg" if self.active else "none"
+
+    def epoch_mask(self, iteration: int) -> jax.Array:
+        """This iteration's (cached) in-bag mask without touching grads —
+        the fused caller passes it as a jit argument (and sizes compaction
+        from its cached count readback, so the analytic
+        ``expected_fraction`` path is GOSS-only)."""
+        m, _, _ = self.sample(iteration, jnp.zeros(1, jnp.float32),
+                              jnp.zeros(1, jnp.float32))
+        return m
+
 
 class GOSSStrategy(SampleStrategy):
     """Gradient-based one-side sampling (reference: goss.hpp:19): keep top_rate by
@@ -145,11 +187,31 @@ class GOSSStrategy(SampleStrategy):
         return -1 if self._is_warmup(iteration) else iteration
 
     def sample(self, iteration: int, grad, hess):
-        c = self.config
-        n = self.num_data
         if self._is_warmup(iteration):
             return SampleStrategy.sample(self, iteration, grad, hess)
-        key = jax.random.PRNGKey(c.bagging_seed * 524287 + iteration)
+        return self.sample_traced(self.traced_key(iteration), grad, hess)
+
+    def fused_mode(self, iteration: int) -> str:
+        # the GOSS mask depends on the CURRENT iteration's gradients, so
+        # the fused program derives it in-trace (sample_traced); warmup
+        # iterations are unsampled and trace the plain program
+        return "none" if self._is_warmup(iteration) else "traced"
+
+    def traced_key(self, iteration: int):
+        return jax.random.PRNGKey(
+            self.config.bagging_seed * 524287 + iteration)
+
+    def expected_fraction(self, iteration: int) -> float:
+        if self._is_warmup(iteration):
+            return 1.0
+        c = self.config
+        return min(1.0, c.top_rate + (1.0 - c.top_rate) * c.other_rate)
+
+    def sample_traced(self, key, grad, hess):
+        """Pure jit-safe GOSS draw — shared by the eager path and the
+        fused one-launch program (identical key -> identical mask)."""
+        c = self.config
+        n = self.num_data
         g2 = grad * hess if grad.ndim == 1 else jnp.sum(jnp.abs(grad * hess), axis=1)
         mag = jnp.abs(g2) if g2.ndim == 1 else g2
         k_top = max(1, int(c.top_rate * n))

@@ -94,6 +94,10 @@ class GrowParams(NamedTuple):
     # data_parallel_tree_learner.cpp:285-299 pattern). Trees bit-identical.
     hist_comms: str = "psum"
     hist_comms_dtype: str = "f32"   # f32 | bf16_pair (compressed wire)
+    # double-buffered reduce_scatter (parallel/comms.reduce_hist): number
+    # of independent psum_scatter chunks along the slot/class axis so the
+    # collective overlaps compute — bitwise identical to 1
+    hist_comms_chunks: int = 1
 
     @property
     def plain_growth(self) -> bool:
@@ -530,7 +534,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                     if with_hist:
                         if use_rs:
                             h = reduce_hist(h, row_axis, 1, plan,
-                                            params.hist_comms_dtype)
+                                            params.hist_comms_dtype,
+                                            chunks=params.hist_comms_chunks)
                         else:
                             with jax.named_scope("hist_psum"):
                                 h = jax.lax.psum(h, row_axis)
@@ -1581,7 +1586,8 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     if with_hist:
                         if use_rs:
                             h = reduce_hist(h, row_axis, 2, plan,
-                                            params.hist_comms_dtype)
+                                            params.hist_comms_dtype,
+                                            chunks=params.hist_comms_chunks)
                         else:
                             with jax.named_scope("hist_psum"):
                                 h = jax.lax.psum(h, row_axis)

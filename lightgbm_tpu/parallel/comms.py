@@ -140,7 +140,7 @@ def _plan_args(plan: ShardPlan):
 
 
 def reduce_hist(h: jax.Array, axis: str, g_dim: int, plan: ShardPlan,
-                dtype: str = "f32") -> jax.Array:
+                dtype: str = "f32", chunks: int = 1) -> jax.Array:
     """Reduce-Scatter the per-device histogram block over the group axis.
 
     Called INSIDE shard_map: h is this device's local block with
@@ -148,7 +148,20 @@ def reduce_hist(h: jax.Array, axis: str, g_dim: int, plan: ShardPlan,
     (g_pad / d)-group slice.  dtype="f32" is one `psum_scatter`, bitwise
     equal to `psum` restricted to the slice; "bf16_pair" exchanges remote
     contributions as the high bf16 half (half the wire bytes), keeps the
-    own-slice contribution exact f32, and accumulates in f32."""
+    own-slice contribution exact f32, and accumulates in f32.
+
+    ``chunks`` > 1 DOUBLE-BUFFERS the exact-wire collective (f32 / int32
+    psum_scatter; the bf16_pair path pipelines through its all_to_all
+    instead and ignores the knob — the engine resolves chunks=1 there):
+    the slot axis (dim 0 —
+    the round's child-slot channels, independent of the scatter's group
+    axis) is split into ``chunks`` independent ``psum_scatter`` calls, so
+    the XLA latency-hiding scheduler can start chunk 0's wire transfer
+    while chunk 1's operand copy/packing still runs, and downstream
+    consumers of already-delivered chunks overlap the tail (the classic
+    comms/compute pipeline of pjit training stacks).  Each element rides
+    the SAME rank-ordered reduction either way, so any chunking is
+    bitwise identical to chunks=1 (asserted by the A/B suite)."""
     G = h.shape[g_dim]
     if plan.g_pad != G:
         pad = [(0, 0)] * h.ndim
@@ -157,6 +170,18 @@ def reduce_hist(h: jax.Array, axis: str, g_dim: int, plan: ShardPlan,
     if dtype == "f32" or jnp.issubdtype(h.dtype, jnp.integer):
         # int32 quantized-gradient histograms are already the compressed,
         # exactly-summable wire format — bf16_pair would only lose bits
+        n_slots = h.shape[0]
+        if chunks > 1 and n_slots >= 2 * chunks:
+            cut = n_slots // chunks
+            parts = []
+            for c in range(chunks):
+                lo = c * cut
+                hi = n_slots if c == chunks - 1 else lo + cut
+                with jax.named_scope(f"hist_reduce_scatter_c{c}"):
+                    parts.append(jax.lax.psum_scatter(
+                        h[lo:hi], axis, scatter_dimension=g_dim,
+                        tiled=True))
+            return jnp.concatenate(parts, axis=0)
         with jax.named_scope("hist_reduce_scatter"):
             return jax.lax.psum_scatter(h, axis, scatter_dimension=g_dim,
                                         tiled=True)

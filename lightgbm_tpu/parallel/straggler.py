@@ -34,9 +34,14 @@ import numpy as np
 from ..utils.log import log_info, log_warning
 
 
+DISPATCH_LAUNCHES = 3.5   # launches/iter past this reads dispatch-bound
+
+
 def straggler_report(iter_times: Sequence[float],
                      warn_skew: float = 1.25,
                      comms_waits: Optional[Sequence[float]] = None,
+                     launches_per_iter: Optional[float] = None,
+                     host_syncs_per_iter: Optional[float] = None,
                      _all_host_stats: Optional[np.ndarray] = None
                      ) -> Optional[Dict[str, Any]]:
     """Aggregate per-host iteration times; returns the report dict.
@@ -45,9 +50,16 @@ def straggler_report(iter_times: Sequence[float],
     the LOCAL step (compute + in-program collectives).
     ``comms_waits`` — matching per-iteration barrier waits (s); the comms
     phase split the telemetry iteration records carry (``comms_wait_s``).
-    ``_all_host_stats`` — test hook: pre-gathered (H, 3) [n, mean, max]
-    or (H, 4) [n, mean, max, comms_mean] rows standing in for the
-    collective."""
+    ``launches_per_iter`` / ``host_syncs_per_iter`` — this host's window
+    mean of watched_jit dispatches and noted device->host transfers per
+    iteration (telemetry.launch_count / host_sync_count diffs); they feed
+    the ``bottleneck: dispatch`` classification — a loop that is neither
+    device- nor link-skewed but still issues many launches (or syncs)
+    per iteration is paying fixed dispatch latency, the regime the fused
+    iteration path (docs/DISTRIBUTED.md) removes.
+    ``_all_host_stats`` — test hook: pre-gathered (H, 3) [n, mean, max],
+    (H, 4) [n, mean, max, comms_mean], or (H, 6) [..., launches/iter,
+    host_syncs/iter] rows standing in for the collective."""
     if not len(iter_times) and _all_host_stats is None:
         return None
     import jax
@@ -57,14 +69,17 @@ def straggler_report(iter_times: Sequence[float],
                    np.float64)
     local = np.array([len(t), float(t.mean()) if len(t) else 0.0,
                       float(t.max()) if len(t) else 0.0,
-                      float(w.mean()) if len(w) else 0.0], np.float64)
+                      float(w.mean()) if len(w) else 0.0,
+                      float(launches_per_iter or 0.0),
+                      float(host_syncs_per_iter or 0.0)], np.float64)
     if _all_host_stats is not None:
         stats = np.asarray(_all_host_stats, np.float64)
         if stats.ndim == 1:
             stats = stats.reshape(1, -1)
-        if stats.shape[1] == 3:          # legacy 3-column test rows
+        if stats.shape[1] < 6:           # legacy 3/4-column test rows
             stats = np.concatenate(
-                [stats, np.zeros((stats.shape[0], 1))], axis=1)
+                [stats, np.zeros((stats.shape[0], 6 - stats.shape[1]))],
+                axis=1)
         pidx = 0
     elif jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -82,14 +97,25 @@ def straggler_report(iter_times: Sequence[float],
     skew = worst / median if median > 0 else 1.0
     wait_median = float(np.median(waits))
     wait_frac = wait_median / median if median > 0 else 0.0
+    launches = float(np.median(stats[:, 4]))
+    syncs = float(np.median(stats[:, 5]))
     # bottleneck classification (docs/DISTRIBUTED.md): a slow DEVICE shows
     # one host's compute far above the median (the others idle at the
     # barrier); a slow LINK shows level compute with everyone's barrier
-    # wait high — the time is inside the collectives
+    # wait high — the time is inside the collectives; a DISPATCH-bound
+    # loop shows neither, but issues many launches (or per-iteration host
+    # syncs) per step — each one fixed latency the fused iteration folds
+    # away.  Rows without the counters (legacy 3/4-column test rows,
+    # callers that never wired launches_per_iter) zero-pad to 0 and keep
+    # their PRE-dispatch-era classification (device/link/balanced) — a
+    # "balanced" verdict is only evidence of a fused loop when the
+    # launches column is nonzero.
     if skew >= warn_skew:
         bottleneck = "device"
     elif wait_frac >= (warn_skew - 1.0):
         bottleneck = "link"
+    elif launches > DISPATCH_LAUNCHES or syncs > DISPATCH_LAUNCHES:
+        bottleneck = "dispatch"
     else:
         bottleneck = "balanced"
     report: Dict[str, Any] = {
@@ -104,6 +130,8 @@ def straggler_report(iter_times: Sequence[float],
         "median_comms_wait_s": round(wait_median, 6),
         "max_comms_wait_s": round(float(waits.max()), 6),
         "comms_wait_frac": round(wait_frac, 4),
+        "launches_per_iter": round(launches, 3),
+        "host_syncs_per_iter": round(syncs, 3),
         "bottleneck": bottleneck,
     }
     from ..telemetry import global_registry, global_tracer
@@ -125,10 +153,17 @@ def straggler_report(iter_times: Sequence[float],
                 f"{wait_median * 1e3:.1f} ms/iter waiting at the barrier "
                 f"({wait_frac:.0%} of the {median * 1e3:.1f} ms compute "
                 "median) with level compute across hosts (slow LINK)")
+        elif bottleneck == "dispatch":
+            log_warning(
+                f"telemetry: dispatch-bound — {launches:.1f} launches and "
+                f"{syncs:.1f} host syncs per iteration at a level "
+                f"{median * 1e3:.1f} ms/iter (each dispatch pays fixed "
+                "latency; enable the fused iteration path, "
+                "docs/DISTRIBUTED.md)")
         else:
             log_info(
                 f"telemetry: {stats.shape[0]} hosts, median "
                 f"{median * 1e3:.1f} ms/iter, max {worst * 1e3:.1f} ms "
                 f"(host {slowest}, skew {skew:.2f}x, comms wait "
-                f"{wait_median * 1e3:.1f} ms)")
+                f"{wait_median * 1e3:.1f} ms, {launches:.1f} launches/iter)")
     return report

@@ -52,10 +52,12 @@ _VOLATILE_PARAMS = frozenset({
     "snapshot_freq", "snapshot_keep", "resume_from", "save_binary",
     "num_machines", "machines", "machine_list_filename", "local_listen_port",
     "time_out", "dist_retries", "dist_backoff",
-    # comms-mode A/B knob: trees are bit-identical across hist_comms, so a
-    # run may resume under a different collective layout (hist_comms_dtype
-    # is NOT volatile — bf16_pair changes the arithmetic)
-    "hist_comms",
+    # comms-mode A/B knobs: trees are bit-identical across hist_comms and
+    # across any psum_scatter chunking, so a run may resume under a
+    # different collective layout (hist_comms_dtype is NOT volatile —
+    # bf16_pair changes the arithmetic); eval_fetch_freq only re-times
+    # host polls
+    "hist_comms", "hist_comms_pipeline", "eval_fetch_freq",
     "telemetry", "telemetry_out", "trace_out", "telemetry_recompile_threshold",
     "telemetry_straggler_every", "telemetry_straggler_skew",
     "serve_host", "serve_port", "serve_max_batch", "serve_max_delay_ms",

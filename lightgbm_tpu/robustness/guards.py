@@ -56,13 +56,32 @@ class NanGuard:
         if not bool(ok_dev):
             self._record(iteration)
 
+    def take_pending(self) -> List[Tuple[int, object]]:
+        """Hand the deferred backlog to a caller that will fetch the
+        device flags inside ITS OWN batched transfer (the engine's
+        _poll_device_flags rides everything on one device_get); pair
+        with :meth:`resolve`."""
+        pending, self._pending = self._pending, []
+        return pending
+
+    def resolve(self, pending: List[Tuple[int, object]], values) -> None:
+        """Apply host values fetched for a :meth:`take_pending` batch."""
+        for (iteration, _), ok in zip(pending, values):
+            if not bool(ok):
+                self._record(iteration)
+
     def poll(self) -> None:
         """Resolve deferred flags (called at the finished-flag polls and at
-        the end of training)."""
-        pending, self._pending = self._pending, []
-        for iteration, ok_dev in pending:
-            if not bool(ok_dev):
-                self._record(iteration)
+        the end of training) — the whole backlog rides ONE device_get, not
+        one blocking bool() per flag."""
+        pending = self.take_pending()
+        if not pending:
+            return
+        import jax
+        from .. import telemetry as _tel
+        got = jax.device_get([ok for _, ok in pending])
+        _tel.note_host_sync()
+        self.resolve(pending, got)
 
     def _record(self, iteration: int) -> None:
         self.hits += 1

@@ -27,7 +27,9 @@ from typing import Any, Dict, Optional
 from .metrics import (MetricsRegistry, device_memory_gb, global_registry,
                       host_rss_gb, memory_snapshot)
 from .tracer import SpanTracer, global_tracer
-from .watchdog import (WatchEntry, get_recompile_threshold, recompile_counts,
+from .watchdog import (WatchEntry, get_recompile_threshold, host_sync_count,
+                       launch_count, note_host_sync, note_launch,
+                       recompile_counts,
                        reset_watchdog, set_recompile_threshold,
                        watchdog_summary, watched_jit)
 
@@ -39,6 +41,7 @@ __all__ = [
     "quantiles", "record", "export_trace", "flush", "summary",
     "watched_jit", "recompile_counts", "watchdog_summary",
     "set_recompile_threshold", "get_recompile_threshold", "reset_watchdog",
+    "launch_count", "host_sync_count", "note_host_sync", "note_launch",
     "memory_snapshot", "device_memory_gb", "host_rss_gb",
 ]
 

@@ -37,6 +37,45 @@ _lock = threading.Lock()
 _entries: "weakref.WeakSet[WatchEntry]" = weakref.WeakSet()
 _default_threshold = 2
 
+# ---- dispatch / host-sync accounting (docs/OBSERVABILITY.md) ----
+# `launches` counts every dispatch of a watched_jit entry point (one XLA
+# program execution request); `host_syncs` counts device->host transfers
+# noted by the engine (device_get, blocking flag reads).  Both are plain
+# int increments on the dispatch path — the GIL makes the += effectively
+# atomic and the cost (~100 ns) vanishes against any real launch.  The
+# straggler report derives launches/iter and host_syncs/iter from window
+# diffs, which is what lets `bottleneck:` tell a dispatch-bound loop from
+# a link-bound one.
+_launches = 0
+_host_syncs = 0
+
+
+def launch_count() -> int:
+    """Cumulative watched_jit dispatches in this process."""
+    return _launches
+
+
+def host_sync_count() -> int:
+    """Cumulative engine-noted device->host transfers."""
+    return _host_syncs
+
+
+def note_host_sync(n: int = 1) -> None:
+    """Record ``n`` device->host transfers (called at the engine's
+    sanctioned readback sites — the batched flag fetch, score pulls)."""
+    global _host_syncs
+    _host_syncs += n
+
+
+def note_launch(n: int = 1) -> None:
+    """Record ``n`` dispatches issued OUTSIDE watched_jit — the engine
+    notes its known eager op groups (each eager jnp op on device arrays
+    is one XLA execution) with conservative lower-bound counts, so the
+    launches/iter figure stays comparable between the fused one-launch
+    path and the eager pipeline it replaces."""
+    global _launches
+    _launches += n
+
 
 class WatchEntry:
     """Compile counter for one watched entry point."""
@@ -136,11 +175,25 @@ def watched_jit(fun=None, *, name: Optional[str] = None, owner: Any = None,
             return f(*args, **kwargs)
 
         jitted = jax.jit(traced, **jit_kwargs)
-        try:
-            jitted._telemetry_watch = entry
-        except AttributeError:
-            pass   # PjitFunction may reject attributes; the registry has it
-        return jitted
+
+        @functools.wraps(f)
+        def dispatched(*args, **kwargs):
+            # one extra Python frame per dispatch buys the launches counter
+            # (straggler `bottleneck: dispatch` classification); the jit's
+            # C++ fast path still runs inside
+            global _launches
+            _launches += 1
+            return jitted(*args, **kwargs)
+
+        dispatched._telemetry_watch = entry
+        dispatched._jitted = jitted
+        # forward the jit AOT/introspection surface the wrapper would
+        # otherwise hide (entry compile uses .lower(...).compile())
+        for attr in ("lower", "trace", "eval_shape", "clear_cache"):
+            bound = getattr(jitted, attr, None)
+            if bound is not None:
+                setattr(dispatched, attr, bound)
+        return dispatched
 
     return wrap if fun is None else wrap(fun)
 

@@ -43,6 +43,16 @@ XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
     | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
 --xla_force_host_platform_device_count=4" \
     python -m pytest tests/test_distributed_fast.py -x -q
+# fused-sharded iteration tier on the same 4-device mesh: the default
+# one-launch-per-iteration mesh path must match the unfused pipeline
+# (round-1 byte + structural ulp identity), keep its state sharded
+# across iterations, and resume bit-identically from a sharded snapshot
+# (docs/DISTRIBUTED.md "fused iteration & sharded state")
+echo "=== stage: fused-sharded iteration tier (D=4) ==="
+XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
+    | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
+--xla_force_host_platform_device_count=4" \
+    python -m pytest tests/test_fused_sharded.py -x -q
 echo "=== stage: full fast tier ==="
 python -m pytest tests/ -x -q
 # GOSS sampling bench: the row-compaction speedup gate (docs/PERF.md

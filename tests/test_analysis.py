@@ -93,6 +93,40 @@ def test_lgb002_jnp_asarray_clean(tmp_path):
     assert run_snippet(tmp_path, src, HostSyncRule()) == []
 
 
+def test_lgb002_iteration_loop_host_sync_trips(tmp_path):
+    """The iteration-loop extension: jax.device_get, .block_until_ready()
+    and np.asarray on sharded state inside the GBDT per-iteration
+    functions stall the one-launch pipeline (docs/ANALYSIS.md)."""
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "class GBDT:\n"
+           "    def _train_one_iter_impl(self):\n"
+           "        fin = jax.device_get(self._finished_dev)\n"   # line 5
+           "        self.score.block_until_ready()\n"             # line 6
+           "        s = np.asarray(self.score)\n"                 # line 7
+           "        n = np.asarray(self.score.shape)\n"           # static ok
+           "        return fin, s, n\n")
+    found = run_snippet(tmp_path, src, HostSyncRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB002", 5), ("LGB002", 6), ("LGB002", 7)]
+    assert "iteration-loop" in found[0].message
+    assert "_poll_device_flags" in found[0].hint
+
+
+def test_lgb002_iteration_loop_clean(tmp_path):
+    """Deferred device flags and metadata reads stay clean — and the same
+    syncs OUTSIDE the iteration loop are not this extension's business."""
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "class GBDT:\n"
+           "    def _train_one_iter_impl(self):\n"
+           "        self._finished_dev = self.score.sum() <= 1\n"
+           "        return self.score.shape[0]\n"
+           "    def _flush_models(self):\n"
+           "        return jax.device_get(self._lazy)\n")
+    assert run_snippet(tmp_path, src, HostSyncRule()) == []
+
+
 def test_lgb003_unbound_axis_trips(tmp_path):
     src = ("import jax\n"
            "from jax.sharding import PartitionSpec as P\n"

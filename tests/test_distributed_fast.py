@@ -84,6 +84,29 @@ def test_reduce_scatter_bit_identical_bagging():
 
 
 @needs_mesh
+def test_reduce_scatter_pipeline_chunks_bit_identical():
+    """Double-buffered scatter (hist_comms_pipeline, default 2 under
+    reduce_scatter): chunking the psum_scatter along the slot axis rides
+    the same rank-ordered per-element reduction, so any chunk count is
+    BITWISE identical to one scatter."""
+    X, y = make_synthetic_binary(n=1500, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5, "tree_learner": "data",
+         "hist_backend": "stream", "hist_comms": "reduce_scatter"}
+
+    def run(chunks):
+        os.environ["LGBTPU_HIST_COMMS_PIPELINE"] = str(chunks)
+        try:
+            bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+        finally:
+            del os.environ["LGBTPU_HIST_COMMS_PIPELINE"]
+        assert bst.engine._grow_params.hist_comms_chunks == chunks
+        return _strip_params(bst.model_to_string())
+
+    assert run(1) == run(2) == run(4)
+
+
+@needs_mesh
 def test_reduce_scatter_env_override():
     """LGBTPU_HIST_COMMS forces the mode over the param (A/B harness)."""
     X, y = make_synthetic_binary(n=1500, f=6)
@@ -210,6 +233,22 @@ def test_straggler_report_splits_comms_from_compute():
     rep = straggler_report([], _all_host_stats=stats)
     assert rep["bottleneck"] == "device"
     assert rep["median_comms_wait_s"] == 0.0
+
+    # DISPATCH-bound: level compute, no barrier wait, but the eager
+    # pipeline's many launches/host-syncs per iteration (6-column rows:
+    # [n, mean, max, comms_mean, launches/iter, syncs/iter])
+    stats = np.array([[50, 0.10, 0.11, 0.001, 9.0, 3.0],
+                      [50, 0.10, 0.11, 0.001, 9.0, 3.0]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "dispatch"
+    assert rep["launches_per_iter"] == 9.0
+
+    # the fused one-launch path reads BALANCED on the same compute
+    stats = np.array([[50, 0.10, 0.11, 0.001, 1.0, 0.1],
+                      [50, 0.10, 0.11, 0.001, 1.0, 0.1]])
+    rep = straggler_report([], _all_host_stats=stats)
+    assert rep["bottleneck"] == "balanced"
+    assert rep["host_syncs_per_iter"] == 0.1
 
 
 # ---------------------------------------------------------------------------
