@@ -951,17 +951,26 @@ def run_fleet_bench():
         responses across the promotion;
       * p99 of successful requests bounded (<= BENCH_FLEET_P99_MS);
       * the killed replica restarts (supervisor backoff) and every
-        reachable replica converges on the promoted generation.
+        reachable replica converges on the promoted generation;
+      * the SLO burn-rate monitor FIRES during the injected chaos (the
+        hung replica's timeout-then-retry latency blows the p99 budget)
+        and CLEARS after recovery, with the alert timeline recorded;
+      * /metrics is valid Prometheus text on the front, a replica, and
+        the fleet aggregate, and the per-process trace shards merge into
+        one wall-clock-aligned Perfetto file.
 
     Writes BENCH_FLEET.json (QPS, p50/p99, shed/retry/breaker/restart
-    counts, reload outcome)."""
+    counts, reload outcome, SLO alert timeline, observability
+    artifacts)."""
     import tempfile
     import threading
 
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
     from lightgbm_tpu.serving import ServingFleet
     from lightgbm_tpu.serving.fleet import validate_candidate
     from lightgbm_tpu.serving.front import http_json
+    from lightgbm_tpu.telemetry.collect import merge_traces, write_merged
 
     rows = int(os.environ.get("BENCH_FLEET_ROWS", 50_000))
     iters = int(os.environ.get("BENCH_FLEET_MODEL_ITERS", 20))
@@ -969,6 +978,11 @@ def run_fleet_bench():
     clients = int(os.environ.get("BENCH_FLEET_CLIENTS", 6))
     replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
     p99_gate_ms = float(os.environ.get("BENCH_FLEET_P99_MS", 2500.0))
+    # latency SLO for the burn gate: the hung replica's timeout-then-
+    # retry requests (~ deadline/attempts >= 500 ms) must blow this
+    # budget while steady-state traffic (p99 ~ 54 ms) stays inside it
+    slo_p99_ms = float(os.environ.get("BENCH_FLEET_SLO_P99_MS", 150.0))
+    slo_burn = float(os.environ.get("BENCH_FLEET_SLO_BURN", 1.0))
     deadline_ms = 2000.0
     if replicas < 3:
         raise RuntimeError("the fleet chaos gate needs >= 3 replicas "
@@ -998,12 +1012,18 @@ def run_fleet_bench():
     os.environ["LGBTPU_CHAOS"] = (
         f"kill_replica:iter=10,rank=0,once={m_kill};"
         f"hang_replica:iter=14,rank=1,once={m_hang}")
+    # the front's spans + SLO gauges live in THIS process; tracing runs
+    # at its DEFAULT sample rate — the QPS gate doubles as the
+    # observability-overhead gate
+    telemetry.configure(enabled=True)
     fleet = ServingFleet(
         paths[0], replicas=replicas, max_batch=max(sizes),
         buckets_spec=str(max(sizes)), max_delay_ms=1.0, queue_size=512,
         deadline_ms=deadline_ms, retries=3, retry_backoff_ms=10.0,
         breaker_failures=3, breaker_cooldown_s=0.5,
-        restart_backoff_s=0.2, hang_timeout_s=2.0)
+        restart_backoff_s=0.2, hang_timeout_s=2.0,
+        fleet_dir=os.path.join(td, "fleet"),
+        slo_p99_ms=slo_p99_ms, slo_window_s=1.0, slo_burn=slo_burn)
     bodies = {m: {"rows": X[:m].tolist(), "raw_score": True,
                   "deadline_ms": deadline_ms} for m in sizes}
     lat_ms: list = []
@@ -1042,9 +1062,31 @@ def run_fleet_bench():
             for k, v in local.items():
                 outcomes[k] += v
 
+    def scrape_text(host, port, path):
+        import http.client
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, r.read().decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+
+    def prom_valid(text):
+        lines = [ln for ln in text.splitlines() if ln]
+        types = [ln for ln in lines if ln.startswith("# TYPE ")]
+        names = [ln.split()[2] for ln in types]
+        return (bool(types) and len(names) == len(set(names))
+                and any(ln.startswith("lgbtpu_") for ln in lines))
+
     reload_outcome = {}
+    slo_report = {}
+    prom_report = {}
     try:
         fleet.start()
+        # the 8-second chaos run cannot wait out a 12x slow window: pair
+        # the 1 s fast window with a 2 s slow one (production keeps 12x)
+        fleet.front.slo.slow_factor = 2.0
         # warm every client-visible shape through the front first
         for m in sizes:
             st, _, _ = http_json(fleet.host, fleet.port, "POST",
@@ -1085,6 +1127,36 @@ def run_fleet_bench():
         d = fleet.describe()
         front_stats = fleet.front.describe()
         restarts = d["restarts_total"]
+        # ---- SLO gate: the burn alert must have FIRED during the chaos
+        # window and must CLEAR now that traffic is healthy/idle (the
+        # front's poll loop keeps ticking the monitor)
+        t_clear = time.time()
+        while (fleet.front.slo.state()["alerting"]
+               and time.time() - t_clear < 15):
+            time.sleep(0.3)
+        slo_state = fleet.front.slo.state()
+        slo_report = {
+            "fired": fleet.front.slo.fired,
+            "cleared": fleet.front.slo.cleared,
+            "alerting_at_end": slo_state["alerting"],
+            "p99_target_ms": slo_p99_ms,
+            "burn_threshold": slo_burn,
+            "timeline": fleet.front.slo.timeline(),
+        }
+        # ---- /metrics gate: valid exposition text on the front, the
+        # fleet aggregate, and the clean replica (rank 2: never chaosed)
+        stf, front_txt = scrape_text(fleet.host, fleet.port, "/metrics")
+        sta, agg_txt = scrape_text(fleet.host, fleet.port,
+                                   "/metrics/fleet")
+        rep_ep = fleet.endpoint(replicas - 1)
+        strr, rep_txt = scrape_text(rep_ep["host"], rep_ep["port"],
+                                    "/metrics")
+        prom_report = {
+            "front_ok": stf == 200 and prom_valid(front_txt),
+            "fleet_ok": (sta == 200 and prom_valid(agg_txt)
+                         and 'replica="' in agg_txt),
+            "replica_ok": strr == 200 and prom_valid(rep_txt),
+        }
     finally:
         fleet.stop()
         if chaos_prev is None:
@@ -1092,13 +1164,51 @@ def run_fleet_bench():
         else:
             os.environ["LGBTPU_CHAOS"] = chaos_prev
 
+    # ---- merged trace: per-process shards (front + replicas, exported
+    # on stop/drain) onto one wall-clock timeline; a head-sampled
+    # request must show spans from >= 2 processes (front -> replica)
+    trace_report = {"shards": 0, "multiprocess_trace": False}
+    try:
+        fdir = fleet.dir
+        shard_paths = [os.path.join(fdir, f) for f in sorted(os.listdir(fdir))
+                       if f.startswith("trace")]
+        if shard_paths:
+            blob, msum = merge_traces(shard_paths)
+            merged_path = write_merged(
+                blob, os.path.join(td, "merged_trace.json"))
+            by_trace = {}
+            for ev in blob["traceEvents"]:
+                tid_arg = (ev.get("args") or {}).get("trace_id")
+                if tid_arg:
+                    by_trace.setdefault(tid_arg, set()).add(
+                        (ev.get("pid"), ev["name"]))
+            multi = [t for t, s in by_trace.items()
+                     if len({p for p, _ in s}) >= 2
+                     and any(n == "front/request" for _, n in s)
+                     and any(n == "serve/predict" for _, n in s)]
+            trace_report = {
+                "shards": msum["shards"],
+                "merged_events": msum["events"],
+                "merged_path": merged_path,
+                "sampled_traces": len(by_trace),
+                "multiprocess_trace": bool(multi),
+            }
+    except (OSError, RuntimeError) as e:
+        trace_report["error"] = str(e)
+
     qps = outcomes["ok"] / max(elapsed, 1e-9)
     p50 = float(np.percentile(lat_ms, 50)) if lat_ms else float("inf")
     p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float("inf")
     chaos_fired = os.path.exists(m_kill) and os.path.exists(m_hang)
+    slo_ok = (slo_report.get("fired", 0) >= 1
+              and not slo_report.get("alerting_at_end", True))
+    obs_ok = (slo_ok and all(prom_report.get(k) for k in
+                             ("front_ok", "fleet_ok", "replica_ok"))
+              and trace_report.get("multiprocess_trace", False))
     ok = (outcomes["errors"] == 0 and outcomes["mis_versioned"] == 0
           and outcomes["ok"] > 0 and chaos_fired and restarts >= 1
-          and reload_ok and converged and p99 <= p99_gate_ms)
+          and reload_ok and converged and p99 <= p99_gate_ms
+          and obs_ok)
     record = {
         "metric": "fleet_chaos_qps",
         "value": round(qps, 1),
@@ -1109,7 +1219,8 @@ def run_fleet_bench():
                  f"mis_versioned={outcomes['mis_versioned']}, "
                  f"p99={p99:.0f}ms<=gate {p99_gate_ms:.0f}, "
                  f"restarts={restarts}, chaos_fired={chaos_fired}, "
-                 f"reload_converged={converged})"),
+                 f"reload_converged={converged}, slo_fired+cleared="
+                 f"{slo_ok}, metrics+trace={obs_ok})"),
         "vs_baseline": None,
         "qps": round(qps, 1),
         "p50_ms": round(p50, 2),
@@ -1126,6 +1237,9 @@ def run_fleet_bench():
         "reload": reload_outcome,
         "replicas": replicas,
         "clients": clients,
+        "slo": slo_report,
+        "metrics_endpoints": prom_report,
+        "trace": trace_report,
     }
     print(json.dumps({k: record[k] for k in
                       ("metric", "value", "unit", "vs_baseline")}),

@@ -30,8 +30,10 @@ def all_rules() -> List[Rule]:
     from .host_sync import HostSyncRule
     from .jit_discipline import JitDisciplineRule
     from .lock_discipline import LockDisciplineRule
+    from .metric_name import MetricNameRule
     from .subprocess_discipline import SubprocessDisciplineRule
 
     return [JitDisciplineRule(), HostSyncRule(), CollectiveAxisRule(),
             DeterminismRule(), AtomicIORule(), LockDisciplineRule(),
-            ConfigDocRule(), SubprocessDisciplineRule()]
+            ConfigDocRule(), SubprocessDisciplineRule(),
+            MetricNameRule()]

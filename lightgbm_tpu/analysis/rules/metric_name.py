@@ -1,0 +1,109 @@
+"""LGB009: metric names must be literal (or allow-listed low-cardinality).
+
+The ``/metrics`` Prometheus surface renders every registry counter/gauge/
+histogram name as a time series.  A name built from runtime data — a
+request id, a model path, a user string — mints a NEW series per distinct
+value: unbounded label cardinality, the classic way a metrics backend
+falls over and a scrape surface becomes unreadable.  The registry cannot
+police this at runtime (by then the damage is a million series), so the
+gate does it at the call site:
+
+Names passed to ``telemetry.inc`` / ``gauge`` / ``observe`` (and the
+same methods on ``global_registry``) must be **string literals**, or
+f-strings whose literal skeleton matches a reviewed low-cardinality
+allow-list:
+
+  * ``fleet/replica/<r>/...`` — bounded by ``serve_replicas``;
+  * ``recompile/<name>`` — bounded by the watched_jit entry-point set.
+
+Everything else — bare variables, ``+`` concatenation, ``%``/
+``str.format``, unlisted f-strings — is flagged.  Names are data, not
+identity: put the varying part in a LABEL (the exporter's
+``fleet/replica/<r>`` relabeling) or in the record stream, never in the
+metric name.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from . import Rule
+
+_METHODS = ("inc", "gauge", "observe")
+# receivers that are (or alias) the metrics registry; attribute chains
+# ending in .telemetry / .global_registry also match
+_RECEIVERS = ("telemetry", "global_registry", "tel", "metrics_registry")
+
+# reviewed low-cardinality f-string skeletons ("*" marks a formatted
+# field).  Adding a line here is a cardinality-budget decision: the
+# formatted field must be bounded by configuration, never by traffic.
+_ALLOWED_SKELETONS = (
+    re.compile(r"^fleet/replica/\*/[a-z0-9_]+$"),
+    re.compile(r"^recompile/\*$"),
+)
+
+
+def _receiver_matches(func: ast.AST) -> bool:
+    if not isinstance(func, ast.Attribute) or func.attr not in _METHODS:
+        return False
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id in _RECEIVERS
+    if isinstance(base, ast.Attribute):
+        return base.attr in _RECEIVERS
+    return False
+
+
+def _skeleton(node: ast.JoinedStr) -> str:
+    parts = []
+    for val in node.values:
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            parts.append(val.value)
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+class MetricNameRule(Rule):
+    rule_id = "LGB009"
+    title = "metric name must be a literal (bounded-cardinality) string"
+    hint = ("pass a literal metric name and put the varying part in the "
+            "record stream or an allow-listed label format "
+            "(fleet/replica/<r>/..., recompile/<name>) — dynamic names "
+            "mint unbounded Prometheus series")
+
+    def check_module(self, module) -> Iterable:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) \
+                    or not _receiver_matches(node.func):
+                continue
+            arg = _name_arg(node)
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                continue
+            if isinstance(arg, ast.JoinedStr):
+                skel = _skeleton(arg)
+                if any(p.match(skel) for p in _ALLOWED_SKELETONS):
+                    continue
+                yield module.finding(
+                    self.rule_id, node,
+                    f"f-string metric name {skel!r} is not on the "
+                    "low-cardinality allow-list — every distinct value "
+                    "mints a new /metrics series", self.hint)
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"metric name for .{node.func.attr}() is computed at "
+                "runtime — unbounded name cardinality on the /metrics "
+                "surface", self.hint)

@@ -208,6 +208,13 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "serve_breaker_cooldown_s": [],
     "serve_restart_backoff_s": [],
     "serve_hang_timeout_s": ["serve_hang_timeout"],
+    "serve_trace_sample": ["trace_sample_rate"],
+    "serve_trace_tail": ["trace_tail_capacity"],
+    "serve_access_log": ["access_log"],
+    "serve_slo_availability": ["slo_availability_target"],
+    "serve_slo_p99_ms": ["slo_p99_ms", "slo_latency_target_ms"],
+    "serve_slo_window_s": ["slo_window"],
+    "serve_slo_burn": ["slo_burn_threshold"],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -595,6 +602,29 @@ class Config:
     # fleet supervisor: SIGKILL+restart a replica whose heartbeat file
     # goes stale past this many seconds (0 = hang detection off)
     serve_hang_timeout_s: float = 10.0
+    # head-sampling probability for per-request trace spans: the front
+    # (or a standalone replica) decides once per request and propagates
+    # the decision in the X-LGBTPU-Trace header; 0 = no request tracing
+    serve_trace_sample: float = 0.01
+    # bounded ring capacity for tail-captured requests (errored or
+    # SLO-violating — kept regardless of head sampling), shown in /stats
+    serve_trace_tail: int = 256
+    # structured JSONL access log ("" = off): a file path standalone;
+    # a DIRECTORY in fleet mode (access_front.jsonl + per-replica files)
+    serve_access_log: str = ""
+    # availability SLO target: fraction of requests NOT failing with a
+    # non-503 error (503 sheds are load management, not outages);
+    # the error budget 1 - target feeds the burn-rate monitor
+    serve_slo_availability: float = 0.999
+    # latency SLO: 99% of 200 responses must land under this many ms;
+    # 0 disables the latency dimension
+    serve_slo_p99_ms: float = 0.0
+    # fast burn-rate window in seconds (the slow window is 12x longer;
+    # an alert needs BOTH above serve_slo_burn, clears on the fast one)
+    serve_slo_window_s: float = 60.0
+    # burn-rate alert threshold: budget consumed this many times faster
+    # than steady-state fires the SLO alert (Google SRE workbook pairing)
+    serve_slo_burn: float = 14.4
 
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records
@@ -671,6 +701,28 @@ class Config:
             raise LightGBMError(
                 f"hist_comms_pipeline={self.hist_comms_pipeline} must be "
                 ">= 0 (0 = auto)")
+        if not 0.0 <= self.serve_trace_sample <= 1.0:
+            raise LightGBMError(
+                f"serve_trace_sample={self.serve_trace_sample} must be a "
+                "probability in [0, 1]")
+        if self.serve_trace_tail < 1:
+            raise LightGBMError(
+                f"serve_trace_tail={self.serve_trace_tail} must be >= 1")
+        if not 0.0 < self.serve_slo_availability < 1.0:
+            raise LightGBMError(
+                f"serve_slo_availability={self.serve_slo_availability} "
+                "must be a fraction in (0, 1), e.g. 0.999")
+        if self.serve_slo_p99_ms < 0:
+            raise LightGBMError(
+                f"serve_slo_p99_ms={self.serve_slo_p99_ms} must be >= 0 "
+                "(0 disables the latency SLO)")
+        if self.serve_slo_window_s <= 0:
+            raise LightGBMError(
+                f"serve_slo_window_s={self.serve_slo_window_s} must be "
+                "> 0")
+        if self.serve_slo_burn <= 0:
+            raise LightGBMError(
+                f"serve_slo_burn={self.serve_slo_burn} must be > 0")
         # GOSS parameter conflicts (reference: Config::CheckParamConflict,
         # src/io/config.cpp — "cannot use bagging in GOSS" and the sampled
         # fractions must partition the data)

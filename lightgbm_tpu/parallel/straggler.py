@@ -138,7 +138,16 @@ def straggler_report(iter_times: Sequence[float],
     global_registry.record(report)
     global_registry.gauge("straggler/skew", skew)
     global_registry.gauge("straggler/comms_wait_frac", wait_frac)
+    # the /metrics scrape surface (docs/OBSERVABILITY.md "Serving
+    # observability") carries the training-side skew signal too, so one
+    # Prometheus dashboard covers both halves of the train->serve loop
+    global_registry.gauge("straggler/median_host_mean_s", median)
+    global_registry.gauge("straggler/max_host_mean_s", worst)
+    global_registry.gauge("straggler/launches_per_iter", launches)
+    global_registry.gauge("straggler/host_syncs_per_iter", syncs)
     global_tracer.counter("straggler_skew", skew=skew)
+    global_tracer.instant("straggler_report", bottleneck=bottleneck,
+                          skew=round(skew, 4), hosts=int(stats.shape[0]))
     if pidx == 0 and stats.shape[0] > 1:
         if bottleneck == "device":
             log_warning(

@@ -66,6 +66,9 @@ _VOLATILE_PARAMS = frozenset({
     "serve_deadline_ms", "serve_retries", "serve_retry_backoff_ms",
     "serve_breaker_failures", "serve_breaker_cooldown_s",
     "serve_restart_backoff_s", "serve_hang_timeout_s",
+    "serve_trace_sample", "serve_trace_tail", "serve_access_log",
+    "serve_slo_availability", "serve_slo_p99_ms", "serve_slo_window_s",
+    "serve_slo_burn",
 })
 
 

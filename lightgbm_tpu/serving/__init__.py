@@ -29,6 +29,7 @@ from .fleet import ServingFleet, run_fleet
 from .registry import ModelRegistry, ServingModel
 from .server import (ServingApp, reuseport_available, run_server,
                      serve_from_params)
+from .slo import SLOMonitor
 
 __all__ = [
     "CompiledPredictor", "bucket_ladder",
@@ -36,5 +37,5 @@ __all__ = [
     "MicroBatcher", "OverloadError", "DeadlineError", "PredictResult",
     "ServingApp", "run_server", "serve_from_params",
     "ServingFleet", "run_fleet", "FanoutFront", "CircuitBreaker",
-    "reuseport_available",
+    "SLOMonitor", "reuseport_available",
 ]

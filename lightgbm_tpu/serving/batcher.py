@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry.tracer import _NULL_SPAN as _NULL_DISPATCH
 from ..utils.log import LightGBMError, log_debug, log_warning
 from .registry import ModelRegistry, ServingModel
 
@@ -99,6 +100,7 @@ class _Request:
     rows: np.ndarray
     raw_score: bool
     deadline: Optional[float] = None      # absolute time.perf_counter point
+    trace: Any = None                     # telemetry.TraceContext or None
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.perf_counter)
 
@@ -192,7 +194,8 @@ class MicroBatcher:
     # -- submission --------------------------------------------------------
     def submit(self, rows, raw_score: bool = False,
                fast: bool = False,
-               deadline: Optional[float] = None) -> "Future[PredictResult]":
+               deadline: Optional[float] = None,
+               trace=None) -> "Future[PredictResult]":
         """Enqueue one request; returns a Future resolving to
         :class:`PredictResult`.  Raises :class:`OverloadError` at once
         when the queue is full (or ``deadline`` — an absolute
@@ -228,7 +231,7 @@ class MicroBatcher:
             fut.set_result(PredictResult(values, model.version, 1, 0.0))
             return fut
         req = _Request(np.ascontiguousarray(X), bool(raw_score),
-                       deadline=deadline)
+                       deadline=deadline, trace=trace)
         with self._submit_lock:
             if self._stop.is_set():
                 raise OverloadError(self._q.qsize(), self.queue_size,
@@ -313,24 +316,42 @@ class MicroBatcher:
         if not good:
             return
         t0 = time.perf_counter()
+        # distributed tracing: each head-sampled request gets its queue
+        # wait as a cross-thread complete event, and the coalesced
+        # device dispatch is one span carrying every sampled trace id
+        sampled = [r.trace.trace_id for r in good
+                   if r.trace is not None and r.trace.sampled]
+        for r in good:
+            telemetry.request_complete(
+                r.trace, "serve/queue_wait", r.t_enqueue,
+                t0 - r.t_enqueue, rows=int(r.rows.shape[0]))
         X = (good[0].rows if len(good) == 1
              else np.concatenate([r.rows for r in good], axis=0))
         n = X.shape[0]
-        if n == 1 and len(good) == 1:
-            # a lone singleton skips the device: native single-row walk
-            values = model.predict(good[0].rows, raw_score=good[0].raw_score)
-            good[0].resolve(PredictResult(
-                values, model.version, 1,
-                t0 - good[0].t_enqueue))
-        else:
-            raw = model.raw_scores(X)
-            off = 0
-            for r in good:
-                m = r.rows.shape[0]
-                r.resolve(PredictResult(
-                    model.finish(raw[off:off + m], r.raw_score),
-                    model.version, n, t0 - r.t_enqueue))
-                off += m
+        dispatch_span = (telemetry.span("serve/dispatch", rows=n,
+                                        requests=len(good),
+                                        trace_ids=sampled)
+                         if sampled else _NULL_DISPATCH)
+        with dispatch_span:
+            if n == 1 and len(good) == 1:
+                # a lone singleton skips the device: native single-row walk
+                values = model.predict(good[0].rows,
+                                       raw_score=good[0].raw_score)
+                good[0].resolve(PredictResult(
+                    values, model.version, 1,
+                    t0 - good[0].t_enqueue))
+            else:
+                with (telemetry.span("serve/device", rows=n,
+                                     trace_ids=sampled)
+                      if sampled else _NULL_DISPATCH):
+                    raw = model.raw_scores(X)
+                off = 0
+                for r in good:
+                    m = r.rows.shape[0]
+                    r.resolve(PredictResult(
+                        model.finish(raw[off:off + m], r.raw_score),
+                        model.version, n, t0 - r.t_enqueue))
+                    off += m
         dt = time.perf_counter() - t0
         with self._submit_lock:
             self.batches += 1

@@ -139,11 +139,17 @@ def promote_pointer(fleet_dir: str, path: str,
 def _replica_main(spec_path: str, rank: int) -> int:
     """Entry point of one replica process (spawned by the supervisor as
     ``python -m lightgbm_tpu.serving.fleet --replica <spec> <rank>``)."""
+    from .. import telemetry
     from ..robustness import chaos
     from .server import ServingApp
 
     with open(spec_path) as fh:
         spec = json.load(fh)
+    # a replica serving blind (no latency histograms, no /metrics, no
+    # trace spans) is undebuggable from the fleet — telemetry is on in
+    # every replica; per-request span emission still follows the
+    # propagated head-sampling decision (serve_trace_sample)
+    telemetry.configure(enabled=True)
     if spec.get("cache_dir"):
         # shared persistent compile cache: replica warmups after the
         # first pay file reads, not XLA compiles
@@ -200,6 +206,7 @@ def _replica_main(spec_path: str, rank: int) -> int:
             if stop.wait(1.0):
                 return 0
     reuseport = bool(spec.get("reuseport"))
+    access_dir = str(spec.get("access_log_dir", "") or "")
     app = ServingApp(
         str(pointer["path"]),
         host=spec["host"],
@@ -211,7 +218,16 @@ def _replica_main(spec_path: str, rank: int) -> int:
         warmup=bool(spec.get("warmup", True)),
         heartbeat_path=hb_path,
         deadline_ms=float(spec.get("deadline_ms", 0.0)),
-        reuse_port=reuseport)
+        reuse_port=reuseport,
+        trace_sample=float(spec.get("trace_sample", 0.01)),
+        trace_tail=int(spec.get("trace_tail", 256)),
+        access_log=(os.path.join(access_dir,
+                                 f"access_replica_{rank}.jsonl")
+                    if access_dir else ""),
+        slo_availability=float(spec.get("slo_availability", 0.999)),
+        slo_p99_ms=float(spec.get("slo_p99_ms", 0.0)),
+        slo_window_s=float(spec.get("slo_window_s", 60.0)),
+        slo_burn=float(spec.get("slo_burn", 14.4)))
     app.replica_rank = rank
     app.generation = int(pointer["generation"])
     app.seen_generation = app.generation
@@ -275,6 +291,16 @@ def _replica_main(spec_path: str, rank: int) -> int:
     while not stop.wait(0.2):
         pass
     app.shutdown(drain=True)
+    # leave this process's span shard behind for the cross-process
+    # collector (python -m lightgbm_tpu.telemetry.collect <fleet_dir>) —
+    # unless the fleet dir is a private tmpdir the supervisor removes on
+    # stop, where the shard would be destroyed moments after the write
+    if not spec.get("ephemeral_dir"):
+        try:
+            telemetry.export_trace(
+                os.path.join(fleet_dir, f"trace_replica_{rank}.json"))
+        except OSError as e:
+            log_debug(f"replica {rank} trace export failed: {e}")
     return 0
 
 
@@ -301,6 +327,10 @@ class ServingFleet:
                  restart_backoff_s: float = 0.5,
                  hang_timeout_s: float = 10.0,
                  startup_timeout_s: float = 180.0,
+                 trace_sample: float = 0.01, trace_tail: int = 256,
+                 access_log: str = "",
+                 slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
+                 slo_window_s: float = 60.0, slo_burn: float = 14.4,
                  python: str = sys.executable):
         from .server import reuseport_available
 
@@ -348,6 +378,17 @@ class ServingFleet:
         cur = read_pointer(self.dir)
         gen = int(cur["generation"]) + 1 if cur else 1
         self._pointer = write_pointer(self.dir, model_path, sha, gen)
+        # observability knobs ride to every replica via the spec; the
+        # access log treats the configured path as a DIRECTORY in fleet
+        # mode (access_front.jsonl + access_replica_<r>.jsonl inside)
+        self.trace_sample = float(trace_sample)
+        self.slo_params = {"slo_availability": float(slo_availability),
+                           "slo_p99_ms": float(slo_p99_ms),
+                           "slo_window_s": float(slo_window_s),
+                           "slo_burn": float(slo_burn)}
+        self.access_dir = str(access_log or "")
+        if self.access_dir:
+            os.makedirs(self.access_dir, exist_ok=True)
         self._spec = {
             "fleet_dir": self.dir, "host": self.host,
             "shared_port": self.port, "reuseport": mode == "reuseport",
@@ -356,6 +397,15 @@ class ServingFleet:
             "queue_size": int(queue_size), "buckets": str(buckets_spec),
             "warmup": bool(warmup), "deadline_ms": self.deadline_ms,
             "poll_s": _BEAT_S, "cache_dir": "/tmp/lgb_tpu_jax_cache",
+            "trace_sample": self.trace_sample,
+            "trace_tail": int(trace_tail),
+            "access_log_dir": self.access_dir,
+            # a private tmpdir is rmtree'd on stop — exporting trace
+            # shards into it would be wasted work destroyed moments
+            # later; set serve_fleet_dir to keep shards for the
+            # collector (docs/OBSERVABILITY.md)
+            "ephemeral_dir": self._own_dir,
+            **self.slo_params,
         }
         self._spec_path = os.path.join(self.dir, "replica_spec.json")
         # atomic: a replica that races the supervisor must never read a
@@ -526,7 +576,16 @@ class ServingFleet:
                 retry_backoff_ms=self.retry_backoff_ms,
                 breaker_failures=self.breaker_failures,
                 breaker_cooldown_s=self.breaker_cooldown_s,
-                deadline_ms=self.deadline_ms).start()
+                deadline_ms=self.deadline_ms,
+                trace_sample=self.trace_sample,
+                trace_tail=int(self._spec["trace_tail"]),
+                access_log=(os.path.join(self.access_dir,
+                                         "access_front.jsonl")
+                            if self.access_dir else ""),
+                slo_availability=self.slo_params["slo_availability"],
+                slo_p99_ms=self.slo_params["slo_p99_ms"],
+                slo_window_s=self.slo_params["slo_window_s"],
+                slo_burn=self.slo_params["slo_burn"]).start()
             self.port = self.front.port
         else:
             self.port = int(self._spec["shared_port"])
@@ -624,11 +683,23 @@ class ServingFleet:
                 "restarts_total": total, "dir": self.dir}
 
     def stop(self, timeout_s: float = 30.0) -> None:
+        from .. import telemetry
+
         self._stop.set()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(5.0)
         if self.front is not None:
             self.front.stop()
+        if telemetry.global_tracer.enabled and not self._own_dir:
+            # this process's shard (front routing + supervisor events);
+            # replicas export theirs during their SIGTERM drain below.
+            # A private tmpdir fleet is skipped — it is rmtree'd at the
+            # end of this method; set serve_fleet_dir to collect shards
+            try:
+                telemetry.export_trace(
+                    os.path.join(self.dir, "trace_front.json"))
+            except OSError as e:
+                log_debug(f"fleet front trace export failed: {e}")
         with self._lock:
             procs = dict(self._procs)
         for proc in procs.values():
@@ -669,7 +740,14 @@ def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
         breaker_failures=cfg.serve_breaker_failures,
         breaker_cooldown_s=cfg.serve_breaker_cooldown_s,
         restart_backoff_s=cfg.serve_restart_backoff_s,
-        hang_timeout_s=cfg.serve_hang_timeout_s)
+        hang_timeout_s=cfg.serve_hang_timeout_s,
+        trace_sample=cfg.serve_trace_sample,
+        trace_tail=cfg.serve_trace_tail,
+        access_log=cfg.serve_access_log,
+        slo_availability=cfg.serve_slo_availability,
+        slo_p99_ms=cfg.serve_slo_p99_ms,
+        slo_window_s=cfg.serve_slo_window_s,
+        slo_burn=cfg.serve_slo_burn)
 
 
 def run_fleet(params: Dict[str, Any]) -> int:

@@ -49,14 +49,16 @@ _FALLBACK_BUDGET_S = 30.0  # budget when neither client nor config set one
 
 def http_json(host: str, port: int, method: str, path: str,
               obj: Optional[Dict[str, Any]] = None,
-              timeout: float = 10.0
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None
               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
     """One JSON request; raises OSError-family on transport failure."""
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
     try:
         body = json.dumps(obj) if obj is not None else None
-        conn.request(method, path, body,
-                     {"Content-Type": "application/json"} if body else {})
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        hdrs.update(headers or {})
+        conn.request(method, path, body, hdrs)
         r = conn.getresponse()
         raw = r.read()
         try:
@@ -153,7 +155,13 @@ class FanoutFront:
     def __init__(self, fleet, *, host: str = "127.0.0.1", port: int = 0,
                  retries: int = 2, retry_backoff_ms: float = 25.0,
                  breaker_failures: int = 5, breaker_cooldown_s: float = 2.0,
-                 deadline_ms: float = 0.0):
+                 deadline_ms: float = 0.0, trace_sample: float = 0.01,
+                 trace_tail: int = 256, access_log: str = "",
+                 slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
+                 slo_window_s: float = 60.0, slo_burn: float = 14.4):
+        from ..telemetry import AccessLog, TailRing
+        from .slo import SLOMonitor
+
         self.fleet = fleet
         self.retries = max(int(retries), 0)
         self.retry_backoff_s = max(float(retry_backoff_ms), 0.0) / 1e3
@@ -168,6 +176,17 @@ class FanoutFront:
         self.shed = 0
         self.retried = 0
         self.forwarded = 0
+        # fleet-edge observability: the front mints the trace id + head-
+        # sampling decision for every request entering the fleet, owns
+        # the client-visible SLO monitor (the only place that sees final
+        # outcomes across retries), the access log, and the tail ring
+        self.trace_sample = max(float(trace_sample), 0.0)
+        self.tail = TailRing(trace_tail)
+        self.access_log = AccessLog(access_log) if access_log else None
+        self.slo = SLOMonitor(availability_target=slo_availability,
+                              p99_target_ms=slo_p99_ms,
+                              window_s=slo_window_s,
+                              burn_threshold=slo_burn)
         self._rng = random.Random(0xF407)
         self._stop = threading.Event()
         self._httpd = ThreadingHTTPServer((host, int(port)), _FrontHandler)
@@ -205,6 +224,8 @@ class FanoutFront:
         for t in self._threads:
             if t.is_alive():
                 t.join(5.0)
+        if self.access_log is not None:
+            self.access_log.close()
 
     def breaker(self, rank: int) -> CircuitBreaker:
         with self._lock:
@@ -242,6 +263,9 @@ class FanoutFront:
             telemetry.gauge("fleet/replicas_ready",
                             float(sum(1 for o in snapshot.values()
                                       if o.get("ready"))))
+            # the poll loop doubles as the SLO heartbeat: burn gauges
+            # stay fresh and alerts CLEAR even when traffic goes idle
+            self.slo.tick()
             if self._stop.wait(_READY_POLL_S):
                 break
 
@@ -263,26 +287,87 @@ class FanoutFront:
         return [(r, eps[r]) for r in ranks[start:] + ranks[:start]]
 
     # -- request handling --------------------------------------------------
-    def handle_predict(self, body: Dict[str, Any]
+    def handle_predict(self, body: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None
                        ) -> Tuple[int, Dict[str, Any],
                                   Optional[Dict[str, str]]]:
+        """Route one client request.  The front is where a request's
+        trace context is born (or accepted from the client's
+        ``X-LGBTPU-Trace`` header) and where its FINAL outcome — across
+        all retries — is judged against the SLO and logged."""
         from .. import telemetry
 
         t0 = time.perf_counter()
+        want = telemetry.TRACE_HEADER.lower()
+        hval = next((v for k, v in (headers or {}).items()
+                     if k.lower() == want), None)
+        ctx = telemetry.TraceContext.from_header(hval)
+        if ctx is None:
+            ctx = telemetry.TraceContext.mint(self.trace_sample)
         try:
             budget_ms = float(body.get("deadline_ms",
                                        self.deadline_ms) or 0.0)
         except (TypeError, ValueError):
-            return 400, {"error": "deadline_ms must be a number"}, None
+            budget_ms = 0.0
+            code, obj, hdrs = 400, {"error": "deadline_ms must be "
+                                             "a number"}, None
+        else:
+            with telemetry.request_span(ctx, "front/request"):
+                code, obj, hdrs = self._route_predict(body, ctx, t0,
+                                                      budget_ms)
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        obj.setdefault("trace_id", ctx.trace_id)
+        hdrs = dict(hdrs or {})
+        hdrs[telemetry.TRACE_HEADER] = ctx.header_value()
+        self._note_outcome(ctx, code, obj, latency_ms, budget_ms)
+        return code, obj, hdrs
+
+    # shed reasons that mean "the fleet could not be reached", not "the
+    # fleet chose to shed": these burn the AVAILABILITY budget (recorded
+    # as 599 against the SLO — the client still sees an honest 503)
+    _OUTAGE_REASONS = ("no_ready_replicas", "retries_exhausted")
+
+    def _note_outcome(self, ctx, code: int, obj: Dict[str, Any],
+                      latency_ms: float, deadline_ms: float) -> None:
+        from ..telemetry.context import note_outcome
+
+        slo_status = None
+        if code == 503:
+            reason = str(obj.get("reason", ""))
+            if (reason in self._OUTAGE_REASONS
+                    or "unreachable" in reason):
+                slo_status = 599
+        note_outcome(ctx=ctx, status=code, latency_ms=latency_ms,
+                     deadline_ms=deadline_ms, obj=obj, slo=self.slo,
+                     tail=self.tail, access_log=self.access_log,
+                     retries=max(int(obj.get("attempts", 1)) - 1, 0),
+                     extra={"replica": obj.get("replica")},
+                     slo_status=slo_status)
+
+    def _route_predict(self, body: Dict[str, Any], ctx, t0: float,
+                       budget_ms: float
+                       ) -> Tuple[int, Dict[str, Any],
+                                  Optional[Dict[str, str]]]:
+        from .. import telemetry
+
         budget_s = budget_ms / 1e3 if budget_ms > 0 else _FALLBACK_BUDGET_S
         deadline = t0 + budget_s
         attempts = self.retries + 1
         last: Optional[Tuple[int, Dict[str, Any]]] = None
         retry_after = 0.5
+        tried = 0      # attempts actually forwarded — every outcome
+        #                (success, shed, pass-through) reports it, so the
+        #                access log's retry count is honest for failures
+
+        def shed(reason: str, retry_after_s: float):
+            code, obj, hdrs = self._shed(reason, retry_after_s)
+            obj["attempts"] = tried
+            return code, obj, hdrs
+
         for attempt in range(attempts):
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
-                return self._shed("deadline_expired", 0.0)
+                return shed("deadline_expired", 0.0)
             picked = None
             for rank, ep in self._candidates():
                 # allow() claims the half-open probe slot; only the
@@ -291,15 +376,20 @@ class FanoutFront:
                     picked = (rank, ep)
                     break
             if picked is None:
-                return self._shed("no_ready_replicas", retry_after)
+                return shed("no_ready_replicas", retry_after)
             rank, ep = picked
+            tried = attempt + 1
             per_try = max(remaining / (attempts - attempt), _MIN_TRY_S)
             fwd = dict(body)
             fwd["deadline_ms"] = per_try * 1e3
             br = self.breaker(rank)
+            telemetry.request_instant(ctx, "front/attempt",
+                                      attempt=attempt + 1, replica=rank)
             try:
-                st, obj, _ = http_json(ep["host"], ep["port"], "POST",
-                                       "/predict", fwd, timeout=per_try)
+                st, obj, _ = http_json(
+                    ep["host"], ep["port"], "POST", "/predict", fwd,
+                    timeout=per_try,
+                    headers={telemetry.TRACE_HEADER: ctx.header_value()})
             except (OSError, http.client.HTTPException,
                     ConnectionError) as e:
                 # killed replica -> reset; hung replica -> timeout: both
@@ -308,6 +398,8 @@ class FanoutFront:
                 br.record_failure()
                 if br.trips > trips0:
                     telemetry.inc("fleet/breaker_trips")
+                    telemetry.request_instant(ctx, "front/breaker_trip",
+                                              replica=rank)
                 last = (503, {"error": "overload",
                               "reason": f"replica {rank} unreachable: "
                                         f"{type(e).__name__}"})
@@ -320,6 +412,8 @@ class FanoutFront:
                     br.record_failure()
                     if br.trips > trips0:
                         telemetry.inc("fleet/breaker_trips")
+                        telemetry.request_instant(ctx, "front/breaker_trip",
+                                                  replica=rank)
                     last = (st, obj)
                 else:
                     # ANY prompt response proves the replica is alive —
@@ -336,6 +430,7 @@ class FanoutFront:
                     if st != 503:
                         # client errors (400/404/409) are not the
                         # replica's fault: pass through, never retry
+                        obj.setdefault("attempts", tried)
                         return st, obj, None
                     # overload/deadline shed: try a sibling
                     retry_after = float(obj.get("retry_after_s",
@@ -345,6 +440,9 @@ class FanoutFront:
                 with self._lock:
                     self.retried += 1
                 telemetry.inc("fleet/retries")
+                telemetry.request_instant(ctx, "front/retry",
+                                          attempt=attempt + 1,
+                                          replica=rank)
                 backoff = self.retry_backoff_s * (2 ** attempt) \
                     * (0.5 + self._rng.random())
                 backoff = min(backoff,
@@ -352,11 +450,11 @@ class FanoutFront:
                 if backoff > 0:
                     time.sleep(backoff)
         if last is not None and last[0] == 503:
-            return self._shed(str(last[1].get("reason",
-                                              last[1].get("error",
-                                                          "overload"))),
-                              retry_after)
-        return self._shed("retries_exhausted", retry_after)
+            return shed(str(last[1].get("reason",
+                                        last[1].get("error",
+                                                    "overload"))),
+                        retry_after)
+        return shed("retries_exhausted", retry_after)
 
     def _shed(self, reason: str, retry_after_s: float
               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
@@ -408,7 +506,37 @@ class FanoutFront:
                 "replicas": {str(r): {k: v for k, v in st.items()
                                       if not k.startswith("_")}
                              for r, st in sorted(ready.items())},
+                "slo": self.slo.state(),
+                "trace_tail": self.tail.snapshot(last=20),
+                "trace_sample": self.trace_sample,
                 "fleet": self.fleet.describe(states=cached)}
+
+    def metrics_text(self, fleet_scope: bool = False) -> str:
+        """Prometheus exposition for this process (front + supervisor
+        share it), optionally aggregating every reachable replica's
+        registry snapshot under ``replica="<r>"`` labels.
+
+        The aggregate fans out one ``/metrics?format=json`` scrape per
+        live replica with a short timeout — scrape cadence is tens of
+        seconds, so unlike ``/stats`` this path accepts N blocking
+        probes in exchange for a single-scrape fleet view."""
+        from ..telemetry import global_registry
+        from ..telemetry.prometheus import render_parts
+
+        parts: List[Tuple[Dict[str, str], Dict[str, Any]]] = [
+            ({"role": "front"}, global_registry.snapshot())]
+        if fleet_scope:
+            for rank, ep in sorted(self.fleet.endpoints().items()):
+                try:
+                    st, snap, _ = http_json(ep["host"], ep["port"], "GET",
+                                            "/metrics?format=json",
+                                            timeout=_READY_TIMEOUT_S)
+                except (OSError, http.client.HTTPException):
+                    continue
+                if st == 200 and isinstance(snap, dict):
+                    parts.append(({"role": "replica",
+                                   "replica": str(rank)}, snap))
+        return render_parts(parts)
 
     def ready_payload(self) -> Tuple[int, Dict[str, Any]]:
         with self._lock:
@@ -475,6 +603,16 @@ class _FrontHandler(BaseHTTPRequestHandler):
             self._send(*self.front.ready_payload())
         elif path == "/stats":
             self._send(200, self.front.describe())
+        elif path in ("/metrics", "/metrics/fleet"):
+            from ..telemetry.prometheus import CONTENT_TYPE
+            body = self.front.metrics_text(
+                fleet_scope=path.endswith("/fleet"))
+            raw = body.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
         else:
             self._send(404, {"error": f"unknown path {self.path!r}"})
 
@@ -484,7 +622,8 @@ class _FrontHandler(BaseHTTPRequestHandler):
         try:
             body = self._read_json()
             if path == "/predict":
-                code, obj, headers = self.front.handle_predict(body)
+                code, obj, headers = self.front.handle_predict(
+                    body, dict(self.headers))
             elif path == "/reload":
                 code, obj = self.front.handle_reload(body)
             else:

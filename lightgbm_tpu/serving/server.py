@@ -21,7 +21,21 @@ Endpoints:
                      keeps serving.
   ``GET  /stats``    latency/queue-depth percentiles from the telemetry
                      registry, request counters, recompile watchdog
-                     counts, model + registry info.
+                     counts, model + registry info, SLO burn state and
+                     the tail-capture ring.
+  ``GET  /metrics``  Prometheus text exposition of the process metrics
+                     registry (counters/gauges/cumulative-bucket
+                     histograms); ``?format=json`` returns the raw
+                     snapshot (what the fleet aggregate scrapes).
+
+Distributed tracing (docs/OBSERVABILITY.md "Serving observability"): a
+``/predict`` request carries its trace context in the ``X-LGBTPU-Trace``
+header — accepted from the front (which minted the id and the
+head-sampling decision) or minted here for direct clients.  Sampled
+requests emit spans through admission -> batcher queue wait -> device
+dispatch; errored and SLO-violating requests are tail-captured into a
+bounded ring regardless of sampling; every request can be access-logged
+as JSONL (``serve_access_log``).
 
 Request resilience (docs/SERVING.md "Fleet architecture"): a ``/predict``
 body may carry ``deadline_ms`` — the client's remaining budget.  The
@@ -97,7 +111,13 @@ class ServingApp:
                  max_delay_ms: float = 2.0, queue_size: int = 512,
                  buckets_spec: str = "", warmup: bool = True,
                  heartbeat_path: str = "", deadline_ms: float = 0.0,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False, trace_sample: float = 0.01,
+                 trace_tail: int = 256, access_log: str = "",
+                 slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
+                 slo_window_s: float = 60.0, slo_burn: float = 14.4):
+        from ..telemetry import AccessLog, TailRing
+        from .slo import SLOMonitor
+
         self.registry = ModelRegistry(model_path, max_batch=max_batch,
                                       buckets_spec=buckets_spec,
                                       warmup=warmup)
@@ -125,6 +145,22 @@ class ServingApp:
         # pointer so ANY replica's reload is fleet-wide; standalone
         # servers keep the registry-local swap
         self.promote_fn = None
+        # request observability (docs/OBSERVABILITY.md "Serving
+        # observability"): head-sampled trace spans, tail capture of
+        # errored/SLO-violating requests, JSONL access log, and the
+        # error-budget burn monitor feeding /ready + /metrics
+        self.trace_sample = max(float(trace_sample), 0.0)
+        self.tail = TailRing(trace_tail)
+        self.access_log = AccessLog(access_log) if access_log else None
+        self.slo = SLOMonitor(availability_target=slo_availability,
+                              p99_target_ms=slo_p99_ms,
+                              window_s=slo_window_s,
+                              burn_threshold=slo_burn)
+        # the SLO ticker runs on its own loop (not per-request) so an
+        # alert also CLEARS while the replica is idle — e.g. when the
+        # front stopped routing here because of the very burn that fired
+        self._slo_stop = threading.Event()
+        self._slo_thread: Optional[threading.Thread] = None
         self.t0 = time.time()
 
     @property
@@ -139,9 +175,17 @@ class ServingApp:
     def draining(self) -> bool:
         return self._draining
 
+    def _slo_loop(self) -> None:
+        while not self._slo_stop.wait(1.0):
+            self.slo.tick()
+
     def start(self) -> "ServingApp":
         """Non-blocking start (tests, embedding); ``run_server`` blocks."""
         self.batcher.start()
+        self._slo_thread = threading.Thread(target=self._slo_loop,
+                                            name="lgbtpu-serve-slo",
+                                            daemon=True)
+        self._slo_thread.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="lgbtpu-serve-http",
                                         daemon=True)
@@ -154,11 +198,33 @@ class ServingApp:
         """Stop accepting, drain the queue (unless ``drain=False``), stop
         the worker.  Idempotent."""
         self._draining = True
+        self._slo_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         self.batcher.stop(drain=drain)
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(5.0)
+        if self._slo_thread is not None and self._slo_thread.is_alive():
+            self._slo_thread.join(2.0)
+        if self.access_log is not None:
+            self.access_log.close()
+
+    def note_request(self, ctx, status: int, latency_ms: float,
+                     deadline_ms: float, obj: Dict[str, Any]) -> None:
+        """Per-request bookkeeping after the response is decided: SLO
+        outcome, access-log line, tail capture of the interesting ones.
+        Must never raise — it runs on the answer path."""
+        from ..telemetry.context import note_outcome
+
+        extra: Dict[str, Any] = {"rows": obj.get("batched_rows")}
+        if self.replica_rank is not None:
+            extra["replica"] = self.replica_rank
+        # replicas see single attempts (retries=0); the front stamps
+        # real retry counts in ITS log
+        note_outcome(ctx=ctx, status=status, latency_ms=latency_ms,
+                     deadline_ms=deadline_ms, obj=obj, slo=self.slo,
+                     tail=self.tail, access_log=self.access_log,
+                     extra=extra)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -180,6 +246,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
@@ -220,6 +294,20 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/stats":
             with telemetry.span("serve/stats"):
                 self._send(200, self._stats())
+        elif path == "/metrics":
+            # Prometheus text exposition of the process registry;
+            # ?format=json returns the raw snapshot (what the fleet
+            # aggregator scrapes to relabel under replica="<r>")
+            from ..telemetry.prometheus import CONTENT_TYPE, registry_text
+            query = self.path.partition("?")[2]
+            if "format=json" in query:
+                self._send(200, telemetry.global_registry.snapshot())
+            else:
+                labels = {}
+                if self.app.replica_rank is not None:
+                    labels["replica"] = str(self.app.replica_rank)
+                self._send_text(200, registry_text(labels=labels),
+                                CONTENT_TYPE)
         else:
             self._send(404, {"error": f"unknown path {self.path!r}"})
 
@@ -228,6 +316,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         path = self.path.split("?")[0]
         headers: Dict[str, str] = {}
+        ctx = None
+        t_req = time.perf_counter()
+        deadline_ms = 0.0
         try:
             # the body must be consumed on EVERY branch — HTTP/1.1
             # keep-alive leaves unread bytes in rfile and the next request
@@ -235,8 +326,23 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._read_json()
             chaos.request_hook()
             if path == "/predict":
-                with telemetry.span("serve/predict"):
-                    code, obj = self._predict(body)
+                # trace context: accept the front's (or client's) header,
+                # mint locally otherwise — the head-sampling decision is
+                # taken exactly once per request, at the outermost tier
+                ctx = telemetry.TraceContext.from_header(
+                    self.headers.get(telemetry.TRACE_HEADER))
+                if ctx is None:
+                    ctx = telemetry.TraceContext.mint(self.app.trace_sample)
+                try:
+                    deadline_ms = float(body.get("deadline_ms",
+                                                 self.app.deadline_ms)
+                                        or 0.0)
+                except (TypeError, ValueError):
+                    deadline_ms = 0.0
+                with telemetry.request_span(
+                        ctx, "serve/predict",
+                        replica=self.app.replica_rank):
+                    code, obj = self._predict(body, ctx)
             elif path == "/reload":
                 with telemetry.span("serve/reload"):
                     code, obj = self._reload(body)
@@ -260,9 +366,18 @@ class _Handler(BaseHTTPRequestHandler):
             code, obj = 503, {"error": "shutting down"}
         except Exception as e:  # noqa: BLE001 — serving must answer
             code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+        if ctx is not None:
+            obj.setdefault("trace_id", ctx.trace_id)
+            headers[telemetry.TRACE_HEADER] = ctx.header_value()
+            try:
+                self.app.note_request(
+                    ctx, code, (time.perf_counter() - t_req) * 1e3,
+                    deadline_ms, obj)
+            except Exception as e:  # noqa: BLE001 — never fail the answer
+                log_debug(f"serve note_request failed: {e}")
         self._send(code, obj, headers or None)
 
-    def _predict(self, body):
+    def _predict(self, body, ctx=None):
         app = self.app
         if app.draining:
             raise OverloadError(app.batcher.queue_depth(),
@@ -283,7 +398,7 @@ class _Handler(BaseHTTPRequestHandler):
         fut = app.batcher.submit(rows,
                                  raw_score=bool(body.get("raw_score", False)),
                                  fast=bool(body.get("fast", False)),
-                                 deadline=deadline)
+                                 deadline=deadline, trace=ctx)
         wait = _REQUEST_TIMEOUT_S if deadline is None else \
             max(deadline - time.perf_counter(), 0.0)
         try:
@@ -383,8 +498,20 @@ class _Handler(BaseHTTPRequestHandler):
             out["generation"] = app.generation
         if app.seen_generation is not None:
             out["seen_generation"] = app.seen_generation
+        # degraded reasons compose: a rejected promotion and a burning
+        # error budget are both "degraded but still serving" states —
+        # neither flips readiness (unrouting a replica because it is slow
+        # would finish the outage), both must be visible to the fleet
+        reasons = []
         if app.degraded:
-            out["degraded"] = app.degraded
+            reasons.append(app.degraded)
+        slo_state = app.slo.state()
+        if slo_state["alerting"]:
+            out["slo_alert"] = slo_state["alert"]
+            reasons.append(f"slo burn: {slo_state['alert']} error budget "
+                           f"burning >= {app.slo.burn_threshold:.1f}x")
+        if reasons:
+            out["degraded"] = "; ".join(reasons)
         if b.heartbeat_path:
             age = heartbeat_age(b.heartbeat_path)
             if age is not None:
@@ -412,6 +539,9 @@ class _Handler(BaseHTTPRequestHandler):
             "recompiles": {k: v for k, v in
                            telemetry.recompile_counts().items()
                            if k.startswith("serve")},
+            "slo": app.slo.state(),
+            "trace_tail": app.tail.snapshot(last=20),
+            "trace_sample": app.trace_sample,
         }
 
 
@@ -432,7 +562,14 @@ def serve_from_params(params: Dict[str, Any]) -> ServingApp:
         buckets_spec=cfg.serve_buckets,
         warmup=cfg.serve_warmup,
         heartbeat_path=cfg.serve_heartbeat,
-        deadline_ms=cfg.serve_deadline_ms)
+        deadline_ms=cfg.serve_deadline_ms,
+        trace_sample=cfg.serve_trace_sample,
+        trace_tail=cfg.serve_trace_tail,
+        access_log=cfg.serve_access_log,
+        slo_availability=cfg.serve_slo_availability,
+        slo_p99_ms=cfg.serve_slo_p99_ms,
+        slo_window_s=cfg.serve_slo_window_s,
+        slo_burn=cfg.serve_slo_burn)
 
 
 def run_server(params: Dict[str, Any]) -> int:

@@ -1,0 +1,216 @@
+"""SLO error-budget burn-rate monitoring for the serving tier.
+
+Two service-level objectives, each with its own error budget:
+
+  * **availability** — fraction of requests that do NOT fail with a
+    non-503 error (``serve_slo_availability``, default 99.9%).  A 503
+    shed is deliberate load management, not an outage, matching the
+    fleet chaos gate's "zero non-503 errors" contract;
+  * **latency** — fraction of 200 responses under the p99 target
+    (``serve_slo_p99_ms``; 0 disables the dimension).  The objective is
+    fixed at 99% — "p99 under X ms" IS the 99%-of-requests statement.
+
+Alerting follows the multi-window burn-rate recipe (Google SRE workbook
+ch. 5): the instantaneous **burn rate** is ``bad_fraction /
+error_budget`` — 1.0 means the budget is being consumed exactly at the
+rate that exhausts it at the window's end; 14.4 means 14.4x faster.  An
+alert FIRES only when BOTH the fast window (``serve_slo_window_s``) and
+the slow window (12x longer) exceed ``serve_slo_burn`` — the slow window
+keeps a single bad second from paging, the fast window makes the alert
+CLEAR quickly once the burn stops (recovery is judged on the fast window
+alone).  State transitions land in a bounded timeline (the chaos bench
+gates on fire-during-chaos + clear-after-recovery), in warning/info
+logs, and in four gauges the ``/metrics`` surface exports:
+``slo/availability_burn_fast``, ``slo/availability_burn_slow``,
+``slo/latency_burn_fast``, ``slo/latency_burn_slow`` plus
+``slo/alert``.
+
+The clock is injectable, so tests drive burn -> alert -> recovery
+deterministically; counts live in per-second buckets, so a record is
+O(1) and a window sum is O(window seconds).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import log_info, log_warning
+
+_SLOW_FACTOR = 12          # slow window = fast window x this
+_MIN_EVENTS = 10           # below this many requests in the fast
+#                            window, burn is not evidence (idle noise)
+_MAX_TIMELINE = 256        # bounded alert-transition history
+
+
+class _SecondBucket:
+    __slots__ = ("sec", "total", "avail_bad", "lat_total", "lat_bad")
+
+    def __init__(self, sec: int):
+        self.sec = sec
+        self.total = 0
+        self.avail_bad = 0
+        self.lat_total = 0
+        self.lat_bad = 0
+
+
+class SLOMonitor:
+    """Multi-window burn-rate monitor over per-second outcome buckets."""
+
+    def __init__(self, *, availability_target: float = 0.999,
+                 p99_target_ms: float = 0.0, window_s: float = 60.0,
+                 burn_threshold: float = 14.4, clock=time.monotonic,
+                 min_events: int = _MIN_EVENTS,
+                 slow_factor: float = _SLOW_FACTOR):
+        if not 0.0 < availability_target < 1.0:
+            raise ValueError("availability_target must be in (0, 1), got "
+                             f"{availability_target}")
+        self.availability_target = float(availability_target)
+        self.p99_target_ms = max(float(p99_target_ms), 0.0)
+        self.window_s = max(float(window_s), 1.0)
+        self.burn_threshold = max(float(burn_threshold), 0.1)
+        self.min_events = max(int(min_events), 1)
+        # compressed-timescale harnesses (the chaos bench) shrink the
+        # slow window; production keeps the 12x SRE-workbook pairing
+        self.slow_factor = max(float(slow_factor), 1.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: "deque[_SecondBucket]" = deque()
+        self._alert: Optional[str] = None     # alerting dimension(s)
+        self._timeline: List[Dict[str, Any]] = []
+        self.fired = 0
+        self.cleared = 0
+
+    # -- recording ---------------------------------------------------------
+    def record(self, status: int, latency_ms: float) -> None:
+        """One finished request: its HTTP status and client-side latency.
+        Transport-level failures should be recorded as status 599."""
+        sec = int(self._clock())
+        avail_bad = status >= 500 and status != 503
+        is_200 = status == 200
+        lat_bad = (is_200 and self.p99_target_ms > 0
+                   and latency_ms > self.p99_target_ms)
+        with self._lock:
+            b = self._buckets[-1] if self._buckets else None
+            if b is None or b.sec != sec:
+                b = _SecondBucket(sec)
+                self._buckets.append(b)
+                self._trim_locked(sec)
+            b.total += 1
+            b.avail_bad += int(avail_bad)
+            b.lat_total += int(is_200)
+            b.lat_bad += int(lat_bad)
+
+    def _trim_locked(self, now_sec: int) -> None:
+        horizon = now_sec - int(self.window_s * self.slow_factor) - 1
+        while self._buckets and self._buckets[0].sec < horizon:
+            self._buckets.popleft()
+
+    # -- evaluation --------------------------------------------------------
+    def _window_burn(self, now: float, span_s: float
+                     ) -> Dict[str, float]:
+        lo = int(now) - int(span_s)
+        total = avail_bad = lat_total = lat_bad = 0
+        with self._lock:
+            for b in self._buckets:
+                if b.sec > lo:
+                    total += b.total
+                    avail_bad += b.avail_bad
+                    lat_total += b.lat_total
+                    lat_bad += b.lat_bad
+        avail_budget = 1.0 - self.availability_target
+        out = {"total": float(total)}
+        out["availability"] = (
+            (avail_bad / total) / avail_budget if total else 0.0)
+        out["latency"] = (
+            (lat_bad / lat_total) / 0.01
+            if (lat_total and self.p99_target_ms > 0) else 0.0)
+        return out
+
+    def burn(self) -> Dict[str, Dict[str, float]]:
+        """Current burn rates: {dimension: {fast, slow}}."""
+        now = self._clock()
+        fast = self._window_burn(now, self.window_s)
+        slow = self._window_burn(now, self.window_s * self.slow_factor)
+        return {
+            "availability": {"fast": round(fast["availability"], 3),
+                             "slow": round(slow["availability"], 3)},
+            "latency": {"fast": round(fast["latency"], 3),
+                        "slow": round(slow["latency"], 3)},
+            "fast_window_events": int(fast["total"]),
+        }
+
+    def tick(self) -> Dict[str, Any]:
+        """Evaluate the state machine; call per record batch or on a
+        poll loop so alerts also CLEAR while traffic is idle."""
+        from .. import telemetry
+
+        b = self.burn()
+        thr = self.burn_threshold
+        enough = b["fast_window_events"] >= self.min_events
+        burning = sorted(
+            dim for dim in ("availability", "latency")
+            if enough and b[dim]["fast"] >= thr and b[dim]["slow"] >= thr)
+        # recovery is judged on the fast window alone: once the recent
+        # window is healthy the page stops, even while the slow window
+        # still remembers the incident
+        still = sorted(dim for dim in ("availability", "latency")
+                       if b[dim]["fast"] >= thr)
+        with self._lock:
+            alert = self._alert
+            if alert is None and burning:
+                self._alert = alert = "+".join(burning)
+                self.fired += 1
+                event = {"t": round(self._clock(), 3), "kind": "fire",
+                         "dimensions": alert, "burn": b}
+                self._timeline.append(event)
+                del self._timeline[:-_MAX_TIMELINE]
+                fired = True
+                cleared = False
+            elif alert is not None and not still:
+                event = {"t": round(self._clock(), 3), "kind": "clear",
+                         "dimensions": alert, "burn": b}
+                self._timeline.append(event)
+                del self._timeline[:-_MAX_TIMELINE]
+                self._alert = None
+                self.cleared += 1
+                fired = False
+                cleared = True
+                alert = None
+            else:
+                fired = cleared = False
+        telemetry.gauge("slo/availability_burn_fast",
+                        b["availability"]["fast"])
+        telemetry.gauge("slo/availability_burn_slow",
+                        b["availability"]["slow"])
+        telemetry.gauge("slo/latency_burn_fast", b["latency"]["fast"])
+        telemetry.gauge("slo/latency_burn_slow", b["latency"]["slow"])
+        telemetry.gauge("slo/alert", 1.0 if alert else 0.0)
+        if fired:
+            log_warning(
+                f"SLO burn alert: {event['dimensions']} error budget "
+                f"burning at >= {thr:.1f}x (fast/slow windows "
+                f"{self.window_s:.0f}s/{self.window_s * self.slow_factor:.0f}s"
+                f"; burn {b})")
+        elif cleared:
+            log_info(f"SLO burn alert cleared ({event['dimensions']}); "
+                     f"burn {b}")
+        return {"alert": alert, "burn": b}
+
+    # -- introspection -----------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            alert = self._alert
+            fired, cleared = self.fired, self.cleared
+        return {"alert": alert, "alerting": alert is not None,
+                "fired": fired, "cleared": cleared,
+                "availability_target": self.availability_target,
+                "p99_target_ms": self.p99_target_ms,
+                "window_s": self.window_s,
+                "burn_threshold": self.burn_threshold,
+                "burn": self.burn()}
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._timeline)

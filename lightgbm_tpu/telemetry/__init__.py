@@ -24,8 +24,12 @@ from __future__ import annotations
 import atexit
 from typing import Any, Dict, Optional
 
+from .context import (TRACE_HEADER, AccessLog, TailRing, TraceContext,
+                      new_trace_id, request_complete, request_instant,
+                      request_span)
 from .metrics import (MetricsRegistry, device_memory_gb, global_registry,
                       host_rss_gb, memory_snapshot)
+from .prometheus import registry_text, render_parts, render_prometheus
 from .tracer import SpanTracer, global_tracer
 from .watchdog import (WatchEntry, get_recompile_threshold, host_sync_count,
                        launch_count, note_host_sync, note_launch,
@@ -43,6 +47,9 @@ __all__ = [
     "set_recompile_threshold", "get_recompile_threshold", "reset_watchdog",
     "launch_count", "host_sync_count", "note_host_sync", "note_launch",
     "memory_snapshot", "device_memory_gb", "host_rss_gb",
+    "TraceContext", "TailRing", "AccessLog", "TRACE_HEADER",
+    "new_trace_id", "request_span", "request_complete", "request_instant",
+    "render_prometheus", "render_parts", "registry_text",
 ]
 
 _trace_out: Optional[str] = None
@@ -150,6 +157,9 @@ def summary() -> Dict[str, Any]:
                                       key=lambda kv: -kv[1])},
         "recompiles": watchdog_summary(),
         "memory": memory_snapshot(),
+        # events the bounded span buffer had to drop (the tracer warns
+        # once when this first goes nonzero)
+        "trace_dropped_events": global_tracer.dropped,
     }
     if global_registry.sink_path:
         out["telemetry_out"] = global_registry.sink_path

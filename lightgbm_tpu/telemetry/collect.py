@@ -1,0 +1,189 @@
+"""Cross-process trace collector: merge per-process shards onto one timeline.
+
+Every process (fleet front/supervisor, each serving replica, a training
+run) exports its own Chrome-trace shard with event timestamps relative to
+its OWN ``perf_counter`` epoch — meaningless across processes.  Each
+shard also carries the wall-clock anchor (``clock_sync``: the
+``time.time()`` captured at the same instant as that epoch), which is the
+one piece of shared truth.  This module shifts every shard onto the
+earliest shard's clock and emits ONE Perfetto-loadable file, so a single
+request's spans — front routing, replica admission, batcher queue wait,
+device dispatch — line up on one timeline.
+
+CLI::
+
+    python -m lightgbm_tpu.telemetry.collect FLEET_DIR -o merged.json
+    python -m lightgbm_tpu.telemetry.collect trace_front.json \
+        trace_replica_*.json -o merged.json --trace-id 4f2a...
+
+A directory argument collects every ``trace*.json`` inside it.
+``--trace-id`` keeps only the named request's events (plus process
+metadata) — the single-request drill-down view.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _find_anchor(blob: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    anchor = (blob.get("otherData") or {}).get("clock_sync")
+    if isinstance(anchor, dict) and "unix_time_s" in anchor:
+        return anchor
+    for ev in blob.get("traceEvents", []):
+        if ev.get("name") == "clock_sync":
+            args = ev.get("args") or {}
+            if "unix_time_s" in args:
+                return args
+    return None
+
+
+def _event_matches(ev: Dict[str, Any], trace_id: str) -> bool:
+    args = ev.get("args") or {}
+    if args.get("trace_id") == trace_id:
+        return True
+    ids = args.get("trace_ids")
+    return isinstance(ids, (list, tuple)) and trace_id in ids
+
+
+def merge_traces(paths: Sequence[str], trace_id: Optional[str] = None
+                 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Merge shard files; returns ``(merged_blob, summary)``.
+
+    Shards without a clock anchor (pre-anchor exports) are kept at
+    offset 0 and reported in the summary — their events render but are
+    NOT aligned."""
+    shards: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise RuntimeError(f"cannot read trace shard {path!r}: {e}")
+        shards.append({"path": path, "blob": blob,
+                       "anchor": _find_anchor(blob)})
+    if not shards:
+        raise RuntimeError("no trace shards to merge")
+    anchored = [s for s in shards if s["anchor"] is not None]
+    base_unix = min(s["anchor"]["unix_time_s"] for s in anchored) \
+        if anchored else 0.0
+
+    meta: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    seen_pids: Dict[int, str] = {}
+    shard_summaries = []
+    for i, shard in enumerate(shards):
+        anchor = shard["anchor"]
+        offset_us = ((anchor["unix_time_s"] - base_unix) * 1e6
+                     if anchor else 0.0)
+        # two shards claiming one pid (pid reuse after a replica restart)
+        # would interleave into one Perfetto track; remap the later shard
+        pid_map: Dict[int, int] = {}
+        n_events = 0
+        for ev in shard["blob"].get("traceEvents", []):
+            ev = dict(ev)
+            pid = ev.get("pid")
+            if isinstance(pid, int):
+                if pid not in pid_map:
+                    owner = seen_pids.get(pid)
+                    if owner is not None and owner != shard["path"]:
+                        mapped = pid + 1_000_000 * (i + 1)
+                    else:
+                        seen_pids[pid] = shard["path"]
+                        mapped = pid
+                    pid_map[pid] = mapped
+                ev["pid"] = pid_map[pid]
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + offset_us
+            if ev.get("ph") == "M":
+                meta.append(ev)
+                continue
+            if trace_id is not None and not _event_matches(ev, trace_id):
+                continue
+            events.append(ev)
+            n_events += 1
+        shard_summaries.append({
+            "path": shard["path"],
+            "aligned": anchor is not None,
+            "offset_ms": round(offset_us / 1e3, 3),
+            "replica_rank": (anchor or {}).get("replica_rank"),
+            "events": n_events,
+        })
+    events.sort(key=lambda ev: ev.get("ts", 0.0))
+    blob = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "lightgbm_tpu.telemetry.collect",
+            "base_unix_s": base_unix,
+            "trace_id_filter": trace_id,
+            "shards": shard_summaries,
+        },
+    }
+    summary = {
+        "shards": len(shards),
+        "unaligned_shards": [s["path"] for s in shards
+                             if s["anchor"] is None],
+        "events": len(events),
+        "span_ms": round((events[-1]["ts"] - events[0]["ts"]) / 1e3, 3)
+        if len(events) > 1 else 0.0,
+        "processes": sorted({ev["pid"] for ev in events
+                             if isinstance(ev.get("pid"), int)}),
+    }
+    return blob, summary
+
+
+def _expand(inputs: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for item in inputs:
+        if os.path.isdir(item):
+            out.extend(sorted(glob.glob(os.path.join(item, "trace*.json"))))
+        else:
+            out.append(item)
+    return out
+
+
+def write_merged(blob: Dict[str, Any], path: str) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(blob, fh, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.telemetry.collect",
+        description="Merge per-process trace shards onto one wall-clock-"
+                    "aligned Perfetto timeline.")
+    ap.add_argument("inputs", nargs="+",
+                    help="shard files, or directories holding trace*.json")
+    ap.add_argument("-o", "--output", default="merged_trace.json",
+                    help="merged trace path (default merged_trace.json)")
+    ap.add_argument("--trace-id", default=None,
+                    help="keep only this request's events")
+    args = ap.parse_args(argv)
+    paths = _expand(args.inputs)
+    if not paths:
+        print("collect: no trace shards found", file=sys.stderr)
+        return 1
+    try:
+        blob, summary = merge_traces(paths, trace_id=args.trace_id)
+    except RuntimeError as e:
+        print(f"collect: {e}", file=sys.stderr)
+        return 1
+    write_merged(blob, args.output)
+    print(json.dumps({"output": args.output, **summary}))
+    for warn in summary["unaligned_shards"]:
+        print(f"collect: WARNING shard {warn} has no clock_sync anchor — "
+              "kept at offset 0 (re-export with a current tracer)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Distributed request-trace context for the serving fleet.
+
+One request entering the fleet gets ONE trace id — minted at the front
+(or accepted from the client) and propagated via the ``X-LGBTPU-Trace``
+header through front routing/retry/breaker events, replica admission,
+batcher queue wait, batch assembly, and the device dispatch.  Every
+process stamps its spans with the trace id, and the cross-process
+collector (:mod:`.collect`) merges the per-process shards onto one
+wall-clock-aligned timeline.
+
+Three sampling/capture surfaces live here:
+
+  * **head sampling** — the routing tier decides ONCE per request
+    (probability ``serve_trace_sample``) whether its spans are recorded;
+    the decision rides in the header (``s=0|1``) so every downstream
+    process agrees without coordination.  The disabled path is one
+    boolean check, so default-rate tracing does not tax the hot path;
+  * **tail capture** — errored and SLO-violating requests are captured
+    into a bounded ring (:class:`TailRing`) REGARDLESS of the head
+    decision: the interesting 0.1% is exactly what a 1% head sample
+    would usually miss.  The ring holds compact outcome records (not
+    full span trees — those cannot be reconstructed after the fact);
+  * **access log** — an append-only JSONL stream (:class:`AccessLog`),
+    one line per request with the audit fields (trace_id, outcome,
+    latency, deadline, retries, model_sha256).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from .tracer import _NULL_SPAN, global_tracer
+
+TRACE_HEADER = "X-LGBTPU-Trace"
+
+# sampling RNG: an owned, seeded instance (never the np.random global
+# stream — lgbtlint LGB004); the fixed seed makes a replica's sampling
+# pattern reproducible, which is a feature for debugging, and the pid
+# fold keeps fleet replicas from sampling the same request positions
+_rng = random.Random(0x7EACE ^ os.getpid())
+
+
+def new_trace_id() -> str:
+    """16 hex chars of process-independent randomness."""
+    return os.urandom(8).hex()
+
+
+@dataclass
+class TraceContext:
+    """One request's identity: trace id + the head-sampling decision."""
+
+    trace_id: str
+    sampled: bool = False
+
+    def header_value(self) -> str:
+        return f"{self.trace_id};s={int(self.sampled)}"
+
+    @classmethod
+    def from_header(cls, value: Optional[str]) -> Optional["TraceContext"]:
+        """Parse ``<trace_id>[;s=0|1]``; None on absent/garbage (the
+        request then gets a locally minted context)."""
+        if not value:
+            return None
+        tid, _, opts = value.partition(";")
+        tid = tid.strip()
+        if not tid or len(tid) > 64 or not all(
+                c in "0123456789abcdefABCDEF-_" for c in tid):
+            return None
+        sampled = False
+        for tok in opts.split(";"):
+            key, _, val = tok.strip().partition("=")
+            if key == "s":
+                sampled = val.strip() == "1"
+        return cls(trace_id=tid, sampled=sampled)
+
+    @classmethod
+    def mint(cls, sample_rate: float) -> "TraceContext":
+        """New context with the head-sampling decision taken here."""
+        rate = max(float(sample_rate), 0.0)
+        return cls(trace_id=new_trace_id(),
+                   sampled=rate > 0 and _rng.random() < rate)
+
+
+def request_span(ctx: Optional[TraceContext], name: str, **args: Any):
+    """Span stamped with the request's trace id — records ONLY for
+    head-sampled requests (one boolean check otherwise), so per-request
+    span emission follows ``serve_trace_sample``, not the global tracer
+    switch alone."""
+    if ctx is None or not ctx.sampled or not global_tracer.enabled:
+        return _NULL_SPAN
+    return global_tracer.span(name, trace_id=ctx.trace_id, **args)
+
+
+def request_complete(ctx: Optional[TraceContext], name: str, start: float,
+                     duration: float, **args: Any) -> None:
+    """Cross-thread "X" event for a sampled request (queue wait)."""
+    if ctx is None or not ctx.sampled or not global_tracer.enabled:
+        return
+    global_tracer.complete(name, start, duration,
+                           trace_id=ctx.trace_id, **args)
+
+
+def request_instant(ctx: Optional[TraceContext], name: str,
+                    **args: Any) -> None:
+    """Point event for a sampled request (retry, breaker trip)."""
+    if ctx is None or not ctx.sampled or not global_tracer.enabled:
+        return
+    global_tracer.instant(name, trace_id=ctx.trace_id, **args)
+
+
+def note_outcome(*, ctx, status: int, latency_ms: float,
+                 deadline_ms: float, obj: Dict[str, Any], slo=None,
+                 tail=None, access_log=None, retries: int = 0,
+                 extra: Optional[Dict[str, Any]] = None,
+                 slo_status: Optional[int] = None) -> None:
+    """Shared per-request outcome bookkeeping (front AND replica run the
+    same flow, so the record schema cannot drift between tiers): SLO
+    sample, access-log line, tail capture of errored/SLO-slow requests.
+
+    ``slo_status`` lets the caller record a DIFFERENT status against the
+    SLO than the client saw (the front maps transport-exhausted sheds to
+    599 so a total outage burns the availability budget, while the
+    client still gets its honest 503 + Retry-After)."""
+    if slo is not None:
+        slo.record(status if slo_status is None else slo_status,
+                   latency_ms)
+    record: Dict[str, Any] = {
+        "trace_id": ctx.trace_id if ctx is not None else None,
+        "outcome": int(status),
+        "latency_ms": round(latency_ms, 3),
+        "deadline_ms": round(float(deadline_ms or 0.0), 3),
+        "retries": int(retries),
+        "model_sha256": obj.get("model_sha256"),
+        "reason": obj.get("reason") or (obj.get("error")
+                                        if status != 200 else None),
+    }
+    if extra:
+        record.update(extra)
+    if access_log is not None:
+        access_log.write(dict(record))
+    slow = (status == 200 and slo is not None and slo.p99_target_ms > 0
+            and latency_ms > slo.p99_target_ms)
+    if tail is not None and (status != 200 or slow):
+        record["captured"] = "error" if status != 200 else "slo_slow"
+        tail.add(record)
+
+
+class TailRing:
+    """Bounded ring of the requests worth keeping: errored or
+    SLO-violating.  Overwrites oldest-first; thread-safe; surfaced via
+    ``/stats``."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: "deque[Dict[str, Any]]" = deque(
+            maxlen=max(int(capacity), 1))
+        self._lock = threading.Lock()
+        self._captured = 0
+
+    def add(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(record)
+            self._captured += 1
+
+    def snapshot(self, last: Optional[int] = None) -> Dict[str, Any]:
+        with self._lock:
+            rows = list(self._ring)
+            captured = self._captured
+        if last is not None:
+            rows = rows[-int(last):]
+        return {"captured": captured, "capacity": self._ring.maxlen,
+                "recent": rows}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class AccessLog:
+    """Append-only JSONL request log (one line per finished request).
+
+    Append streams are crash-consistent by construction (a torn final
+    line is detectable, everything before it survives), mirroring the
+    metrics registry's JSONL sink.  Write failures disable the log
+    rather than failing requests."""
+
+    SCHEMA = ("ts", "trace_id", "outcome", "latency_ms", "deadline_ms",
+              "retries", "model_sha256")
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._dead = False
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._dead:
+            return
+        record.setdefault("ts", round(time.time(), 6))
+        with self._lock:
+            if self._fh is None:
+                try:
+                    self._fh = open(self.path, "a")
+                except OSError:
+                    self._dead = True
+                    return
+            try:
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
+            except (OSError, TypeError, ValueError):
+                self._dead = True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None

@@ -179,11 +179,16 @@ class MetricsRegistry:
             hists = {}
             for k, h in self._hists.items():
                 mean = h["sum"] / h["count"] if h["count"] else 0.0
+                # bounds + per-bucket counts ride along: any cumulative-
+                # bucket exporter (the Prometheus text endpoint) needs
+                # them, and the summary stats alone cannot rebuild them
                 hists[k] = {"count": h["count"],
                             "sum_s": round(h["sum"], 6),
                             "mean_s": round(mean, 6),
                             "min_s": round(h["min"], 6),
-                            "max_s": round(h["max"], 6)}
+                            "max_s": round(h["max"], 6),
+                            "bounds": list(h["bounds"]),
+                            "buckets": list(h["buckets"])}
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges),
                     "histograms": hists,

@@ -1,0 +1,146 @@
+"""Prometheus text-exposition rendering of the metrics registry.
+
+Turns :meth:`MetricsRegistry.snapshot` dicts into the Prometheus text
+format (version 0.0.4): ``# TYPE``-declared families, ``_total``-suffixed
+monotone counters, gauges, and histograms with CUMULATIVE ``le`` buckets
+plus ``_sum``/``_count`` — the standard scrape surface every collector
+(Prometheus, VictoriaMetrics, Grafana agent) understands.
+
+Naming: registry names are slash-paths (``serve/latency_s``); they map to
+``<prefix>_serve_latency_s`` with every non-``[a-zA-Z0-9_:]`` character
+folded to ``_``.  The one sanctioned dynamic-name family,
+``fleet/replica/<r>/<metric>``, is re-shaped into a LABELED series
+(``<prefix>_fleet_replica_<metric>{replica="<r>"}``) so per-replica
+cardinality lives in a label value, never in the metric-name space.
+
+``render_parts`` renders SEVERAL snapshots (the fleet aggregate: the
+supervisor/front's own registry plus every replica's scrape) under
+distinct label sets in ONE pass, so each family gets exactly one
+``# TYPE`` line — concatenating independent renders would be invalid
+exposition text.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_PREFIX = "lgbtpu"
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_REPLICA = re.compile(r"^fleet/replica/([0-9]+)/(.+)$")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _metric_name(name: str, prefix: str) -> str:
+    base = _NAME_BAD.sub("_", name.strip("/"))
+    if not base:
+        base = "unnamed"
+    if base[0].isdigit():
+        base = "_" + base
+    return f"{prefix}_{base}"
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace(
+            '"', r"\"").replace("\n", r"\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _split_replica(name: str) -> Tuple[str, Dict[str, str]]:
+    """``fleet/replica/3/up`` -> (``fleet/replica_up``, {replica: "3"})."""
+    m = _REPLICA.match(name)
+    if m is None:
+        return name, {}
+    return f"fleet/replica_{m.group(2)}", {"replica": m.group(1)}
+
+
+class _Family:
+    __slots__ = ("mtype", "samples")
+
+    def __init__(self, mtype: str):
+        self.mtype = mtype
+        # (suffix, labels, value) triples in insertion order
+        self.samples: List[Tuple[str, Dict[str, str], float]] = []
+
+
+def render_parts(parts: Iterable[Tuple[Dict[str, str], Dict[str, Any]]],
+                 prefix: str = _PREFIX) -> str:
+    """Render ``(labels, snapshot)`` parts as one exposition document."""
+    fams: Dict[str, _Family] = {}
+
+    def family(name: str, mtype: str) -> _Family:
+        fam = fams.get(name)
+        if fam is None:
+            fam = fams[name] = _Family(mtype)
+        elif fam.mtype != mtype:
+            # one name, two types across parts would be invalid text;
+            # keep the first registration and coerce to it as a gauge
+            fam.mtype = "gauge"
+        return fam
+
+    for labels, snap in parts:
+        labels = dict(labels or {})
+        for name, value in sorted((snap.get("counters") or {}).items()):
+            base, extra = _split_replica(name)
+            mname = _metric_name(base, prefix) + "_total"
+            family(mname, "counter").samples.append(
+                ("", {**labels, **extra}, float(value)))
+        for name, value in sorted((snap.get("gauges") or {}).items()):
+            base, extra = _split_replica(name)
+            mname = _metric_name(base, prefix)
+            family(mname, "gauge").samples.append(
+                ("", {**labels, **extra}, float(value)))
+        for name, h in sorted((snap.get("histograms") or {}).items()):
+            bounds = h.get("bounds")
+            buckets = h.get("buckets")
+            if bounds is None or buckets is None:
+                continue     # pre-anchor snapshot without bucket export
+            base, extra = _split_replica(name)
+            mname = _metric_name(base, prefix)
+            fam = family(mname, "histogram")
+            lb = {**labels, **extra}
+            cum = 0
+            for bound, count in zip(bounds, buckets):
+                cum += int(count)
+                fam.samples.append(
+                    ("_bucket", {**lb, "le": _fmt(bound)}, cum))
+            fam.samples.append(
+                ("_bucket", {**lb, "le": "+Inf"}, int(h["count"])))
+            fam.samples.append(("_sum", lb, float(h["sum_s"])))
+            fam.samples.append(("_count", lb, int(h["count"])))
+
+    lines: List[str] = []
+    for mname in sorted(fams):
+        fam = fams[mname]
+        lines.append(f"# TYPE {mname} {fam.mtype}")
+        for suffix, lb, value in fam.samples:
+            lines.append(f"{mname}{suffix}{_label_str(lb)} {_fmt(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      labels: Optional[Dict[str, str]] = None,
+                      prefix: str = _PREFIX) -> str:
+    """One snapshot -> exposition text (optionally labeled)."""
+    return render_parts([(labels or {}, snapshot)], prefix=prefix)
+
+
+def registry_text(labels: Optional[Dict[str, str]] = None,
+                  prefix: str = _PREFIX) -> str:
+    """The global registry's current scrape document."""
+    from .metrics import global_registry
+    return render_prometheus(global_registry.snapshot(), labels=labels,
+                             prefix=prefix)
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

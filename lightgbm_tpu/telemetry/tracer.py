@@ -72,7 +72,14 @@ class SpanTracer:
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._dropped = 0
+        self._drop_warned = False
+        # wall-clock anchor: the unix time captured at the SAME instant as
+        # the perf_counter epoch — perf_counter is monotonic but has an
+        # arbitrary per-process zero, so two processes' traces can only be
+        # merged onto one timeline through this pairing (the collector,
+        # telemetry/collect.py, aligns shards on it)
         self._epoch = time.perf_counter()
+        self._epoch_unix = time.time()
         self._phase_totals: Dict[str, float] = {}
         self._phase_counts: Dict[str, int] = {}
         self._local = threading.local()
@@ -88,7 +95,10 @@ class SpanTracer:
         with self._lock:
             self._events = []
             self._dropped = 0
+            self._drop_warned = False
+            # re-anchor: both halves of the clock pairing move together
             self._epoch = time.perf_counter()
+            self._epoch_unix = time.time()
             self._phase_totals = {}
             self._phase_counts = {}
 
@@ -116,6 +126,21 @@ class SpanTracer:
         self._emit("C", name, time.perf_counter(),
                    {k: float(v) for k, v in values.items()})
 
+    def complete(self, name: str, start: float, duration: float,
+                 **args: Any) -> None:
+        """One finished span as a single "X" (complete) event.
+
+        ``start`` is an absolute ``time.perf_counter`` point and
+        ``duration`` is in seconds.  Unlike :meth:`span`, the begin and
+        end may have happened on DIFFERENT threads (a request enqueued by
+        an HTTP handler and dispatched by the batcher worker) — the event
+        is attributed to the emitting thread's track."""
+        if not self.enabled:
+            return
+        self._emit("X", name, start, args or None,
+                   extra={"dur": max(duration, 0.0) * 1e6})
+        self._account(name, duration)
+
     def _emit(self, ph: str, name: str, t: float, args: Optional[dict],
               extra: Optional[dict] = None) -> None:
         ev: Dict[str, Any] = {
@@ -130,8 +155,18 @@ class SpanTracer:
         with self._lock:
             if len(self._events) < _MAX_EVENTS:
                 self._events.append(ev)
-            else:
-                self._dropped += 1
+                return
+            self._dropped += 1
+            warn = not self._drop_warned
+            self._drop_warned = True
+        if warn:
+            from ..utils.log import log_warning
+            log_warning(
+                f"telemetry: trace event buffer full ({_MAX_EVENTS} "
+                "events) — further spans are DROPPED, not recorded "
+                "(raise LIGHTGBM_TPU_TRACE_MAX_EVENTS or lower "
+                "serve_trace_sample); the drop count is in "
+                "telemetry_summary()['trace_dropped_events']")
 
     def _account(self, name: str, dt: float) -> None:
         with self._lock:
@@ -143,6 +178,29 @@ class SpanTracer:
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        """Events dropped after the bounded buffer filled."""
+        with self._lock:
+            return self._dropped
+
+    def clock_sync(self) -> Dict[str, Any]:
+        """The wall-clock anchor record: ``unix_time_s`` is the
+        ``time.time()`` captured at the same instant the ``perf_counter``
+        epoch (event ``ts`` zero-point) was taken, plus the process
+        identity a multi-process merge needs."""
+        with self._lock:
+            anchor = {"unix_time_s": self._epoch_unix,
+                      "perf_epoch_s": self._epoch}
+        anchor["pid"] = os.getpid()
+        rank = os.environ.get("LGBTPU_REPLICA_RANK")
+        if rank is not None:
+            try:
+                anchor["replica_rank"] = int(rank)
+            except ValueError:
+                pass
+        return anchor
 
     def phase_snapshot(self) -> Dict[str, float]:
         """Copy of cumulative per-span-name wall totals (seconds)."""
@@ -163,10 +221,19 @@ class SpanTracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        meta: List[Dict[str, Any]] = [{
-            "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
-            "args": {"name": "lightgbm_tpu host"},
-        }]
+        anchor = self.clock_sync()
+        rank = anchor.get("replica_rank")
+        proc_name = ("lightgbm_tpu host" if rank is None
+                     else f"lightgbm_tpu replica {rank}")
+        meta: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": os.getpid(),
+             "tid": 0, "args": {"name": proc_name}},
+            # wall-clock anchor as a metadata event at ts 0: every ts in
+            # this file is relative to anchor.unix_time_s, which is what
+            # lets the collector align shards from different processes
+            {"name": "clock_sync", "ph": "M", "pid": os.getpid(),
+             "tid": 0, "ts": 0.0, "args": anchor},
+        ]
         for tid in sorted({e["tid"] for e in events}):
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": os.getpid(), "tid": tid,
@@ -175,7 +242,8 @@ class SpanTracer:
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
             "otherData": {"producer": "lightgbm_tpu.telemetry",
-                          "dropped_events": dropped},
+                          "dropped_events": dropped,
+                          "clock_sync": anchor},
         }
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as fh:

@@ -13,6 +13,12 @@ python -m lightgbm_tpu.analysis
 # every later perf triage lie
 echo "=== stage: telemetry fast tier ==="
 python -m pytest tests/test_telemetry.py -x -q
+# fleet observability next: trace-context propagation, the Prometheus
+# /metrics surface, the cross-process trace collector, and the SLO
+# burn-rate state machine — the layer the serving and perf gates below
+# report through (docs/OBSERVABILITY.md "Serving observability")
+echo "=== stage: observability fast tier ==="
+python -m pytest tests/test_observability.py -x -q -m 'not slow'
 # the analysis-engine suite rides with it (per-rule tripping fixtures +
 # the repo-clean findings==baseline gate test; no models trained)
 echo "=== stage: analysis-engine fast tier ==="

@@ -18,6 +18,7 @@ from lightgbm_tpu.analysis.rules.determinism import DeterminismRule
 from lightgbm_tpu.analysis.rules.host_sync import HostSyncRule
 from lightgbm_tpu.analysis.rules.jit_discipline import JitDisciplineRule
 from lightgbm_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from lightgbm_tpu.analysis.rules.metric_name import MetricNameRule
 from lightgbm_tpu.analysis.rules.subprocess_discipline import (
     SubprocessDisciplineRule)
 
@@ -300,6 +301,41 @@ def test_lgb008_out_of_scope_dirs_clean(tmp_path):
                               name="lightgbm_tpu/ops/mod.py") == []
 
 
+def test_lgb009_dynamic_metric_name_trips(tmp_path):
+    src = ("from lightgbm_tpu import telemetry\n"
+           "def serve(name, rank):\n"
+           "    telemetry.inc(name)\n"                            # line 3
+           "    telemetry.gauge('queue/' + name, 1.0)\n"          # line 4
+           "    telemetry.observe(f'serve/{name}_s', 0.1)\n"      # line 5
+           "    telemetry.inc('serve/%s' % name)\n"               # line 6
+           "    telemetry.inc('serve/requests')\n"                # literal ok
+           "    telemetry.gauge(f'fleet/replica/{rank}/up', 1)\n"  # allowed
+           "    telemetry.inc(f'recompile/{name}')\n")            # allowed
+    found = run_snippet(tmp_path, src, MetricNameRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB009", 3), ("LGB009", 4), ("LGB009", 5), ("LGB009", 6)]
+    assert "cardinality" in found[0].message
+    assert "serve/*_s" in found[2].message
+
+
+def test_lgb009_registry_receiver_and_kwarg(tmp_path):
+    src = ("from lightgbm_tpu.telemetry import global_registry\n"
+           "def record(key):\n"
+           "    global_registry.inc(name=key)\n"                  # line 3
+           "    global_registry.inc(name='serve/requests')\n")    # ok
+    found = run_snippet(tmp_path, src, MetricNameRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB009", 3)]
+
+
+def test_lgb009_unrelated_receivers_clean(tmp_path):
+    # .inc/.gauge/.observe on arbitrary objects are not metric calls
+    src = ("def bump(counter, name):\n"
+           "    counter.inc(name)\n"
+           "    self_made = {}\n"
+           "    return counter, self_made\n")
+    assert run_snippet(tmp_path, src, MetricNameRule()) == []
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: baseline round-trip, stale entries, parse errors
 # ---------------------------------------------------------------------------
@@ -366,12 +402,12 @@ def test_cli_json_output(capsys, monkeypatch):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["findings"] == [] and out["stale_baseline"] == []
-    assert len(out["checked_rules"]) == 8
+    assert len(out["checked_rules"]) == 9
 
 
 def test_cli_list_rules(capsys):
     assert eng.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("LGB001", "LGB002", "LGB003", "LGB004", "LGB005",
-                "LGB006", "LGB007", "LGB008"):
+                "LGB006", "LGB007", "LGB008", "LGB009"):
         assert rid in out

@@ -1,0 +1,646 @@
+"""Fleet-wide request observability (docs/OBSERVABILITY.md "Serving
+observability").
+
+The contract under test:
+
+  * trace context: header round-trip, head-sampling decision minted once
+    and honored downstream, trace-id propagation END TO END through the
+    fanout front onto a second replica after a transport failure — one
+    trace, spans from two processes, merged onto one wall-clock-aligned
+    timeline and time-ordered;
+  * the tracer's wall-clock anchor (clock_sync in every export,
+    re-anchored by reset()) and the one-shot event-drop warning +
+    summary field;
+  * ``/metrics`` output parses as VALID Prometheus text exposition
+    (unique # TYPE per family, cumulative le buckets, _sum/_count) with
+    counters monotone across scrapes, on replicas, the front, and the
+    fleet aggregate (per-replica labels);
+  * the SLO burn-rate monitor's state machine on an injected clock:
+    healthy traffic -> no alert; burn -> fire (both windows); recovery
+    -> clear on the fast window — for both the latency and availability
+    dimensions;
+  * tail capture of errored requests regardless of head sampling, and
+    the JSONL access-log schema.
+"""
+import json
+import os
+import re
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.telemetry as tel
+from lightgbm_tpu.serving import ServingApp, ServingFleet, SLOMonitor
+from lightgbm_tpu.serving.front import http_json
+from lightgbm_tpu.telemetry import TraceContext, TailRing
+from lightgbm_tpu.telemetry.collect import merge_traces
+from lightgbm_tpu.telemetry.prometheus import render_parts, render_prometheus
+
+
+@pytest.fixture
+def telemetry():
+    tel.reset()
+    tel.configure(enabled=True)
+    yield tel
+    tel.disable()
+    tel.reset()
+    tel.configure(enabled=False, metrics_out="", trace_out="")
+
+
+def _make_data(seed=7, n=400):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    y = ((X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float64)
+    return X, y
+
+
+def _train_to_file(path, seed=3):
+    X, y = _make_data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5, "seed": seed},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    bst.save_model(str(path))
+    return X
+
+
+# ---------------------------------------------------------------------------
+# trace context mechanics
+# ---------------------------------------------------------------------------
+
+def test_trace_header_roundtrip():
+    ctx = TraceContext.mint(1.0)
+    assert ctx.sampled and len(ctx.trace_id) == 16
+    back = TraceContext.from_header(ctx.header_value())
+    assert back.trace_id == ctx.trace_id and back.sampled
+    assert TraceContext.from_header("abcd1234;s=0").sampled is False
+    # garbage never crashes admission; it just mints a fresh context
+    assert TraceContext.from_header(None) is None
+    assert TraceContext.from_header("") is None
+    assert TraceContext.from_header("no spaces allowed;s=1") is None
+    assert TraceContext.from_header("x" * 100) is None
+
+
+def test_head_sampling_rates():
+    assert not TraceContext.mint(0.0).sampled
+    assert all(TraceContext.mint(1.0).sampled for _ in range(20))
+
+
+def test_tail_ring_bounded():
+    ring = TailRing(4)
+    for i in range(10):
+        ring.add({"i": i})
+    snap = ring.snapshot()
+    assert snap["captured"] == 10 and len(snap["recent"]) == 4
+    assert [r["i"] for r in snap["recent"]] == [6, 7, 8, 9]
+
+
+# ---------------------------------------------------------------------------
+# tracer satellites: wall-clock anchor, drop accounting
+# ---------------------------------------------------------------------------
+
+def test_export_carries_clock_sync_anchor(telemetry, tmp_path):
+    t_before = time.time()
+    tel.reset()                      # anchor taken here
+    with tel.span("work"):
+        pass
+    path = tel.export_trace(str(tmp_path / "t.json"))
+    blob = json.load(open(path))
+    anchor = blob["otherData"]["clock_sync"]
+    assert t_before <= anchor["unix_time_s"] <= time.time()
+    assert anchor["pid"] == os.getpid()
+    # the same anchor rides as a metadata event for tools that only see
+    # traceEvents
+    evs = [e for e in blob["traceEvents"] if e["name"] == "clock_sync"]
+    assert evs and evs[0]["args"]["unix_time_s"] == anchor["unix_time_s"]
+    # reset() re-anchors BOTH halves
+    a1 = tel.global_tracer.clock_sync()
+    time.sleep(0.01)
+    tel.reset()
+    a2 = tel.global_tracer.clock_sync()
+    assert a2["unix_time_s"] > a1["unix_time_s"]
+    assert a2["perf_epoch_s"] > a1["perf_epoch_s"]
+
+
+def test_event_drop_warns_once_and_surfaces(telemetry, monkeypatch):
+    from lightgbm_tpu.telemetry import tracer as tracer_mod
+    from lightgbm_tpu.utils import log as logmod
+
+    warnings = []
+    monkeypatch.setattr(tracer_mod, "_MAX_EVENTS", 2)
+    monkeypatch.setattr(logmod, "log_warning",
+                        lambda msg: warnings.append(str(msg)))
+    tel.reset()
+    for _ in range(5):
+        tel.instant("x")
+    assert tel.global_tracer.dropped == 3
+    assert tel.summary()["trace_dropped_events"] == 3
+    dropped_warnings = [w for w in warnings if "DROPPED" in w]
+    assert len(dropped_warnings) == 1      # one-shot, not per event
+
+
+def test_complete_event_cross_thread(telemetry):
+    t0 = time.perf_counter() - 0.25
+    tel.global_tracer.complete("q", t0, 0.25, trace_id="ab")
+    ev = [e for e in tel.global_tracer.events if e["name"] == "q"][0]
+    assert ev["ph"] == "X"
+    assert ev["dur"] == pytest.approx(0.25e6, rel=0.01)
+    assert ev["args"]["trace_id"] == "ab"
+
+
+def test_snapshot_exposes_histogram_buckets(telemetry):
+    tel.observe("h", 0.002)
+    tel.observe("h", 0.02)
+    tel.observe("h", 999.0)
+    h = tel.global_registry.snapshot()["histograms"]["h"]
+    assert len(h["buckets"]) == len(h["bounds"]) + 1
+    assert sum(h["buckets"]) == h["count"] == 3
+    assert h["buckets"][-1] == 1      # the overflow bucket
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf)$")
+
+
+def _parse_prom(text):
+    """Minimal validity check of the 0.0.4 text format; returns
+    {family: type} and {sample_line_name: value}."""
+    types, samples = {}, {}
+    for ln in text.strip().splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, mtype = rest.rsplit(" ", 1)
+            assert name not in types, f"duplicate # TYPE for {name}"
+            types[name] = mtype
+            continue
+        assert not ln.startswith("#"), f"unexpected comment: {ln}"
+        m = _SAMPLE.match(ln)
+        assert m, f"invalid sample line: {ln!r}"
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    # every sample belongs to a declared family
+    for key in samples:
+        base = key.split("{")[0]
+        fam = base
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[:-len(suffix)] in types:
+                fam = base[:-len(suffix)]
+        assert fam in types, f"sample {key} has no # TYPE"
+    return types, samples
+
+
+def test_prometheus_render_valid_and_cumulative(telemetry):
+    tel.inc("serve/requests", 5)
+    tel.gauge("fleet/replicas_alive", 3)
+    tel.observe("serve/latency_s", 0.004)
+    tel.observe("serve/latency_s", 0.5)
+    text = tel.registry_text()
+    types, samples = _parse_prom(text)
+    assert types["lgbtpu_serve_requests_total"] == "counter"
+    assert types["lgbtpu_fleet_replicas_alive"] == "gauge"
+    assert types["lgbtpu_serve_latency_s"] == "histogram"
+    assert samples["lgbtpu_serve_requests_total"] == 5
+    # cumulative buckets: monotone nondecreasing, +Inf == _count
+    buckets = [(k, v) for k, v in samples.items()
+               if k.startswith("lgbtpu_serve_latency_s_bucket")]
+    values = [v for _, v in buckets]
+    assert values == sorted(values)
+    assert samples['lgbtpu_serve_latency_s_bucket{le="+Inf"}'] == \
+        samples["lgbtpu_serve_latency_s_count"] == 2
+
+
+def test_prometheus_counters_monotone_across_scrapes(telemetry):
+    tel.inc("serve/requests", 2)
+    _, s1 = _parse_prom(tel.registry_text())
+    tel.inc("serve/requests", 3)
+    tel.observe("serve/latency_s", 0.01)
+    _, s2 = _parse_prom(tel.registry_text())
+    for key, v1 in s1.items():
+        if "_total" in key or "_count" in key or "_bucket" in key:
+            assert s2.get(key, v1) >= v1, f"{key} went backwards"
+
+
+def test_prometheus_replica_relabeling(telemetry):
+    tel.gauge("fleet/replica/3/up", 1.0)
+    tel.gauge("fleet/replica/11/heartbeat_age_s", 0.25)
+    types, samples = _parse_prom(tel.registry_text())
+    assert samples['lgbtpu_fleet_replica_up{replica="3"}'] == 1.0
+    assert samples[
+        'lgbtpu_fleet_replica_heartbeat_age_s{replica="11"}'] == 0.25
+    # the numeric rank lives in a label, never in the metric name
+    assert not any("replica_3" in t or "replica_11" in t for t in types)
+
+
+def test_prometheus_multi_part_single_type(telemetry):
+    snap_a = {"counters": {"serve/requests": 4.0}, "gauges": {},
+              "histograms": {}}
+    snap_b = {"counters": {"serve/requests": 9.0}, "gauges": {},
+              "histograms": {}}
+    text = render_parts([({"role": "front"}, snap_a),
+                         ({"role": "replica", "replica": "0"}, snap_b)])
+    types, samples = _parse_prom(text)
+    assert list(types) == ["lgbtpu_serve_requests_total"]
+    assert samples['lgbtpu_serve_requests_total{role="front"}'] == 4.0
+    assert samples['lgbtpu_serve_requests_total'
+                   '{replica="0",role="replica"}'] == 9.0
+
+
+def test_prometheus_handles_legacy_snapshot_without_buckets():
+    # a pre-anchor snapshot (no bounds/buckets) must not crash the
+    # exporter — the histogram is simply omitted
+    snap = {"counters": {}, "gauges": {},
+            "histograms": {"h": {"count": 2, "sum_s": 0.1, "mean_s": 0.05,
+                                 "min_s": 0.01, "max_s": 0.09}}}
+    assert render_prometheus(snap) == ""
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor on an injected clock
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_latency_burn_fire_and_clear():
+    clk = _Clock()
+    mon = SLOMonitor(p99_target_ms=100.0, window_s=5.0,
+                     burn_threshold=10.0, clock=clk, min_events=5)
+    # healthy: 1s of fast traffic
+    for _ in range(50):
+        mon.record(200, 20.0)
+    assert mon.tick()["alert"] is None
+    # burn: 3s where half the responses blow the p99 target (burn = 50x)
+    for _ in range(3):
+        clk.t += 1.0
+        for _ in range(20):
+            mon.record(200, 20.0)
+            mon.record(200, 500.0)
+    out = mon.tick()
+    assert out["alert"] == "latency"
+    assert mon.state()["alerting"]
+    assert [e["kind"] for e in mon.timeline()] == ["fire"]
+    # recovery: the fast window (5s) outruns the incident
+    for _ in range(7):
+        clk.t += 1.0
+        for _ in range(30):
+            mon.record(200, 20.0)
+        mon.tick()
+    assert mon.state()["alerting"] is False
+    kinds = [e["kind"] for e in mon.timeline()]
+    assert kinds == ["fire", "clear"]
+    ts = [e["t"] for e in mon.timeline()]
+    assert ts == sorted(ts)
+
+
+def test_slo_availability_dimension_and_503_exemption():
+    clk = _Clock()
+    mon = SLOMonitor(availability_target=0.99, window_s=5.0,
+                     burn_threshold=5.0, clock=clk, min_events=5)
+    # 503 sheds are NOT availability errors (load management)
+    for _ in range(100):
+        mon.record(503, 5.0)
+    assert mon.tick()["alert"] is None
+    # non-503 5xx errors burn the budget
+    for _ in range(3):
+        clk.t += 1.0
+        for _ in range(10):
+            mon.record(200, 5.0)
+            mon.record(500, 5.0)
+    assert mon.tick()["alert"] == "availability"
+    # idle recovery: the poll-loop tick clears once the window drains
+    clk.t += 10.0
+    mon.tick()
+    assert mon.state()["alerting"] is False
+
+
+def test_slo_min_events_guard():
+    clk = _Clock()
+    mon = SLOMonitor(p99_target_ms=10.0, window_s=5.0,
+                     burn_threshold=2.0, clock=clk, min_events=10)
+    # 3 catastrophic requests are not statistically an outage
+    for _ in range(3):
+        mon.record(200, 500.0)
+    assert mon.tick()["alert"] is None
+
+
+def test_slo_rejects_bad_target():
+    with pytest.raises(ValueError):
+        SLOMonitor(availability_target=1.5)
+
+
+def test_outcome_helper_slo_status_override_and_schema():
+    """The shared outcome recorder: the front maps transport-exhausted
+    sheds to 599 against the SLO (availability burns during a total
+    outage) while the record keeps the client-visible 503."""
+    from lightgbm_tpu.telemetry.context import note_outcome
+
+    clk = _Clock()
+    mon = SLOMonitor(availability_target=0.99, window_s=5.0,
+                     burn_threshold=1.0, clock=clk, min_events=5)
+    ring = TailRing(8)
+    ctx = TraceContext.mint(0.0)
+    for _ in range(10):
+        note_outcome(ctx=ctx, status=503, latency_ms=12.0,
+                     deadline_ms=100.0,
+                     obj={"reason": "retries_exhausted"},
+                     slo=mon, tail=ring, retries=2, slo_status=599)
+    assert mon.tick()["alert"] == "availability"
+    rec = ring.snapshot()["recent"][-1]
+    assert rec["outcome"] == 503          # the client saw an honest 503
+    assert rec["retries"] == 2 and rec["captured"] == "error"
+    assert rec["reason"] == "retries_exhausted"
+
+
+def test_replica_slo_alert_clears_while_idle(tmp_path, telemetry):
+    """The replica's own ticker thread must CLEAR an alert with zero
+    traffic — the front stops routing to a burning replica, so waiting
+    for the next request to tick would latch the alert forever."""
+    model = tmp_path / "m.txt"
+    _train_to_file(model)
+    app = ServingApp(str(model), port=0, max_delay_ms=1.0,
+                     slo_availability=0.99, slo_window_s=1.0).start()
+    try:
+        for _ in range(30):
+            app.slo.record(500, 5.0)
+        app.slo.tick()
+        assert app.slo.state()["alerting"]
+        deadline = time.time() + 8
+        while app.slo.state()["alerting"] and time.time() < deadline:
+            time.sleep(0.2)      # only the ticker thread can clear it
+        assert not app.slo.state()["alerting"]
+        assert app.slo.cleared == 1
+    finally:
+        app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# collector: merge + align + filter
+# ---------------------------------------------------------------------------
+
+def _shard(path, unix0, pid, events):
+    blob = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"proc-{pid}"}}] + events,
+        "otherData": {"clock_sync": {"unix_time_s": unix0,
+                                     "perf_epoch_s": 0.0, "pid": pid}}}
+    path.write_text(json.dumps(blob))
+    return str(path)
+
+
+def test_collector_aligns_shards_on_wall_clock(tmp_path):
+    # shard B's epoch is 2s later than A's: its local ts 0 must land at
+    # +2s on the merged timeline
+    a = _shard(tmp_path / "trace_a.json", 100.0, 11, [
+        {"name": "front/request", "ph": "B", "pid": 11, "tid": 1,
+         "ts": 0.0, "args": {"trace_id": "t1"}},
+        {"name": "front/request", "ph": "E", "pid": 11, "tid": 1,
+         "ts": 3_000_000.0},
+    ])
+    b = _shard(tmp_path / "trace_b.json", 102.0, 22, [
+        {"name": "serve/predict", "ph": "B", "pid": 22, "tid": 1,
+         "ts": 0.0, "args": {"trace_id": "t1"}},
+        {"name": "serve/predict", "ph": "E", "pid": 22, "tid": 1,
+         "ts": 500_000.0},
+    ])
+    blob, summary = merge_traces([a, b])
+    assert summary["shards"] == 2 and summary["unaligned_shards"] == []
+    evs = [e for e in blob["traceEvents"] if e.get("ph") != "M"]
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    by_name = {e["name"]: e["ts"] for e in evs if e["ph"] == "B"}
+    assert by_name["serve/predict"] == pytest.approx(2_000_000.0)
+    assert by_name["front/request"] == pytest.approx(0.0)
+    assert sorted(summary["processes"]) == [11, 22]
+
+
+def test_collector_trace_id_filter_and_batch_membership(tmp_path):
+    a = _shard(tmp_path / "trace_a.json", 50.0, 5, [
+        {"name": "serve/dispatch", "ph": "B", "pid": 5, "tid": 1,
+         "ts": 10.0, "args": {"trace_ids": ["want", "other"]}},
+        {"name": "serve/predict", "ph": "B", "pid": 5, "tid": 1,
+         "ts": 5.0, "args": {"trace_id": "unrelated"}},
+    ])
+    blob, summary = merge_traces([a], trace_id="want")
+    names = [e["name"] for e in blob["traceEvents"] if e.get("ph") != "M"]
+    assert names == ["serve/dispatch"]     # list membership matched
+
+
+def test_collector_unaligned_shard_flagged(tmp_path):
+    p = tmp_path / "trace_old.json"
+    p.write_text(json.dumps({"traceEvents": [
+        {"name": "x", "ph": "i", "pid": 1, "tid": 1, "ts": 1.0}]}))
+    blob, summary = merge_traces([str(p)])
+    assert summary["unaligned_shards"] == [str(p)]
+
+
+# ---------------------------------------------------------------------------
+# replica server surfaces (in-process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def app(tmp_path_factory, telemetry):
+    td = tmp_path_factory.mktemp("obs_app")
+    model = td / "model.txt"
+    X = _train_to_file(model)
+    access = str(td / "access.jsonl")
+    app = ServingApp(str(model), port=0, max_delay_ms=1.0,
+                     trace_sample=1.0, access_log=access,
+                     slo_p99_ms=60_000.0).start()
+    yield app, X, access
+    app.shutdown()
+
+
+def test_server_trace_metrics_access_log_and_tail(app):
+    app, X, access = app
+    # 1) normal predict: trace id minted, echoed in body AND header
+    st, obj, hdrs = http_json(app.host, app.port, "POST", "/predict",
+                              {"rows": X[:4].tolist()}, timeout=10)
+    assert st == 200 and re.fullmatch(r"[0-9a-f]{16}", obj["trace_id"])
+    echoed = {k.lower(): v for k, v in hdrs.items()}["x-lgbtpu-trace"]
+    assert echoed.startswith(obj["trace_id"])
+    # 2) propagated context wins over minting
+    st, obj2, _ = http_json(
+        app.host, app.port, "POST", "/predict",
+        {"rows": X[:2].tolist()}, timeout=10,
+        headers={"X-LGBTPU-Trace": "feedface00000001;s=1"})
+    assert st == 200 and obj2["trace_id"] == "feedface00000001"
+    spans = {e["name"]: e for e in tel.global_tracer.events
+             if e.get("args", {}).get("trace_id") == "feedface00000001"}
+    assert "serve/predict" in spans       # replica span carries the id
+    assert "serve/queue_wait" in spans    # batcher queue wait rode along
+    # 3) a shape error is tail-captured even though it was head-sampled
+    #    anyway; the ring keeps it as an error
+    st, obj3, _ = http_json(app.host, app.port, "POST", "/predict",
+                            {"rows": [[1.0, 2.0]]}, timeout=10)
+    assert st == 400
+    st, stats, _ = http_json(app.host, app.port, "GET", "/stats",
+                             timeout=10)
+    tail = stats["trace_tail"]
+    assert tail["captured"] >= 1
+    assert any(r["outcome"] == 400 for r in tail["recent"])
+    assert stats["slo"]["alerting"] is False
+    # 4) /metrics is valid exposition and counts the traffic
+    st, snap, _ = http_json(app.host, app.port, "GET",
+                            "/metrics?format=json", timeout=10)
+    assert st == 200 and snap["counters"]["serve/requests"] >= 2
+    import urllib.request
+    text = urllib.request.urlopen(
+        f"http://{app.host}:{app.port}/metrics", timeout=10
+    ).read().decode()
+    types, samples = _parse_prom(text)
+    assert samples["lgbtpu_serve_requests_total"] >= 2
+    # 5) the access log has one line per finished request, schema intact
+    lines = [json.loads(ln) for ln in open(access)]
+    assert len(lines) == 3
+    assert {ln["outcome"] for ln in lines} == {200, 400}
+    for ln in lines:
+        for key in ("ts", "trace_id", "outcome", "latency_ms",
+                    "deadline_ms", "retries", "model_sha256"):
+            assert key in ln, f"access log missing {key}"
+    ok = [ln for ln in lines if ln["outcome"] == 200]
+    assert all(ln["model_sha256"] for ln in ok)
+
+
+def test_server_unsampled_requests_emit_no_spans(tmp_path, telemetry):
+    model = tmp_path / "m.txt"
+    X = _train_to_file(model)
+    app = ServingApp(str(model), port=0, max_delay_ms=1.0,
+                     trace_sample=0.0).start()
+    try:
+        tel.global_tracer.reset()
+        st, obj, _ = http_json(app.host, app.port, "POST", "/predict",
+                               {"rows": X[:2].tolist()}, timeout=10)
+        assert st == 200 and "trace_id" in obj   # id still minted
+        names = {e["name"] for e in tel.global_tracer.events}
+        assert "serve/predict" not in names
+        assert "serve/queue_wait" not in names
+    finally:
+        app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the real fleet: one trace across two processes + /metrics everywhere
+# ---------------------------------------------------------------------------
+
+def test_fleet_trace_propagation_metrics_and_merge(tmp_path, telemetry):
+    """End to end: a request that fails over from a killed replica onto
+    its sibling carries ONE trace id through the front's retry; the
+    merged shards show the request on one timeline with spans from two
+    processes; /metrics is valid on the front, a replica, and the fleet
+    aggregate."""
+    model = tmp_path / "model.txt"
+    X = _train_to_file(model)
+    fleet_dir = str(tmp_path / "fleet")
+    fleet = ServingFleet(
+        str(model), replicas=2, max_batch=16, buckets_spec="16",
+        max_delay_ms=1.0, deadline_ms=5000.0, retries=2,
+        retry_backoff_ms=5.0, breaker_failures=3, breaker_cooldown_s=0.5,
+        restart_backoff_s=8.0,      # slow enough that the killed replica
+        #                             stays down for the whole test
+        hang_timeout_s=10.0, fleet_dir=fleet_dir,
+        trace_sample=1.0, access_log=str(tmp_path / "access")).start()
+    try:
+        # warm both replicas through the front
+        for _ in range(4):
+            st, obj, _ = http_json(fleet.host, fleet.port, "POST",
+                                   "/predict",
+                                   {"rows": X[:3].tolist(),
+                                    "deadline_ms": 4000}, timeout=30)
+            assert st == 200, obj
+
+        # ---- /metrics: replica, front, fleet aggregate all valid
+        ep1 = fleet.endpoint(1)
+        import urllib.request
+        rep_text = urllib.request.urlopen(
+            f"http://{ep1['host']}:{ep1['port']}/metrics",
+            timeout=10).read().decode()
+        types, samples = _parse_prom(rep_text)
+        assert any(k.startswith('lgbtpu_serve_requests_total')
+                   for k in samples)
+        front_text = urllib.request.urlopen(
+            f"http://{fleet.host}:{fleet.port}/metrics",
+            timeout=10).read().decode()
+        _parse_prom(front_text)
+        assert "lgbtpu_fleet_replicas_ready" in front_text
+        agg_text = urllib.request.urlopen(
+            f"http://{fleet.host}:{fleet.port}/metrics/fleet",
+            timeout=10).read().decode()
+        _parse_prom(agg_text)
+        assert 'role="front"' in agg_text
+        assert 'replica="0"' in agg_text and 'replica="1"' in agg_text
+
+        # ---- wedge replica 0 (SIGSTOP: its socket stays open, requests
+        # time out — exactly what a stuck XLA dispatch looks like), then
+        # push traced requests until one fails over onto the sibling.
+        # Deterministic: until the readiness cache notices (~1.5 s) the
+        # round-robin keeps routing there, so a retry MUST happen.
+        stopped_pid = fleet.endpoint(0)["pid"]
+        os.kill(stopped_pid, signal.SIGSTOP)
+        traced = None
+        deadline = time.time() + 30
+        n = 0
+        try:
+            while time.time() < deadline:
+                n += 1
+                tid = f"{n:016x}"
+                st, obj, _ = http_json(
+                    fleet.host, fleet.port, "POST", "/predict",
+                    {"rows": X[:2].tolist(), "deadline_ms": 4000},
+                    timeout=30,
+                    headers={"X-LGBTPU-Trace": f"{tid};s=1"})
+                if st == 200 and obj.get("attempts", 1) >= 2:
+                    assert obj["trace_id"] == tid
+                    traced = tid
+                    break
+                time.sleep(0.02)
+        finally:
+            os.kill(stopped_pid, signal.SIGCONT)   # let it drain+export
+        assert traced, "no request ever needed a retry onto the sibling"
+    finally:
+        fleet.stop()
+
+    # ---- replicas exported shards on drain, the front on stop; merge
+    shards = sorted(os.listdir(fleet_dir))
+    assert "trace_front.json" in shards
+    assert any(s.startswith("trace_replica_") for s in shards)
+    paths = [os.path.join(fleet_dir, s) for s in shards
+             if s.startswith("trace")]
+    blob, summary = merge_traces(paths, trace_id=traced)
+    evs = [e for e in blob["traceEvents"] if e.get("ph") != "M"]
+    assert evs, "merged trace lost the request"
+    # one trace, spans from TWO processes (front + surviving replica)
+    pids = {e["pid"] for e in evs}
+    assert len(pids) >= 2, f"expected >= 2 processes, got {pids}"
+    names = {e["name"] for e in evs}
+    assert "front/request" in names        # front process
+    assert "front/retry" in names          # the failover is on the trace
+    assert "serve/predict" in names        # replica process
+    assert "serve/queue_wait" in names     # batcher
+    assert "serve/dispatch" in names       # device dispatch
+    # time-ordered on the merged wall-clock timeline
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # the front's request span opens before the replica's handler span
+    first_front = min(e["ts"] for e in evs
+                      if e["name"] == "front/request")
+    first_replica = min(e["ts"] for e in evs
+                        if e["name"] == "serve/predict")
+    assert first_front <= first_replica
+    # access logs: front log stamps the retry count
+    front_log = os.path.join(str(tmp_path / "access"),
+                             "access_front.jsonl")
+    entries = [json.loads(ln) for ln in open(front_log)]
+    hit = [e for e in entries if e["trace_id"] == traced]
+    assert hit and hit[0]["retries"] >= 1 and hit[0]["outcome"] == 200
