@@ -26,6 +26,7 @@ def all_rules() -> List[Rule]:
     from .atomic_io import AtomicIORule
     from .collective_axis import CollectiveAxisRule
     from .config_doc import ConfigDocRule
+    from .cost_attribution import CostAttributionRule
     from .determinism import DeterminismRule
     from .host_sync import HostSyncRule
     from .jit_discipline import JitDisciplineRule
@@ -36,4 +37,4 @@ def all_rules() -> List[Rule]:
     return [JitDisciplineRule(), HostSyncRule(), CollectiveAxisRule(),
             DeterminismRule(), AtomicIORule(), LockDisciplineRule(),
             ConfigDocRule(), SubprocessDisciplineRule(),
-            MetricNameRule()]
+            MetricNameRule(), CostAttributionRule()]

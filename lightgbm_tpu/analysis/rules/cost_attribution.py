@@ -1,0 +1,81 @@
+"""LGB010: every ``watched_jit`` call site must pass an explicit ``name=``.
+
+The entry-point name is the COST-ATTRIBUTION KEY: it labels the
+``recompile/<name>`` counters, the ``cost/<name>/*`` flops/HBM gauges
+(telemetry/costmodel.py), the per-entry ceilings in PERF_BUDGETS.json,
+and the sentinel's regression reports.  A ``watched_jit`` without
+``name=`` falls back to ``f.__name__`` — typically ``_fn`` or a lambda —
+so a refactor that renames a local closure silently RETIRES the metric
+series and ORPHANS the budget: the sentinel then reports the entry as
+"not exercised" instead of catching its regression.  The name must also
+be a string LITERAL — a computed name is unstable across runs, which is
+the same attribution break with extra steps.
+
+Allow-list: telemetry/watchdog.py (defines the wrapper and names entries
+from its own arguments).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from . import Rule
+from .common import const_str
+
+ALLOWED_FILES = ("lightgbm_tpu/telemetry/watchdog.py",)
+
+
+def _name_kw(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+class CostAttributionRule(Rule):
+    rule_id = "LGB010"
+    title = "watched_jit without an explicit name= breaks cost attribution"
+    hint = ("pass name=\"<stable-entry-name>\" (a string literal) to "
+            "watched_jit — the name keys recompile/<name>, cost/<name>/* "
+            "and the PERF_BUDGETS.json ceilings, and must survive "
+            "closure renames")
+
+    def check_module(self, module) -> Iterable:
+        if module.rel in ALLOWED_FILES:
+            return
+        m = module.model
+        for call in m.walk_calls():
+            target = None
+            if m.name_matches(call.func, "watched_jit"):
+                target = call            # watched_jit(f, ...) / factory
+            elif m.name_matches(call.func, "functools.partial",
+                                "partial") and call.args \
+                    and m.name_matches(call.args[0], "watched_jit"):
+                target = call            # partial(watched_jit, ...)
+            if target is None:
+                continue
+            name = _name_kw(target)
+            if name is None:
+                yield module.finding(
+                    self.rule_id, target,
+                    "watched_jit call without name= — the entry falls "
+                    "back to the wrapped function's __name__, so a "
+                    "closure rename silently retires its metric series "
+                    "and orphans its cost budget", self.hint)
+            elif const_str(name) is None:
+                yield module.finding(
+                    self.rule_id, target,
+                    "watched_jit name= is not a string literal — a "
+                    "computed entry name is unstable across runs and "
+                    "cannot key cost budgets", self.hint)
+        # bare decorator spelling: @watched_jit (no call, so no name=)
+        for node in m.funcdefs:
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    continue   # handled as calls above
+                if m.name_matches(dec, "watched_jit"):
+                    yield module.finding(
+                        self.rule_id, dec,
+                        f"function {node.name!r} uses bare @watched_jit "
+                        "— no explicit entry name for cost attribution",
+                        self.hint)

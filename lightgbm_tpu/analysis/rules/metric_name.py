@@ -41,6 +41,9 @@ _RECEIVERS = ("telemetry", "global_registry", "tel", "metrics_registry")
 _ALLOWED_SKELETONS = (
     re.compile(r"^fleet/replica/\*/[a-z0-9_]+$"),
     re.compile(r"^recompile/\*$"),
+    # cost/<entry>/<field> — bounded by the watched_jit entry-point set
+    # (same budget as recompile/<name>); LGB010 keeps the names stable
+    re.compile(r"^cost/\*/[a-z0-9_]+$"),
 )
 
 

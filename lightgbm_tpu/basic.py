@@ -1063,6 +1063,7 @@ class Booster:
                     metrics_out=cfg.telemetry_out or None,
                     trace_out=cfg.trace_out or None,
                     recompile_threshold=cfg.telemetry_recompile_threshold,
+                    cost_capture=cfg.telemetry_cost,
                     _source="params")
             elif _tel.enabled() and _tel.enabled_source() == "params":
                 # a previous model's param-driven telemetry must not leak

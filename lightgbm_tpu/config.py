@@ -222,6 +222,8 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "telemetry_recompile_threshold": ["recompile_warn_threshold"],
     "telemetry_straggler_every": ["straggler_check_every"],
     "telemetry_straggler_skew": ["straggler_warn_skew"],
+    "telemetry_cost": ["cost_capture", "telemetry_cost_capture"],
+    "profile_out": ["profile_dir", "profile_output"],
 }
 
 # alias -> canonical
@@ -639,6 +641,17 @@ class Config:
     telemetry_straggler_every: int = 50
     # warn when the slowest host's mean iter time exceeds skew x median
     telemetry_straggler_skew: float = 1.25
+    # XLA cost capture per watched_jit entry (docs/OBSERVABILITY.md "Cost
+    # model & profiling"): auto/lowered = flops + bytes from the lowered
+    # module whenever telemetry is on (~1 ms per compile, no extra XLA
+    # compile); full = also AOT-compile for the peak-HBM memory analysis
+    # (one extra compile per entry); off = never (env LGBTPU_COST wins)
+    telemetry_cost: str = "auto"
+    # directory for a jax.profiler device-trace session wrapped around
+    # train() ("" = off): writes the device trace, the host span shard,
+    # and one merged host+device Perfetto timeline (same machinery as
+    # `python -m lightgbm_tpu.telemetry.profile`)
+    profile_out: str = ""
 
     def __post_init__(self) -> None:
         self._unknown: Dict[str, Any] = {}
@@ -693,6 +706,11 @@ class Config:
             raise LightGBMError(
                 f"fused_iter={self.fused_iter!r} is not one of "
                 "'auto', 'on', 'off'")
+        if str(self.telemetry_cost).strip().lower() not in (
+                "auto", "off", "lowered", "full"):
+            raise LightGBMError(
+                f"telemetry_cost={self.telemetry_cost!r} is not one of "
+                "'auto', 'off', 'lowered', 'full'")
         if self.eval_fetch_freq < 0:
             raise LightGBMError(
                 f"eval_fetch_freq={self.eval_fetch_freq} must be >= 0 "

@@ -118,43 +118,71 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     snapshot_keep = int(params.get("snapshot_keep", -1) or -1)
     output_model = str(params.get("output_model", "LightGBM_model.txt"))
 
-    evaluation_result_list: List = []
-    for i in range(start_iteration, num_boost_round):
-        for cb in callbacks_before:
-            cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=0, end_iteration=num_boost_round,
-                           evaluation_result_list=[]))
-        finished = booster.update()
-        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            # periodic crash-consistent checkpoint: tmp + os.replace with a
-            # sealed manifest, resumable via resume_from (reference:
-            # gbdt.cpp:259-263 Train snapshots; docs/ROBUSTNESS.md)
-            booster.checkpoint(output_model, i + 1, keep=snapshot_keep)
-        _chaos.maybe_kill(i + 1)
+    # profile_out wraps the whole boosting loop in a device-trace session
+    # (jax.profiler + host spans merged onto one Perfetto timeline,
+    # docs/OBSERVABILITY.md "Cost model & profiling")
+    profile_dir = str(params.get("profile_out", "") or "")
+    profile_session = None
+    if profile_dir:
+        from .telemetry.profile import ProfileSession
+        profile_session = ProfileSession(profile_dir).start()
 
-        evaluation_result_list: List = []
-        if valid_sets is not None or feval is not None:
-            if booster.engine.valid_sets:
-                evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    evaluation_result_list: List = []
+    try:
+        for i in range(start_iteration, num_boost_round):
+            for cb in callbacks_before:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
-                               begin_iteration=0, end_iteration=num_boost_round,
-                               evaluation_result_list=evaluation_result_list))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score or []
-            break
-        if finished:
-            log_info("Stopped training because there are no more leaves that "
-                     "meet the split requirements")
-            break
-    else:
-        # loop ran to num_boost_round: growth may have stopped between the
-        # engine's deferred finished-flag polls — drop any trailing no-op
-        # trees so the saved model matches the reference's immediate stop
-        booster.engine._trim_trailing_trivial()
-    booster.engine.flush_nan_guard()
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=[]))
+            finished = booster.update()
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                # periodic crash-consistent checkpoint: tmp + os.replace
+                # with a sealed manifest, resumable via resume_from
+                # (reference: gbdt.cpp:259-263 Train snapshots;
+                # docs/ROBUSTNESS.md)
+                booster.checkpoint(output_model, i + 1, keep=snapshot_keep)
+            _chaos.maybe_kill(i + 1)
+
+            evaluation_result_list: List = []
+            if valid_sets is not None or feval is not None:
+                if booster.engine.valid_sets:
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(CallbackEnv(model=booster, params=params, iteration=i,
+                                   begin_iteration=0,
+                                   end_iteration=num_boost_round,
+                                   evaluation_result_list=evaluation_result_list))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score or []
+                break
+            if finished:
+                log_info("Stopped training because there are no more leaves "
+                         "that meet the split requirements")
+                break
+        else:
+            # loop ran to num_boost_round: growth may have stopped between
+            # the engine's deferred finished-flag polls — drop any trailing
+            # no-op trees so the saved model matches the reference's
+            # immediate stop
+            booster.engine._trim_trailing_trivial()
+        booster.engine.flush_nan_guard()
+    finally:
+        if profile_session is not None:
+            # the session must never cost the caller a trained booster —
+            # an export/merge failure (ENOSPC, unreadable shard) logs and
+            # moves on, and never masks an exception from the loop above
+            try:
+                info = profile_session.stop()
+                log_info(f"profile: merged host+device timeline at "
+                         f"{info['merged_trace']} ({info['merged_events']} "
+                         f"events, {info['shards']} shards)")
+            except Exception as e:  # noqa: BLE001
+                log_warning(f"profile: session export failed "
+                            f"({type(e).__name__}: {e}) — training result "
+                            "is unaffected")
 
     if evaluation_result_list:
         best: Dict[str, Dict[str, float]] = collections.defaultdict(dict)

@@ -29,7 +29,8 @@ from ..ops.predict import StackedTrees, _walk_one_tree
 from ..robustness import chaos as _chaos
 from ..robustness.guards import (NanGuard, check_finite_init,
                                  check_model_trees)
-from ..telemetry import (global_registry as _tel_registry,
+from ..telemetry import (costmodel as _tel_cost,
+                         global_registry as _tel_registry,
                          global_tracer as _tel_tracer, memory_snapshot,
                          watched_jit)
 from ..tree import Tree, TreeArrays, finalize_tree
@@ -1667,16 +1668,18 @@ class GBDT:
             return self._train_one_iter_impl(grad, hess)
         t0 = time.perf_counter()
         ph0 = _tel_tracer.phase_snapshot()
+        cost0 = _tel_cost.dispatch_totals()
         # 1-based, matching the record _emit_iter_record writes after the
         # impl increments iter_ — span N and JSONL row N are the same step
         it = self.iter_ + 1
         with _tel_tracer.span("GBDT::Iteration", iteration=it,
                               booster=self.boosting_type):
             finished = self._train_one_iter_impl(grad, hess)
-        self._emit_iter_record(t0, ph0, finished)
+        self._emit_iter_record(t0, ph0, cost0, finished)
         return finished
 
     def _emit_iter_record(self, t0: float, ph0: Dict[str, float],
+                          cost0: Tuple[float, float],
                           finished: bool) -> None:
         """One telemetry record per boosting iteration.
 
@@ -1753,6 +1756,16 @@ class GBDT:
         self._tel_comms_waits.append(comms_wait or 0.0)
         if len(self._tel_comms_waits) > 1024:
             del self._tel_comms_waits[:512]
+        # device-cost accounting: dispatch-weighted XLA flops and HBM
+        # bytes this iteration executed (telemetry/costmodel.py) — the
+        # fields that tell compute growth from dispatch/comms growth when
+        # s/tree regresses (docs/OBSERVABILITY.md)
+        if _tel_cost.active():
+            cf, cb = _tel_cost.dispatch_totals()
+            rec["flops"] = cf - cost0[0]
+            rec["hbm_bytes"] = cb - cost0[1]
+            _tel_registry.inc("cost/flops", rec["flops"])
+            _tel_registry.inc("cost/hbm_bytes", rec["hbm_bytes"])
         # dispatch accounting: watched_jit launches and noted host syncs
         # this iteration consumed (window means feed the straggler
         # report's `bottleneck: dispatch` classification)

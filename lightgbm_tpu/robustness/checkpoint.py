@@ -60,6 +60,7 @@ _VOLATILE_PARAMS = frozenset({
     "hist_comms", "hist_comms_pipeline", "eval_fetch_freq",
     "telemetry", "telemetry_out", "trace_out", "telemetry_recompile_threshold",
     "telemetry_straggler_every", "telemetry_straggler_skew",
+    "telemetry_cost", "profile_out",
     "serve_host", "serve_port", "serve_max_batch", "serve_max_delay_ms",
     "serve_queue_size", "serve_buckets", "serve_warmup", "serve_heartbeat",
     "serve_replicas", "serve_fleet_mode", "serve_fleet_dir",

@@ -539,6 +539,10 @@ class _Handler(BaseHTTPRequestHandler):
             "recompiles": {k: v for k, v in
                            telemetry.recompile_counts().items()
                            if k.startswith("serve")},
+            # XLA cost records for the serving entry points (flops/bytes/
+            # peak HBM + roofline verdict per compiled bucket program);
+            # the full rollup incl. roofline peaks rides telemetry_summary
+            "cost": telemetry.cost_summary(),
             "slo": app.slo.state(),
             "trace_tail": app.tail.snapshot(last=20),
             "trace_sample": app.trace_sample,

@@ -24,16 +24,18 @@ from __future__ import annotations
 import atexit
 from typing import Any, Dict, Optional
 
+from . import costmodel
 from .context import (TRACE_HEADER, AccessLog, TailRing, TraceContext,
                       new_trace_id, request_complete, request_instant,
                       request_span)
+from .costmodel import cost_summary, machine_balance
 from .metrics import (MetricsRegistry, device_memory_gb, global_registry,
                       host_rss_gb, memory_snapshot)
 from .prometheus import registry_text, render_parts, render_prometheus
 from .tracer import SpanTracer, global_tracer
 from .watchdog import (WatchEntry, get_recompile_threshold, host_sync_count,
                        launch_count, note_host_sync, note_launch,
-                       recompile_counts,
+                       recompile_counts, reset_counters,
                        reset_watchdog, set_recompile_threshold,
                        watchdog_summary, watched_jit)
 
@@ -46,6 +48,7 @@ __all__ = [
     "watched_jit", "recompile_counts", "watchdog_summary",
     "set_recompile_threshold", "get_recompile_threshold", "reset_watchdog",
     "launch_count", "host_sync_count", "note_host_sync", "note_launch",
+    "reset_counters", "costmodel", "cost_summary", "machine_balance",
     "memory_snapshot", "device_memory_gb", "host_rss_gb",
     "TraceContext", "TailRing", "AccessLog", "TRACE_HEADER",
     "new_trace_id", "request_span", "request_complete", "request_instant",
@@ -64,12 +67,15 @@ _enabled_source: Optional[str] = None
 def configure(enabled: bool = True, metrics_out: Optional[str] = None,
               trace_out: Optional[str] = None,
               recompile_threshold: Optional[int] = None,
+              cost_capture: Optional[str] = None,
               _source: str = "api") -> None:
     """Turn telemetry on/off and point its sinks.
 
     ``metrics_out`` — JSONL path for streamed records; ``trace_out`` —
     Chrome trace JSON written by :func:`flush` (training calls it at the
-    end of ``train()``); ``recompile_threshold`` — watchdog warn level."""
+    end of ``train()``); ``recompile_threshold`` — watchdog warn level;
+    ``cost_capture`` — XLA cost-model mode (``auto``/``off``/``lowered``/
+    ``full``, see :mod:`.costmodel`; env ``LGBTPU_COST`` overrides)."""
     global _trace_out, _enabled_source
     if enabled:
         global_tracer.enable()
@@ -79,6 +85,7 @@ def configure(enabled: bool = True, metrics_out: Optional[str] = None,
         global_tracer.disable()
         global_registry.disable()
         _enabled_source = None
+    costmodel.configure(enabled=enabled, mode=cost_capture)
     if metrics_out is not None:
         global_registry.set_sink(metrics_out or None)
     if trace_out is not None:
@@ -104,9 +111,11 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear collected spans/metrics (keeps enabled state and sinks)."""
+    """Clear collected spans/metrics/cost records (keeps enabled state
+    and sinks)."""
     global_tracer.reset()
     global_registry.reset()
+    costmodel.reset()
 
 
 # -- thin instrument aliases (the hot-path entry points) --------------------
@@ -156,6 +165,9 @@ def summary() -> Dict[str, Any]:
                    for k, v in sorted(phases.items(),
                                       key=lambda kv: -kv[1])},
         "recompiles": watchdog_summary(),
+        # XLA flops/HBM accounting + roofline verdicts per watched entry
+        # (docs/OBSERVABILITY.md "Cost model & profiling")
+        "cost": cost_summary(),
         "memory": memory_snapshot(),
         # events the bounded span buffer had to drop (the tracer warns
         # once when this first goes nonzero)

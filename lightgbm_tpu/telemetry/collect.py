@@ -24,10 +24,19 @@ from __future__ import annotations
 
 import argparse
 import glob
+import gzip
 import json
 import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _read_blob(path: str) -> Dict[str, Any]:
+    """One shard file -> parsed blob; ``.gz`` shards (jax.profiler's
+    ``*.trace.json.gz``) are transparently decompressed."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        return json.load(fh)
 
 
 def _find_anchor(blob: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -60,8 +69,7 @@ def merge_traces(paths: Sequence[str], trace_id: Optional[str] = None
     shards: List[Dict[str, Any]] = []
     for path in paths:
         try:
-            with open(path) as fh:
-                blob = json.load(fh)
+            blob = _read_blob(path)
         except (OSError, ValueError) as e:
             raise RuntimeError(f"cannot read trace shard {path!r}: {e}")
         shards.append({"path": path, "blob": blob,
@@ -114,6 +122,7 @@ def merge_traces(paths: Sequence[str], trace_id: Optional[str] = None
             "events": n_events,
         })
     events.sort(key=lambda ev: ev.get("ts", 0.0))
+    stamped = [float(ev["ts"]) for ev in events if "ts" in ev]
     blob = {
         "traceEvents": meta + events,
         "displayTimeUnit": "ms",
@@ -129,8 +138,9 @@ def merge_traces(paths: Sequence[str], trace_id: Optional[str] = None
         "unaligned_shards": [s["path"] for s in shards
                              if s["anchor"] is None],
         "events": len(events),
-        "span_ms": round((events[-1]["ts"] - events[0]["ts"]) / 1e3, 3)
-        if len(events) > 1 else 0.0,
+        # device shards may carry flow/metadata events without a ts
+        "span_ms": round((max(stamped) - min(stamped)) / 1e3, 3)
+        if len(stamped) > 1 else 0.0,
         "processes": sorted({ev["pid"] for ev in events
                              if isinstance(ev.get("pid"), int)}),
     }
@@ -141,7 +151,9 @@ def _expand(inputs: Sequence[str]) -> List[str]:
     out: List[str] = []
     for item in inputs:
         if os.path.isdir(item):
-            out.extend(sorted(glob.glob(os.path.join(item, "trace*.json"))))
+            out.extend(sorted(glob.glob(os.path.join(item, "trace*.json"))
+                              + glob.glob(os.path.join(item,
+                                                       "trace*.json.gz"))))
         else:
             out.append(item)
     return out

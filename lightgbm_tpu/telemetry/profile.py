@@ -1,0 +1,251 @@
+"""Device-trace profiling session: XLA timeline + host spans, merged.
+
+The span tracer (:mod:`.tracer`) sees the HOST side of a run — phases,
+queue waits, dispatch points.  ``jax.profiler.trace()`` sees the DEVICE
+side — every XLA op's start/stop on the accelerator timeline.  Each alone
+answers half of "where did the time go"; this module runs a workload under
+both and merges them onto ONE wall-clock-aligned Perfetto timeline using
+the tracer's clock anchor (:meth:`SpanTracer.clock_sync` — the
+``time.time()`` paired with the ``perf_counter`` epoch) and the session's
+own anchor captured at ``start_trace``.
+
+API::
+
+    from lightgbm_tpu.telemetry.profile import ProfileSession
+    session = ProfileSession("prof_out").start()
+    ...   # train / serve / anything that dispatches XLA programs
+    info = session.stop()         # info["merged_trace"] -> Perfetto file
+
+or wrap training declaratively with the ``profile_out`` param — ``train()``
+runs the whole boosting loop inside a session and logs the merged path.
+
+CLI::
+
+    python -m lightgbm_tpu.telemetry.profile -o prof_out            # tiny
+                                                      # synthetic training
+    python -m lightgbm_tpu.telemetry.profile -o prof_out --task serve
+    python -m lightgbm_tpu.telemetry.profile -o prof_out -- \
+        task=train data=train.csv num_iterations=50   # full CLI workload
+
+Outputs in the session directory: ``device/`` (the raw jax.profiler dump,
+TensorBoard-loadable), ``trace_host.json`` (host span shard),
+``trace_device.json`` (device shard re-anchored to wall clock), and
+``merged_trace.json`` (the combined Perfetto timeline).  If the backend
+cannot produce a device trace the session degrades to the host shard and
+says so in the returned summary — never an exception on the workload
+path.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .collect import merge_traces, write_merged
+from .tracer import global_tracer
+
+
+class ProfileSession:
+    """One profiling window: host tracer + ``jax.profiler`` together."""
+
+    def __init__(self, out_dir: str, keep_python_frames: bool = False
+                 ) -> None:
+        self.out_dir = str(out_dir)
+        self.device_dir = os.path.join(self.out_dir, "device")
+        # the profiler's own python-stack sampler emits "$file:line fn"
+        # frames — hundreds of MB that duplicate the host span tracer's
+        # job; dropped from the merged shard unless explicitly kept (the
+        # raw dump under device/ always has them)
+        self.keep_python_frames = keep_python_frames
+        self._t_unix: Optional[float] = None
+        self._device_started = False
+        self._device_error: Optional[str] = None
+        self._was_enabled = False
+
+    def start(self) -> "ProfileSession":
+        import jax
+        os.makedirs(self.device_dir, exist_ok=True)
+        from . import enabled as _tel_enabled
+        self._was_enabled = _tel_enabled()
+        if not self._was_enabled:
+            # spans are the host half of the merge; turn the tracer on for
+            # the session (left on afterwards — disabling would also kill
+            # a caller's own telemetry mid-run)
+            from . import configure
+            configure(enabled=True)
+        # the device shard's wall-clock anchor: jax.profiler timestamps
+        # are relative to start_trace, so the unix time AT start_trace is
+        # what aligns them with the host shard's clock_sync
+        self._t_unix = time.time()
+        try:
+            jax.profiler.start_trace(self.device_dir)
+            self._device_started = True
+        except Exception as e:   # noqa: BLE001 — degrade, don't break work
+            self._device_error = f"{type(e).__name__}: {e}"
+        return self
+
+    def __enter__(self) -> "ProfileSession":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def _device_shard(self) -> Optional[str]:
+        """Re-anchor THIS session's jax.profiler Chrome trace onto the
+        wall clock and write it as a mergeable shard.  A reused out_dir
+        may hold earlier sessions' dumps — only traces written since
+        this session's start are candidates, so a failed profiler start
+        can never silently re-anchor a stale timeline."""
+        pattern = os.path.join(self.device_dir, "plugins", "profile",
+                               "*", "*.trace.json.gz")
+        candidates = sorted(
+            (p for p in glob.glob(pattern)
+             if os.path.getmtime(p) >= (self._t_unix or 0.0) - 1.0),
+            key=os.path.getmtime)
+        if not candidates:
+            self._device_error = (self._device_error
+                                  or "profiler produced no trace.json.gz")
+            return None
+        try:
+            with gzip.open(candidates[-1], "rt") as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            self._device_error = f"unreadable device trace: {e}"
+            return None
+        if not self.keep_python_frames:
+            blob["traceEvents"] = [
+                ev for ev in blob.get("traceEvents", [])
+                if not str(ev.get("name", "")).startswith("$")]
+        blob.setdefault("otherData", {})["clock_sync"] = {
+            "unix_time_s": self._t_unix,
+            "pid": os.getpid(),
+            "producer": "jax.profiler",
+        }
+        path = os.path.join(self.out_dir, "trace_device.json")
+        return write_merged(blob, path)
+
+    def stop(self) -> Dict[str, Any]:
+        """End the session; returns paths + merge summary."""
+        import jax
+        device_ran = False
+        if self._device_started:
+            try:
+                jax.profiler.stop_trace()
+                device_ran = True
+            except Exception as e:   # noqa: BLE001
+                self._device_error = f"stop_trace failed: {e}"
+            self._device_started = False
+        host_path = os.path.join(self.out_dir, "trace_host.json")
+        global_tracer.export_trace(host_path)
+        shards: List[str] = [host_path]
+        # no successful device session -> no device shard: a stale dump
+        # from a previous run in the same out_dir must not be re-anchored
+        device_path = self._device_shard() if device_ran else None
+        if device_path is not None:
+            shards.append(device_path)
+        merged_path = os.path.join(self.out_dir, "merged_trace.json")
+        blob, msum = merge_traces(shards)
+        write_merged(blob, merged_path)
+        out: Dict[str, Any] = {
+            "out_dir": self.out_dir,
+            "host_trace": host_path,
+            "device_trace": device_path,
+            "merged_trace": merged_path,
+            "merged_events": msum["events"],
+            "shards": msum["shards"],
+            "span_ms": msum["span_ms"],
+        }
+        if self._device_error:
+            out["device_trace_error"] = self._device_error
+        return out
+
+
+# -- CLI workloads ----------------------------------------------------------
+def _synthetic_data(rows: int, features: int = 16, seed: int = 7):
+    """The shared seeded workload generator — the profile CLI and the
+    perf sentinel's budget measurement both use THIS, so the two
+    surfaces can never drift onto different data."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    X = rs.randn(rows, features).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + 0.3 * rs.randn(rows) > 0).astype(np.float64)
+    return X, y
+
+
+def _run_train(rows: int, iters: int) -> Dict[str, Any]:
+    import lightgbm_tpu as lgb
+    X, y = _synthetic_data(rows)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "telemetry": True},
+                    lgb.Dataset(X, label=y), num_boost_round=iters)
+    return {"workload": "train", "rows": rows, "iterations": iters,
+            "trees": bst.num_trees()}
+
+
+def _run_serve(rows: int, iters: int) -> Dict[str, Any]:
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from ..serving.registry import ModelRegistry
+    X, y = _synthetic_data(rows)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=iters)
+    with tempfile.TemporaryDirectory(prefix="lgb_profile_") as td:
+        path = os.path.join(td, "model.txt")
+        bst.save_model(path)
+        reg = ModelRegistry(path, max_batch=64)
+        model = reg.current()
+        served = 0
+        for m in (1, 8, 64):
+            model.predict(X[:m], raw_score=True)
+            served += m
+    return {"workload": "serve", "rows_scored": served,
+            "trees": bst.num_trees()}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.telemetry.profile",
+        description="Run a workload under jax.profiler + the host span "
+                    "tracer and merge both onto one Perfetto timeline.")
+    ap.add_argument("-o", "--out", default="profile_out",
+                    help="session directory (default profile_out)")
+    ap.add_argument("--task", choices=("train", "serve"), default="train",
+                    help="built-in synthetic workload (default train)")
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("cli", nargs="*", metavar="key=value",
+                    help="after '--': full lightgbm_tpu CLI params to run "
+                         "under the session instead of the synthetic task")
+    args = ap.parse_args(argv)
+    session = ProfileSession(args.out).start()
+    try:
+        if args.cli:
+            from ..cli import main as cli_main
+            rc = cli_main(list(args.cli))
+            work: Dict[str, Any] = {"workload": "cli", "rc": rc}
+        elif args.task == "serve":
+            work = _run_serve(args.rows, args.iters)
+        else:
+            work = _run_train(args.rows, args.iters)
+    finally:
+        info = session.stop()
+    print(json.dumps({**work, **info}))
+    if info.get("device_trace_error"):
+        print(f"profile: WARNING device trace unavailable "
+              f"({info['device_trace_error']}) — merged timeline holds "
+              "host spans only", file=sys.stderr)
+    return int(work.get("rc", 0) or 0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 import jax
 
 from ..utils.log import log_warning
+from . import costmodel as _costmodel
 
 _lock = threading.Lock()
 # weak enumeration for summaries: an entry stays alive exactly as long as
@@ -77,6 +78,17 @@ def note_launch(n: int = 1) -> None:
     _launches += n
 
 
+def reset_counters() -> None:
+    """Zero the module-global ``launches``/``host_syncs`` dispatch
+    counters.  Per-entry compile counters have :func:`reset_watchdog`;
+    this is the A/B counterpart for the globals — bench arms call it at
+    the start of each timed arm so launches/iter and host_syncs/iter are
+    attributable to THAT arm, not contaminated by the previous one."""
+    global _launches, _host_syncs
+    _launches = 0
+    _host_syncs = 0
+
+
 class WatchEntry:
     """Compile counter for one watched entry point."""
 
@@ -86,6 +98,9 @@ class WatchEntry:
         self.count = 0
         self.signatures: List[str] = []   # last few trace signatures
         self.warned = 0
+        # trace count already cost-captured (telemetry/costmodel.py);
+        # count > cost_seen means a fresh compile awaits capture
+        self.cost_seen = 0
 
     def effective_threshold(self) -> int:
         return _default_threshold if self.warn_after is None else self.warn_after
@@ -183,19 +198,89 @@ def watched_jit(fun=None, *, name: Optional[str] = None, owner: Any = None,
             # C++ fast path still runs inside
             global _launches
             _launches += 1
-            return jitted(*args, **kwargs)
+            out = jitted(*args, **kwargs)
+            if _costmodel.active():
+                _costmodel.after_dispatch(entry, jitted, args, kwargs)
+            return out
 
         dispatched._telemetry_watch = entry
         dispatched._jitted = jitted
         # forward the jit AOT/introspection surface the wrapper would
-        # otherwise hide (entry compile uses .lower(...).compile())
-        for attr in ("lower", "trace", "eval_shape", "clear_cache"):
+        # otherwise hide — with the compile/execute path WATCHED: a
+        # `.lower(...).compile()` entry compile counts against the same
+        # entry (and feeds the cost model), and calls on the compiled
+        # executable count as launches, so the AOT surface cannot bypass
+        # the recompile/dispatch accounting
+        def lower(*args, **kwargs):
+            c0 = entry.count
+            lowered = jitted.lower(*args, **kwargs)
+            # a jaxpr-cache miss runs `traced` during lower and already
+            # counted; the wrapper must then NOT count the .compile() too
+            return _WatchedLowered(lowered, entry, args, kwargs,
+                                   counted=entry.count > c0)
+
+        dispatched.lower = lower
+        for attr in ("trace", "eval_shape", "clear_cache"):
             bound = getattr(jitted, attr, None)
             if bound is not None:
                 setattr(dispatched, attr, bound)
         return dispatched
 
     return wrap if fun is None else wrap(fun)
+
+
+class _WatchedLowered:
+    """Forwarded ``.lower(...)`` result whose ``.compile()`` stays on the
+    books: the AOT entry compile increments the entry's trace counter
+    (``recompile/<name>`` included) and hands the compiled executable to
+    the cost model — the full analysis for free, since the caller paid
+    for the compile anyway."""
+
+    __slots__ = ("_lowered", "_entry", "_args", "_kwargs", "_counted")
+
+    def __init__(self, lowered, entry: WatchEntry, args: tuple,
+                 kwargs: dict, counted: bool = False) -> None:
+        self._lowered = lowered
+        self._entry = entry
+        self._args = args
+        self._kwargs = kwargs
+        self._counted = counted
+
+    def compile(self, *args, **kwargs):
+        compiled = self._lowered.compile(*args, **kwargs)
+        if not self._counted:
+            # lower() hit the jaxpr cache, so nothing counted this entry
+            # compile yet — an AOT compile of an already-traced signature
+            # is still a real XLA compile
+            self._entry.note_trace(self._args, self._kwargs)
+        self._counted = False   # a second .compile() of this Lowered counts
+        _costmodel.note_compiled(self._entry, compiled)
+        return _WatchedCompiled(compiled, self._entry)
+
+    def __getattr__(self, name):
+        return getattr(self._lowered, name)
+
+
+class _WatchedCompiled:
+    """AOT executable wrapper: every call is one XLA program execution,
+    so it lands in the ``launches`` counter like a jit dispatch."""
+
+    __slots__ = ("_compiled", "_entry")
+
+    def __init__(self, compiled, entry: WatchEntry) -> None:
+        self._compiled = compiled
+        self._entry = entry
+
+    def __call__(self, *args, **kwargs):
+        global _launches
+        _launches += 1
+        out = self._compiled(*args, **kwargs)
+        if _costmodel.active():
+            _costmodel.note_dispatch(self._entry)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._compiled, name)
 
 
 def recompile_counts() -> Dict[str, int]:
@@ -231,3 +316,4 @@ def reset_watchdog() -> None:
             entry.count = 0
             entry.signatures = []
             entry.warned = 0
+            entry.cost_seen = 0

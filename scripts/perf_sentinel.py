@@ -1,0 +1,312 @@
+"""Perf-regression sentinel: cost budgets + bench-history compare.
+
+Wall-clock on a shared test box is noisy; XLA flops, peak-HBM bytes, and
+launches-per-iteration are not — they are properties of the compiled
+programs.  The sentinel therefore gates on two complementary surfaces
+(docs/OBSERVABILITY.md "Perf-regression sentinel"):
+
+**Budget mode** (``--budgets PERF_BUDGETS.json --measure``): trains the
+manifest's fixed small workload with full cost capture
+(telemetry/costmodel.py), exercises the serving predictor, and compares
+each watched entry's measured flops / peak-HBM / launches-per-iter
+against its budget ceiling.  Deterministic on any box — silent compute
+bloat (an accidental f32 upcast, a lost fusion, a new per-round gather)
+fails here even when wall-clock noise would hide it.  Entries whose
+backend reports no cost analysis are ``unavailable`` and are SKIPPED
+with a notice — never treated as zero (a zero would read as a 100%
+improvement and grandfather real regressions under a later budget
+refresh).
+
+**History mode** (``--history BENCH_HISTORY.jsonl``): compares the
+newest bench value per (metric, host) against the median of its
+predecessors on the SAME host, directional per metric (qps up is good,
+s/tree down is good), with a noise tolerance.  Hosts with fewer than
+``--min-runs`` entries are skipped with a notice, so the gate is safe to
+run everywhere and only bites where history exists.
+
+Exit status: 0 = all checks passed/skipped, 1 = regression, 2 = usage /
+manifest error.  ``--current FILE`` substitutes a saved measurement for
+``--measure`` (fixture injection for tests; also useful to re-judge one
+measurement against edited budgets without retraining).
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# the budget workload: small, fixed, seeded — flops/HBM are then pure
+# functions of the compiled programs, comparable across boxes
+DEFAULT_WORKLOAD = {
+    "rows": 20_000, "features": 16, "num_leaves": 31, "max_bin": 63,
+    "iters": 4, "seed": 7,
+}
+# fields a budget entry may bound (ceilings; measured must stay under
+# budget * (1 + tolerance))
+BUDGET_FIELDS = ("flops", "bytes_accessed", "peak_hbm_bytes")
+
+
+def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Train the fixed workload + exercise serving with full cost capture;
+    returns {entries, launches_per_iter, workload, platform}."""
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.telemetry import costmodel
+    from lightgbm_tpu.telemetry.profile import _synthetic_data
+
+    w = {**DEFAULT_WORKLOAD, **(workload or {})}
+    X, y = _synthetic_data(int(w["rows"]), int(w["features"]),
+                           int(w["seed"]))
+    params = {
+        "objective": "binary", "num_leaves": int(w["num_leaves"]),
+        "max_bin": int(w["max_bin"]), "learning_rate": 0.1,
+        "verbosity": -1, "telemetry": True, "telemetry_cost": "full",
+    }
+    # an exported LGBTPU_COST (e.g. "off" on a dev box) overrides the
+    # param and would let the gate pass vacuously with zero checks —
+    # the sentinel's measurement MUST run at full capture
+    cost_env = os.environ.pop("LGBTPU_COST", None)
+    try:
+        telemetry.reset_watchdog()
+        telemetry.reset_counters()
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=int(w["iters"]))
+        if costmodel.mode() != "full":
+            raise RuntimeError(
+                f"cost capture resolved to {costmodel.mode()!r}, not "
+                "'full' — the budget measurement would be vacuous")
+    finally:
+        if cost_env is not None:
+            os.environ["LGBTPU_COST"] = cost_env
+    # serving entry: the bucketed compiled predictor (serve_predict)
+    with tempfile.TemporaryDirectory(prefix="lgb_sentinel_") as td:
+        path = os.path.join(td, "model.txt")
+        bst.save_model(path)
+        from lightgbm_tpu.serving.registry import ModelRegistry
+        reg = ModelRegistry(path, max_batch=64)
+        reg.current().predict(X[:8], raw_score=True)
+    from lightgbm_tpu.telemetry import global_registry
+    recs = [r for r in global_registry.records
+            if r.get("event") == "iteration" and "launches" in r]
+    # steady state: the first iteration carries the compile-time eager
+    # setup dispatches — budgets bound the repeated per-iteration cost
+    steady = [float(r["launches"]) for r in recs[1:]] or \
+        [float(r["launches"]) for r in recs]
+    launches_per_iter = max(steady) if steady else 0.0
+    entries: Dict[str, Any] = {}
+    unavailable: List[str] = []
+    for name, rec in costmodel.cost_records().items():
+        if rec.get("available"):
+            entries[name] = {k: rec[k] for k in
+                             (*BUDGET_FIELDS, "intensity", "verdict")
+                             if k in rec}
+        else:
+            unavailable.append(name)
+            entries[name] = {"available": False,
+                             "error": rec.get("error", "")}
+    import jax
+    return {
+        "workload": w,
+        "platform": jax.default_backend(),
+        "entries": entries,
+        "launches_per_iter": round(launches_per_iter, 3),
+        "unavailable": sorted(unavailable),
+    }
+
+
+def compare_budgets(measured: Dict[str, Any], budgets: Dict[str, Any]
+                    ) -> Tuple[List[str], List[str], int]:
+    """(violations, skipped_notices, checks_run) for one measurement."""
+    tol = float(budgets.get("tolerance", 0.10))
+    violations: List[str] = []
+    skipped: List[str] = []
+    checks = 0
+    m_entries = measured.get("entries", {})
+    for name, limits in sorted(budgets.get("entries", {}).items()):
+        got = m_entries.get(name)
+        if got is None:
+            skipped.append(f"{name}: not exercised by the sentinel "
+                           "workload (no cost record)")
+            continue
+        if got.get("available") is False:
+            skipped.append(f"{name}: cost analysis unavailable on this "
+                           f"backend ({got.get('error', '?')}) — budget "
+                           "NOT judged (unavailable is never zero)")
+            continue
+        for field in BUDGET_FIELDS:
+            if field not in limits:
+                continue
+            limit = float(limits[field])
+            val = got.get(field)
+            if val is None:
+                skipped.append(f"{name}.{field}: not captured "
+                               "(lowered-only record?) — skipped")
+                continue
+            checks += 1
+            if float(val) > limit * (1.0 + tol):
+                violations.append(
+                    f"{name}.{field}: measured {float(val):.6g} exceeds "
+                    f"budget {limit:.6g} (+{tol:.0%} tolerance) — "
+                    f"{float(val) / limit:.2f}x")
+    lpi_max = budgets.get("launches_per_iter_max")
+    if lpi_max is not None:
+        checks += 1
+        lpi = float(measured.get("launches_per_iter", 0.0))
+        if lpi > float(lpi_max):
+            violations.append(
+                f"launches_per_iter: measured {lpi} exceeds budget "
+                f"{lpi_max} — dispatch-count bloat")
+    return violations, skipped, checks
+
+
+def _metric_direction(metric: str) -> int:
+    """+1 = higher is better (throughput), -1 = lower is better."""
+    m = metric.lower()
+    return +1 if ("qps" in m or "throughput" in m) else -1
+
+
+def check_history(path: str, tolerance: float = 0.25, min_runs: int = 3
+                  ) -> Tuple[List[str], List[str], int]:
+    """Latest value per (metric, host) vs the median of its same-host
+    predecessors; returns (violations, notices, checks_run)."""
+    if not os.path.exists(path):
+        return [], [f"no history file at {path} — nothing to compare"], 0
+    rows: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and row.get("metric") is not None \
+                    and isinstance(row.get("value"), (int, float)):
+                rows.append(row)
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for row in rows:
+        key = (str(row["metric"]), str(row.get("host", "unknown")))
+        groups.setdefault(key, []).append(row)
+    violations: List[str] = []
+    notices: List[str] = []
+    checks = 0
+    for (metric, host), grp in sorted(groups.items()):
+        if len(grp) < min_runs:
+            notices.append(f"{metric}@{host}: {len(grp)} run(s) < "
+                           f"{min_runs} — wall-clock compare skipped")
+            continue
+        grp = sorted(grp, key=lambda r: str(r.get("date", "")))
+        latest = float(grp[-1]["value"])
+        # baseline = median of the most recent prior runs: a years-old
+        # 100x-slower entry must not dilute the bar the latest run clears
+        prior = grp[max(0, len(grp) - 6):-1]
+        base = statistics.median(float(r["value"]) for r in prior)
+        if base <= 0.0:
+            notices.append(f"{metric}@{host}: non-positive baseline "
+                           f"{base} — skipped")
+            continue
+        checks += 1
+        direction = _metric_direction(metric)
+        if direction < 0 and latest > base * (1.0 + tolerance):
+            violations.append(
+                f"{metric}@{host}: latest {latest:.6g} is "
+                f"{latest / base:.2f}x the median of the last "
+                f"{len(prior)} prior runs ({base:.6g}; +{tolerance:.0%} "
+                "tolerance, lower is better)")
+        elif direction > 0 and latest < base * (1.0 - tolerance):
+            violations.append(
+                f"{metric}@{host}: latest {latest:.6g} is "
+                f"{latest / base:.2f}x the median of the last "
+                f"{len(prior)} prior runs ({base:.6g}; -{tolerance:.0%} "
+                "tolerance, higher is better)")
+    return violations, notices, checks
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/perf_sentinel.py",
+        description="Gate compiled-program cost budgets and bench "
+                    "wall-clock history against regressions.")
+    ap.add_argument("--budgets", default=None,
+                    help="PERF_BUDGETS.json manifest path")
+    ap.add_argument("--measure", action="store_true",
+                    help="measure the budget workload in-process")
+    ap.add_argument("--current", default=None,
+                    help="saved measurement JSON instead of --measure")
+    ap.add_argument("--history", default=None,
+                    help="BENCH_HISTORY.jsonl path for wall-clock compare")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="history noise tolerance (default 0.25)")
+    ap.add_argument("--min-runs", type=int, default=3,
+                    help="history entries per (metric, host) needed "
+                         "before comparing (default 3)")
+    ap.add_argument("--save-measurement", default=None,
+                    help="write the --measure result JSON here (budget "
+                         "recalibration workflow)")
+    args = ap.parse_args(argv)
+    if not args.budgets and not args.history:
+        ap.error("nothing to do: pass --budgets and/or --history")
+
+    all_violations: List[str] = []
+    if args.budgets:
+        try:
+            with open(args.budgets) as fh:
+                budgets = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"perf_sentinel: cannot read budgets {args.budgets!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        if args.current:
+            try:
+                with open(args.current) as fh:
+                    measured = json.load(fh)
+            except (OSError, ValueError) as e:
+                print(f"perf_sentinel: cannot read measurement "
+                      f"{args.current!r}: {e}", file=sys.stderr)
+                return 2
+        elif args.measure:
+            measured = measure(budgets.get("workload"))
+        else:
+            ap.error("--budgets needs --measure or --current FILE")
+        if args.save_measurement:
+            tmp = f"{args.save_measurement}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(measured, fh, indent=2)
+            os.replace(tmp, args.save_measurement)
+        violations, skipped, checks = compare_budgets(measured, budgets)
+        for s in skipped:
+            print(f"perf_sentinel: NOTICE {s}")
+        print(f"perf_sentinel: budgets — {checks} check(s), "
+              f"{len(violations)} violation(s), {len(skipped)} skipped "
+              f"[platform {measured.get('platform', '?')}]")
+        all_violations += violations
+
+    if args.history:
+        violations, notices, checks = check_history(
+            args.history, tolerance=args.tolerance, min_runs=args.min_runs)
+        for s in notices:
+            print(f"perf_sentinel: NOTICE {s}")
+        print(f"perf_sentinel: history — {checks} comparison(s), "
+              f"{len(violations)} regression(s)")
+        all_violations += violations
+
+    for v in all_violations:
+        print(f"perf_sentinel: REGRESSION {v}", file=sys.stderr)
+    if all_violations:
+        print("perf_sentinel: FAIL — see regressions above (recalibrate "
+              "PERF_BUDGETS.json only for UNDERSTOOD cost changes, with "
+              "the measurement attached)", file=sys.stderr)
+        return 1
+    print("perf_sentinel: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

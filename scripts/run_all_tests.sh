@@ -71,6 +71,15 @@ BENCH_TASK=goss \
 BENCH_ROWS="${BENCH_ROWS:-100000}" \
 BENCH_GOSS_ITERS="${BENCH_GOSS_ITERS:-5}" \
     python bench.py
+# perf sentinel: compiled-program cost budgets (per-entry XLA flops,
+# peak-HBM bytes, launches/iter on a fixed small workload vs
+# PERF_BUDGETS.json — deterministic, so the gate holds on any test box)
+# plus the wall-clock history compare, which only bites where
+# BENCH_HISTORY.jsonl already holds >= 3 same-host runs of a metric
+# (docs/OBSERVABILITY.md "Perf-regression sentinel")
+echo "=== stage: perf sentinel (cost budgets + bench history) ==="
+python scripts/perf_sentinel.py --budgets PERF_BUDGETS.json --measure \
+    --history BENCH_HISTORY.jsonl
 # fleet chaos bench: 3 replicas under sustained loopback load while
 # chaos SIGKILLs one and wedges another mid-run, with a mid-chaos
 # fleet-wide /reload — gates on zero non-503 errors, bitwise-exact

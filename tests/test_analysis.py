@@ -14,6 +14,7 @@ from lightgbm_tpu.analysis.rules import all_rules
 from lightgbm_tpu.analysis.rules.atomic_io import AtomicIORule
 from lightgbm_tpu.analysis.rules.collective_axis import CollectiveAxisRule
 from lightgbm_tpu.analysis.rules.config_doc import ConfigDocRule
+from lightgbm_tpu.analysis.rules.cost_attribution import CostAttributionRule
 from lightgbm_tpu.analysis.rules.determinism import DeterminismRule
 from lightgbm_tpu.analysis.rules.host_sync import HostSyncRule
 from lightgbm_tpu.analysis.rules.jit_discipline import JitDisciplineRule
@@ -336,6 +337,47 @@ def test_lgb009_unrelated_receivers_clean(tmp_path):
     assert run_snippet(tmp_path, src, MetricNameRule()) == []
 
 
+def test_lgb009_cost_family_allowed(tmp_path):
+    # cost/<entry>/<field> is bounded by the watched_jit entry set (the
+    # same budget as recompile/<name>; LGB010 keeps names stable)
+    src = ("from lightgbm_tpu import telemetry\n"
+           "def capture(name, flops):\n"
+           "    telemetry.gauge(f'cost/{name}/flops', flops)\n"      # ok
+           "    telemetry.gauge(f'cost/{name}/peak_hbm_bytes', 1)\n"  # ok
+           "    telemetry.gauge(f'cost/{name}', flops)\n")            # line 5
+    found = run_snippet(tmp_path, src, MetricNameRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB009", 5)]
+
+
+def test_lgb010_watched_jit_without_name_trips(tmp_path):
+    src = ("import functools\n"
+           "from lightgbm_tpu.telemetry.watchdog import watched_jit\n"
+           "def build(engine, fn, key):\n"
+           "    a = watched_jit(fn, owner=engine)\n"                  # line 4
+           "    b = functools.partial(watched_jit, warn_after=0)\n"   # line 5
+           "    c = watched_jit(fn, name=key)\n"                      # line 6
+           "    return a, b, c\n"
+           "@watched_jit\n"                                           # line 8
+           "def bare(x):\n"
+           "    return x\n")
+    found = run_snippet(tmp_path, src, CostAttributionRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB010", 4), ("LGB010", 5), ("LGB010", 6), ("LGB010", 8)]
+    assert "cost" in found[0].message
+    assert "literal" in found[2].message
+
+
+def test_lgb010_named_call_sites_clean(tmp_path):
+    src = ("import functools\n"
+           "from lightgbm_tpu.telemetry.watchdog import watched_jit\n"
+           "@functools.partial(watched_jit, name='kernel', warn_after=0)\n"
+           "def kernel(x):\n"
+           "    return x\n"
+           "def build(engine, fn):\n"
+           "    return watched_jit(fn, name='grow_tree', owner=engine)\n")
+    assert run_snippet(tmp_path, src, CostAttributionRule()) == []
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: baseline round-trip, stale entries, parse errors
 # ---------------------------------------------------------------------------
@@ -402,12 +444,12 @@ def test_cli_json_output(capsys, monkeypatch):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["findings"] == [] and out["stale_baseline"] == []
-    assert len(out["checked_rules"]) == 9
+    assert len(out["checked_rules"]) == 10
 
 
 def test_cli_list_rules(capsys):
     assert eng.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("LGB001", "LGB002", "LGB003", "LGB004", "LGB005",
-                "LGB006", "LGB007", "LGB008", "LGB009"):
+                "LGB006", "LGB007", "LGB008", "LGB009", "LGB010"):
         assert rid in out
