@@ -913,24 +913,79 @@ def run_multichip_bench() -> bool:
     return ok
 
 
+def _serve_exactness_side_models(td):
+    """Categorical(+NaN) and multiclass models scored over the BINARY
+    wire at every bucket size, bitwise against Booster.predict — the
+    acceptance matrix the 10k-QPS headline must not trade away."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import BinaryClient, ServingApp
+
+    ok = True
+    rs = np.random.RandomState(11)
+    n = 900
+    Xc = 0.01 * rs.randn(n, 6)
+    Xc[:, 4] = rs.randint(0, 6, n)
+    Xc[rs.rand(n) < 0.15, 0] = np.nan
+    yc = 3.0 * np.isin(Xc[:, 4], [1, 4]).astype(float) + 0.01 * rs.randn(n)
+    ym = rs.randint(0, 3, n).astype(np.float64)
+    flavors = [
+        ("cat", {"objective": "regression", "max_cat_to_onehot": 1}, yc),
+        ("multiclass", {"objective": "multiclass", "num_class": 3}, ym),
+    ]
+    for name, extra, yv in flavors:
+        bst = lgb.train({"num_leaves": 15, "verbosity": -1,
+                         "min_data_in_leaf": 5, **extra},
+                        lgb.Dataset(Xc, label=yv, categorical_feature=[4]),
+                        num_boost_round=5)
+        mp = os.path.join(td, f"model_{name}.txt")
+        bst.save_model(mp)
+        ref = lgb.Booster(model_file=mp)
+        app = ServingApp(mp, port=0, max_batch=64, max_delay_ms=1.0,
+                         binary_port=0).start()
+        try:
+            ladder = app.registry.current().describe()["buckets"]
+            with BinaryClient(app.host, app.binary_port) as c:
+                for m in ladder:
+                    for raw in (True, False):
+                        resp = c.request(Xc[:m], raw_score=raw)
+                        good = (resp["status"] == 0 and np.array_equal(
+                            np.asarray(resp["predictions"]),
+                            ref.predict(Xc[:m], raw_score=raw)))
+                        if not good:
+                            print(f"serve exactness FAIL: {name} bucket "
+                                  f"{m} raw={raw}")
+                            ok = False
+        finally:
+            app.shutdown(drain=True)
+    return ok
+
+
 def run_serve_bench():
-    """BENCH_SERVE=1: loopback serving throughput — sustained QPS and
-    client-side p50/p99 latency over concurrent mixed-size requests, with
-    a zero-recompiles-after-warmup gate (the telemetry watchdog counters
-    must not move during the timed window) and an exactness gate (served
-    scores bitwise equal Booster.predict)."""
+    """BENCH_SERVE=1: loopback serving throughput over BOTH wires.
+
+    The binary row protocol (docs/SERVING.md "Binary wire protocol") is
+    the headline: persistent connections, pipelined single-row frames,
+    gated on sustained QPS >= BENCH_SERVE_QPS_MIN (default 10k), window
+    p99 <= BENCH_SERVE_P99_MS, ZERO errors, ZERO XLA recompiles after
+    warmup, and bitwise exactness against ``Booster.predict`` on every
+    bucket size for numeric(+NaN), categorical(+NaN), and multiclass
+    models.  The JSON/HTTP arm keeps its historical serve_loopback_qps
+    series for comparison."""
     import http.client
     import tempfile
     import threading
 
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.serving import ServingApp
+    from lightgbm_tpu.serving import BinaryClient, ServingApp
     from lightgbm_tpu.telemetry import recompile_counts
 
     rows = int(os.environ.get("BENCH_SERVE_ROWS", 200_000))
     iters = int(os.environ.get("BENCH_SERVE_MODEL_ITERS", 50))
     secs = float(os.environ.get("BENCH_SERVE_SECS", 5.0))
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    window = int(os.environ.get("BENCH_SERVE_WINDOW", 32))
+    qps_min = float(os.environ.get("BENCH_SERVE_QPS_MIN", 10_000.0))
+    p99_gate_ms = float(os.environ.get("BENCH_SERVE_P99_MS", 250.0))
     X, y = make_higgs_like(rows, N_FEATURES)
     bst = lgb.train({"objective": "binary", "num_leaves": 63,
                      "learning_rate": 0.1, "max_bin": 63, "verbosity": -1},
@@ -939,11 +994,81 @@ def run_serve_bench():
     model_path = os.path.join(td, "model.txt")
     bst.save_model(model_path)
     app = ServingApp(model_path, port=0, max_batch=256, max_delay_ms=2.0,
-                     queue_size=1024).start()
+                     queue_size=4096, binary_port=0).start()
     ref = lgb.Booster(model_file=model_path)
     sizes = [1, 4, 16, 64]
     body_cache = {m: json.dumps({"rows": X[:m].tolist(),
                                  "raw_score": True}) for m in sizes}
+
+    # ---- binary exactness: every bucket of the main model, then the
+    # categorical(+NaN) and multiclass side models
+    exact = True
+    ladder = app.registry.current().describe()["buckets"]
+    with BinaryClient(app.host, app.binary_port) as c:
+        for m in ladder:
+            for raw in (True, False):
+                resp = c.request(X[:m], raw_score=raw)
+                exact &= (resp["status"] == 0 and np.array_equal(
+                    np.asarray(resp["predictions"]),
+                    ref.predict(X[:m], raw_score=raw)))
+    exact &= _serve_exactness_side_models(td)
+
+    # ---- binary timed window: pipelined single-row frames over
+    # persistent connections (requests == frames; the window RTT upper-
+    # bounds every member request's latency, so its p99 gates the SLO)
+    bin_compiles0 = recompile_counts().get("serve_predict", 0)
+    stop = threading.Event()
+    lock = threading.Lock()
+    bin_done, bin_errors = [0], [0]
+    win_ms = []
+
+    def bin_client(seed):
+        rs = np.random.RandomState(seed)
+        bodies = [np.ascontiguousarray(X[i:i + 1], np.float32)
+                  for i in rs.randint(0, min(len(X), 4096), 256)]
+        local_done = local_err = 0
+        local_win = []
+        try:
+            c = BinaryClient(app.host, app.binary_port, timeout=30)
+        except OSError:
+            with lock:
+                bin_errors[0] += 1
+            return
+        try:
+            while not stop.is_set():
+                batch = [bodies[rs.randint(256)] for _ in range(window)]
+                t0 = time.perf_counter()
+                try:
+                    resps = c.pipeline(batch, raw_score=True)
+                except Exception:  # noqa: BLE001 — transport = gate food
+                    local_err += 1
+                    break
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                bad = sum(1 for r in resps if r["status"] != 0)
+                local_err += bad
+                local_done += len(resps) - bad
+                local_win.append(dt_ms)
+        finally:
+            c.close()
+            with lock:
+                bin_done[0] += local_done
+                bin_errors[0] += local_err
+                win_ms.extend(local_win)
+
+    threads = [threading.Thread(target=bin_client, args=(1000 + i,))
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(secs)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    bin_elapsed = time.time() - t0
+    bin_compiles1 = recompile_counts().get("serve_predict", 0)
+    binary_qps = bin_done[0] / max(bin_elapsed, 1e-9)
+    bin_p99 = float(np.percentile(win_ms, 99)) if win_ms else float("inf")
+    bin_p50 = float(np.percentile(win_ms, 50)) if win_ms else float("inf")
 
     def post(conn, body):
         conn.request("POST", "/predict", body,
@@ -954,7 +1079,6 @@ def run_serve_bench():
     # ---- warmup: cover every bucket through the full HTTP path, then
     # pin the watchdog counters
     warm = http.client.HTTPConnection(app.host, app.port, timeout=30)
-    exact = True
     for m in sizes:
         st, obj = post(warm, body_cache[m])
         exact &= (st == 200 and np.array_equal(
@@ -965,7 +1089,6 @@ def run_serve_bench():
 
     stop = threading.Event()
     lat_ms, errors = [], [0]
-    lock = threading.Lock()
 
     def client(seed):
         rs = np.random.RandomState(seed)
@@ -996,7 +1119,8 @@ def run_serve_bench():
     t0 = time.time()
     for t in threads:
         t.start()
-    time.sleep(secs)
+    time.sleep(min(secs, float(os.environ.get("BENCH_SERVE_HTTP_SECS",
+                                              secs))))
     stop.set()
     for t in threads:
         t.join(30)
@@ -1007,13 +1131,32 @@ def run_serve_bench():
     qps = len(lat_ms) / max(elapsed, 1e-9)
     p50 = float(np.percentile(lat_ms, 50)) if lat_ms else float("inf")
     p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float("inf")
-    no_recompiles = compiles1 == compiles0
-    ok = no_recompiles and exact and errors[0] == 0 and len(lat_ms) > 0
+    no_recompiles = (compiles1 == compiles0
+                     and bin_compiles1 == bin_compiles0)
+    bin_ok = (bin_errors[0] == 0 and bin_done[0] > 0
+              and binary_qps >= qps_min and bin_p99 <= p99_gate_ms)
+    ok = (no_recompiles and exact and errors[0] == 0 and len(lat_ms) > 0
+          and bin_ok)
+    bin_record = {
+        "metric": "serve_binary_qps",
+        "value": round(binary_qps, 1),
+        "unit": (f"req/s over {bin_elapsed:.1f}s binary wire, {clients} "
+                 f"clients x {window}-frame pipeline, single-row frames, "
+                 f"{iters} trees ({'OK' if ok else 'FAIL'}: "
+                 f"qps_gate>={qps_min:.0f}, window p99 "
+                 f"{bin_p99:.1f}ms<=gate {p99_gate_ms:.0f}, "
+                 f"errors={bin_errors[0]}, "
+                 f"recompiles_after_warmup="
+                 f"{bin_compiles1 - bin_compiles0}, exact={exact})"),
+        "vs_baseline": None,
+        "p50_window_ms": round(bin_p50, 3),
+        "p99_window_ms": round(bin_p99, 3),
+    }
     qps_record = {
         "metric": "serve_loopback_qps",
         "value": round(qps, 1),
-        "unit": (f"req/s over {elapsed:.1f}s, {clients} clients, mixed "
-                 f"sizes {sizes}, {iters} trees "
+        "unit": (f"req/s over {elapsed:.1f}s HTTP/JSON keep-alive, "
+                 f"{clients} clients, mixed sizes {sizes}, {iters} trees "
                  f"({'OK' if ok else 'FAIL'}: recompiles_after_warmup="
                  f"{compiles1 - compiles0}, errors={errors[0]}, "
                  f"exact={exact})"),
@@ -1022,11 +1165,13 @@ def run_serve_bench():
     lat_record = {
         "metric": "serve_latency_ms",
         "value": round(p50, 3),
-        "unit": f"p50 ms client-side (p99 {p99:.3f} ms)",
+        "unit": f"p50 ms client-side HTTP (p99 {p99:.3f} ms)",
         "vs_baseline": None,
     }
+    print(json.dumps(bin_record), flush=True)
     print(json.dumps(qps_record), flush=True)
     print(json.dumps(lat_record), flush=True)
+    _append_history(bin_record, ok=ok)
     _append_history(qps_record, ok=ok)
     _append_history(lat_record, ok=ok)
     return ok
@@ -1115,16 +1260,65 @@ def run_fleet_bench():
         paths[0], replicas=replicas, max_batch=max(sizes),
         buckets_spec=str(max(sizes)), max_delay_ms=1.0, queue_size=512,
         deadline_ms=deadline_ms, retries=3, retry_backoff_ms=10.0,
-        breaker_failures=3, breaker_cooldown_s=0.5,
-        restart_backoff_s=0.2, hang_timeout_s=2.0,
+        # breaker_failures 4 + 0.3 s cooldown: the hung replica feeds the
+        # latency SLO enough >p99-target requests (initial trips + half-
+        # open probes over the 3 s hang window) that the burn-rate FIRES
+        # reliably — at 3/0.5/2.0 the gate was a coin flip (the breaker
+        # cut the slow-request supply before both burn windows filled)
+        breaker_failures=4, breaker_cooldown_s=0.3,
+        restart_backoff_s=0.2, hang_timeout_s=3.0,
         fleet_dir=os.path.join(td, "fleet"),
-        slo_p99_ms=slo_p99_ms, slo_window_s=1.0, slo_burn=slo_burn)
+        slo_p99_ms=slo_p99_ms, slo_window_s=1.0, slo_burn=slo_burn,
+        binary_port=0)
     bodies = {m: {"rows": X[:m].tolist(), "raw_score": True,
                   "deadline_ms": deadline_ms} for m in sizes}
     lat_ms: list = []
     outcomes = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
+    # the same chaos gate rides the BINARY wire in parallel: replica-
+    # aware clients (wire.FleetBinaryClient) discover per-replica wire
+    # ports and route around kills/hangs with deadline-split retries —
+    # zero non-shed errors and zero mis-versioned responses apply to
+    # both paths (docs/SERVING.md "Binary wire protocol")
+    bin_clients = int(os.environ.get("BENCH_FLEET_BIN_CLIENTS", 2))
+    bin_outcomes = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
     lock = threading.Lock()
     stop = threading.Event()
+
+    def bin_client(seed):
+        from lightgbm_tpu.serving import FleetBinaryClient
+        from lightgbm_tpu.serving import wire as _wire
+
+        rs = np.random.RandomState(seed)
+        fbc = FleetBinaryClient(fleet.binary_endpoints, attempts=3,
+                                cooldown_s=0.5)
+        local = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
+        try:
+            while not stop.is_set():
+                m = sizes[rs.randint(len(sizes))]
+                try:
+                    resp = fbc.request(X[:m], raw_score=True,
+                                       deadline_ms=deadline_ms)
+                except Exception:  # noqa: BLE001 — gate food
+                    local["errors"] += 1
+                    continue
+                st = resp["status"]
+                if st == _wire.ST_OK:
+                    by_sha = oracle.get(resp.get("model_sha256"))
+                    if by_sha is None or not np.array_equal(
+                            np.asarray(resp["predictions"]), by_sha[m]):
+                        local["mis_versioned"] += 1
+                    else:
+                        local["ok"] += 1
+                elif st in (_wire.ST_OVERLOAD, _wire.ST_DEADLINE,
+                            _wire.ST_DRAINING):
+                    local["s503"] += 1     # structured shed, not an error
+                else:
+                    local["errors"] += 1
+        finally:
+            fbc.close()
+            with lock:
+                for k, v in local.items():
+                    bin_outcomes[k] += v
 
     def client(seed):
         rs = np.random.RandomState(seed)
@@ -1189,6 +1383,8 @@ def run_fleet_bench():
             assert st == 200
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(clients)]
+        threads += [threading.Thread(target=bin_client, args=(100 + i,))
+                    for i in range(bin_clients)]
         t0 = time.time()
         for t in threads:
             t.start()
@@ -1300,10 +1496,13 @@ def run_fleet_bench():
     obs_ok = (slo_ok and all(prom_report.get(k) for k in
                              ("front_ok", "fleet_ok", "replica_ok"))
               and trace_report.get("multiprocess_trace", False))
+    bin_ok = (bin_outcomes["errors"] == 0
+              and bin_outcomes["mis_versioned"] == 0
+              and bin_outcomes["ok"] > 0)
     ok = (outcomes["errors"] == 0 and outcomes["mis_versioned"] == 0
           and outcomes["ok"] > 0 and chaos_fired and restarts >= 1
           and reload_ok and converged and p99 <= p99_gate_ms
-          and obs_ok)
+          and obs_ok and bin_ok)
     record = {
         "metric": "fleet_chaos_qps",
         "value": round(qps, 1),
@@ -1315,8 +1514,11 @@ def run_fleet_bench():
                  f"p99={p99:.0f}ms<=gate {p99_gate_ms:.0f}, "
                  f"restarts={restarts}, chaos_fired={chaos_fired}, "
                  f"reload_converged={converged}, slo_fired+cleared="
-                 f"{slo_ok}, metrics+trace={obs_ok})"),
+                 f"{slo_ok}, metrics+trace={obs_ok}, "
+                 f"binary={'OK' if bin_ok else 'FAIL'}:"
+                 f"{bin_outcomes})"),
         "vs_baseline": None,
+        "binary_wire": bin_outcomes,
         "qps": round(qps, 1),
         "p50_ms": round(p50, 2),
         "p99_ms": round(p99, 2),
@@ -1350,11 +1552,15 @@ def run_fleet_bench():
                  f"{restarts} restarts)"),
         "vs_baseline": None,
     }), flush=True)
-    from lightgbm_tpu.robustness.checkpoint import atomic_open
-    with atomic_open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                  "BENCH_FLEET.json"), "w") as fh:
-        json.dump(record, fh, indent=2)
-        fh.write("\n")
+    if ok:
+        # a failing chaos run must not clobber the last PASSING artifact
+        # (the BENCH_GOSS.json lesson from the round-12 review)
+        from lightgbm_tpu.robustness.checkpoint import atomic_open
+        with atomic_open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_FLEET.json"), "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
     return ok
 
 

@@ -1386,12 +1386,15 @@ class Booster:
 
     def _try_device_predict(self, X, use, k, es=None):
         """Batched on-device prediction (pallas/predict_kernel.py): bin the
-        raw matrix with the training mappers and walk all trees on-chip.
-        Returns None when the fast path does not apply (small batch, no
-        engine, categorical splits, CPU backend) — reference analog:
-        predictor.hpp picks per-row vs batch paths.  es=(freq, margin)
-        composes prediction early stopping with the device walk (k == 1
-        only; multiclass margins couple classes, so they stay host-side)."""
+        raw matrix with the training mappers and walk all trees on-chip —
+        numeric, zero-as-missing, and categorical splits included (cat
+        left-sets ride a per-tree bin-domain bitset side table).  Returns
+        None when the fast path does not apply (small batch, no engine,
+        linear trees, bundled categorical features, CPU backend) —
+        reference analog: predictor.hpp picks per-row vs batch paths.
+        es=(freq, margin) composes prediction early stopping with the
+        device walk (k == 1 only; multiclass margins couple classes, so
+        they stay host-side)."""
         import jax
         if (self._engine is None or not use
                 or X.shape[0] < self._DEVICE_PREDICT_MIN_ROWS):
@@ -1410,38 +1413,71 @@ class Booster:
         per_class = -(-len(use) // max(k, 1))
         if per_class * ROWS_PER_TREE * L * 4 > 10 * 2 ** 20:
             return None
+        cat_feats = set()
         for t in use:
             if t.is_linear:
-                return None    # linear leaves: host path
+                return None    # linear leaves: the only host fallback
             ni = max(t.num_leaves - 1, 0)
-            if ni and (np.asarray(t.decision_type[:ni]) & 1).any():
-                return None    # categorical splits: host path
-            if ni and ((np.asarray(t.decision_type[:ni]) >> 2) & 3 == 1).any():
-                return None    # zero-as-missing default routing: host path
+            if ni:
+                dt = np.asarray(t.decision_type[:ni]).astype(np.int64)
+                for f in np.asarray(t.split_feature[:ni])[(dt & 1) > 0]:
+                    cat_feats.add(int(f))
         from .binning import construct_binned
+        from .pallas.predict_kernel import CAT_DIGITS as \
+            predict_kernel_CAT_DIGITS
         from .pallas.predict_kernel import (build_predict_tables,
                                             predict_stream, tree_max_depth)
         from .pallas.stream_kernel import pack_bins_T
         import jax.numpy as jnp
         eng = self.engine
         tb = eng.train_data.binned
-        binned = construct_binned(np.asarray(X, np.float64), tb.bin_mappers,
-                                  tb.group_features)
-        slay = pack_bins_T(jnp.asarray(binned.bins))
         r = eng.dd.routing
         routing_np = {name: np.asarray(getattr(r, name))
                       for name in ("feat_group", "span_start", "default_bin",
-                                   "bundled", "nan_bin", "num_bins")}
+                                   "bundled", "nan_bin", "num_bins",
+                                   "mzero_bin")}
+        for f in sorted(cat_feats):
+            # the NaN/unseen sentinel re-bin below needs the cat feature
+            # alone in its group, and the sentinel bin num_bins must fit
+            # the uint8 storage — bundled or near-full ladders stay host
+            if routing_np["bundled"][f] or tb.bin_mappers[f].num_bins >= 255:
+                return None
+        binned = construct_binned(np.asarray(X, np.float64), tb.bin_mappers,
+                                  tb.group_features)
+        bins = np.asarray(binned.bins)
+        if cat_feats:
+            # the host walk routes NaN / unseen / negative categories
+            # RIGHT (bit absent from the bitset); the mapper bins them to
+            # bin 0 (the most frequent category) — re-bin those rows to
+            # the sentinel bin one past the span, whose bitset bit is
+            # always zero by construction (build_predict_tables)
+            Xf = np.asarray(X, np.float64)
+            for f in sorted(cat_feats):
+                m = tb.bin_mappers[f]
+                v = Xf[:, f]
+                ivc = np.where(np.isnan(v), -1.0, v)
+                ivc = np.clip(ivc, -1.0, float(2 ** 62)).astype(np.int64)
+                ok = (ivc >= 0) & np.isin(ivc,
+                                          m.categories.astype(np.int64))
+                bins[~ok, int(routing_np["feat_group"][f])] = m.num_bins
+        slay = pack_bins_T(jnp.asarray(bins))
         maxd = max(max(tree_max_depth(t) for t in use), 1)
         n = X.shape[0]
         es_freq, es_margin = (int(es[0]), float(es[1])) if es else (0, 0.0)
         outs = []
         for c in range(k):
             trees_c = [t for i, t in enumerate(use) if i % k == c]
-            tabs = build_predict_tables(trees_c, routing_np, L,
-                                        bin_mappers=tb.bin_mappers)
-            s = predict_stream(slay.bins_T, jnp.asarray(tabs), L,
-                               len(trees_c), maxd, es_freq=es_freq,
+            tabs, cat_tab = build_predict_tables(trees_c, routing_np, L,
+                                                 bin_mappers=tb.bin_mappers)
+            if cat_tab.shape[1] > 2048:
+                return None    # bitset side table would blow VMEM
+            if not cat_feats:
+                # numeric-only: a minimal dummy keeps the unread cat
+                # input out of VMEM (the kernel never touches it)
+                cat_tab = cat_tab[:predict_kernel_CAT_DIGITS]
+            s = predict_stream(slay.bins_T, jnp.asarray(tabs),
+                               jnp.asarray(cat_tab), L, len(trees_c), maxd,
+                               has_cat=bool(cat_feats), es_freq=es_freq,
                                es_margin=es_margin)
             outs.append(s)
         host = jax.device_get(outs)

@@ -198,6 +198,8 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "serve_buckets": ["serve_bucket_ladder"],
     "serve_warmup": [],
     "serve_heartbeat": ["serve_heartbeat_file"],
+    "serve_binary_port": ["binary_port", "serve_wire_port"],
+    "serve_binary_accept_threads": ["binary_accept_threads"],
     "serve_replicas": ["num_replicas", "serve_num_replicas"],
     "serve_fleet_mode": ["fleet_mode"],
     "serve_fleet_dir": ["fleet_dir"],
@@ -570,6 +572,15 @@ class Config:
     # heartbeat file the batch worker touches after every dispatch
     # (robustness liveness probe; "" = off)
     serve_heartbeat: str = ""
+    # persistent-connection binary row wire next to HTTP (length-prefixed
+    # f32 frames, docs/SERVING.md "Binary wire protocol"): -1 = off,
+    # 0 = ephemeral port, > 0 = fixed port; in a fleet every replica
+    # opens its own wire and publishes the port in replica_<r>.json
+    serve_binary_port: int = -1
+    # acceptor threads sharing the binary wire's listen socket (the
+    # multi-accept front: connection setup never serializes behind one
+    # thread)
+    serve_binary_accept_threads: int = 2
     # replica fleet size for task=serve; > 1 runs the fleet supervisor
     # (N replica processes + restart-with-backoff + fleet-wide promotion,
     # docs/SERVING.md "Fleet architecture") instead of one process
@@ -719,6 +730,14 @@ class Config:
             raise LightGBMError(
                 f"hist_comms_pipeline={self.hist_comms_pipeline} must be "
                 ">= 0 (0 = auto)")
+        if self.serve_binary_port < -1 or self.serve_binary_port > 65535:
+            raise LightGBMError(
+                f"serve_binary_port={self.serve_binary_port} must be -1 "
+                "(off), 0 (ephemeral), or a TCP port <= 65535")
+        if self.serve_binary_accept_threads < 1:
+            raise LightGBMError(
+                f"serve_binary_accept_threads="
+                f"{self.serve_binary_accept_threads} must be >= 1")
         if not 0.0 <= self.serve_trace_sample <= 1.0:
             raise LightGBMError(
                 f"serve_trace_sample={self.serve_trace_sample} must be a "

@@ -64,6 +64,7 @@ _VOLATILE_PARAMS = frozenset({
     "serve_host", "serve_port", "serve_max_batch", "serve_max_delay_ms",
     "serve_queue_size", "serve_buckets", "serve_warmup", "serve_heartbeat",
     "serve_replicas", "serve_fleet_mode", "serve_fleet_dir",
+    "serve_binary_port", "serve_binary_accept_threads",
     "serve_deadline_ms", "serve_retries", "serve_retry_backoff_ms",
     "serve_breaker_failures", "serve_breaker_cooldown_s",
     "serve_restart_backoff_s", "serve_hang_timeout_s",

@@ -30,6 +30,7 @@ from .registry import ModelRegistry, ServingModel
 from .server import (ServingApp, reuseport_available, run_server,
                      serve_from_params)
 from .slo import SLOMonitor
+from .wire import BinaryClient, BinaryServer, FleetBinaryClient, WireError
 
 __all__ = [
     "CompiledPredictor", "bucket_ladder",
@@ -38,4 +39,5 @@ __all__ = [
     "ServingApp", "run_server", "serve_from_params",
     "ServingFleet", "run_fleet", "FanoutFront", "CircuitBreaker",
     "SLOMonitor", "reuseport_available",
+    "BinaryServer", "BinaryClient", "FleetBinaryClient", "WireError",
 ]

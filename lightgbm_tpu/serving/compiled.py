@@ -15,11 +15,20 @@ model thresholds are float64.  The walk therefore never compares floats
 on device: each float64 value ``v`` is mapped on the host to a MONOTONE
 64-bit integer key (sign-flip trick: ``bits ^ (bits < 0 ? ~0 : 1<<63)``,
 with -0.0 normalized to +0.0) carried as two uint32 lanes, and ``v <=
-threshold`` becomes an exact lexicographic integer compare.  The device
-returns leaf INDICES only; leaf values are gathered and accumulated on
-the host in float64 in the same tree order as the host batch path, so
-serving scores are bitwise equal to ``Booster.predict`` — asserted by
-tests/test_serving.py.
+threshold`` becomes an exact lexicographic integer compare.
+
+Score accumulation ALSO runs on device: the per-tree leaf-value table
+rides into the program as a float64 argument (under a scoped
+``jax.experimental.enable_x64``), and one sequential ``fori_loop``
+replays the host batch loop's exact tree order — per row, the same
+IEEE-754 float64 adds in the same order — so the returned scores are
+bitwise equal to ``Booster.predict`` without the host ever touching a
+per-tree Python loop (the pre-PR-13 hot path burned ~40% of serving CPU
+there).  Backends without real float64 (probed once at import of the
+first predictor; ``LGBTPU_SERVE_ACCUM=host`` forces it) keep the old
+host-side float64 accumulation over device leaf indices — same bits,
+more host work.  The only models the device path refuses entirely are
+linear trees (raw-feature float64 dot products per leaf).
 
 Missing handling mirrors tree.py ``predict_raw`` exactly: NaN rows carry
 a host-computed mask; the ``zero_as_missing`` band ``|v| < 1e-35`` is an
@@ -28,11 +37,13 @@ the model's category bitset words.
 """
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional, Sequence
+import os
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils.log import LightGBMError
+from ..utils.log import LightGBMError, log_info
 
 # monotone keys of +/-1e-35 — the reference's kZeroThreshold band used by
 # zero-as-missing routing (tree.py predict_raw: np.abs(v) < 1e-35)
@@ -67,6 +78,60 @@ class PackedServingTrees(NamedTuple):
     right_child: object     # (T, M) i32
     cat_ord: object         # (T, M) i32 — row into cat_words, -1 numeric
     cat_words: object       # (C, W) u32 — per-cat-node bitset words
+
+
+def _x64_scope():
+    """Scoped float64 (the repo-wide pattern: models/gbdt.py _x64_scope) —
+    the global x64 flag stays off; serving traces/dispatches its scored
+    programs inside the scope so the f64 leaf table and accumulator are
+    real IEEE doubles on capable backends."""
+    import jax
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:   # moved under jax.experimental in recent releases
+        from jax.experimental import enable_x64 as ctx
+    return ctx()
+
+
+_DEVICE_F64: Optional[bool] = None
+
+
+def device_accumulation_supported() -> bool:
+    """Can this backend hold float64 arrays and add them with IEEE-754
+    semantics?  Probed ONCE: a pair whose low word vanishes under any
+    f32 emulation (1.0 + 1e-16 == 1.0 in f32) must survive bitwise.
+    ``LGBTPU_SERVE_ACCUM=host`` forces the host-accumulation fallback;
+    ``=device`` raises if the probe fails (no silent downgrade)."""
+    global _DEVICE_F64
+    mode = os.environ.get("LGBTPU_SERVE_ACCUM", "auto").strip().lower()
+    if mode not in ("auto", "device", "host"):
+        raise LightGBMError(
+            f"LGBTPU_SERVE_ACCUM={mode!r} must be auto, device, or host")
+    if mode == "host":
+        return False
+    if _DEVICE_F64 is None:
+        try:
+            import jax.numpy as jnp
+            want = np.float64(1.0) + np.float64(1e-16)
+            with _x64_scope():
+                a = jnp.asarray(np.asarray([1.0, 1e-16], np.float64))
+                ok = a.dtype == jnp.float64
+                if ok:
+                    # eager device add (no bare jit): any f32 emulation
+                    # loses the 1e-16 and fails the bit compare
+                    got = np.asarray(a[0] + a[1])
+                    ok = (got.dtype == np.float64
+                          and got.view(np.uint64) ==
+                          np.float64(want).view(np.uint64))
+            _DEVICE_F64 = bool(ok)
+        except Exception as e:  # noqa: BLE001 — probe must never kill serving
+            log_info(f"serving: device float64 probe failed ({e}); "
+                     "leaf accumulation stays on the host")
+            _DEVICE_F64 = False
+    if mode == "device" and not _DEVICE_F64:
+        raise LightGBMError(
+            "LGBTPU_SERVE_ACCUM=device but this backend has no IEEE "
+            "float64 — unset it to fall back to host accumulation")
+    return _DEVICE_F64
 
 
 def _walk_impl(pack: PackedServingTrees, keys_hi, keys_lo, nan_mask, iv,
@@ -129,19 +194,67 @@ def _walk_impl(pack: PackedServingTrees, keys_hi, keys_lo, nan_mask, iv,
     return jax.lax.map(one_tree, tuple(pack[:7]))
 
 
-_serve_walk = None   # lazily-built watched_jit (import must stay jax-free)
+def _score_impl(pack: PackedServingTrees, leaf_values, keys_hi, keys_lo,
+                nan_mask, iv, max_depth: int, num_class: int):
+    """Walk + on-device float64 accumulation in the host loop's exact
+    tree order (traced under enable_x64; bitwise == Booster.predict).
+
+    ``leaf_values`` is (T, L) float64.  num_class == 1: one fori_loop
+    ``score += lv[t][leaf[t]]`` — per element the identical IEEE add
+    sequence as the host ``for t: score += lv[leaves[t]]`` loop.
+    num_class > 1: trees iterate round-major (tree i feeds column i % k),
+    so looping rounds r and adding the (k, n) gather keeps every COLUMN's
+    adds in ascending tree order — again the host loop's order."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = _walk_impl(pack, keys_hi, keys_lo, nan_mask, iv, max_depth)
+    n = keys_hi.shape[0]
+    T = leaf_values.shape[0]
+    if num_class == 1:
+        def body(t, s):
+            return s + leaf_values[t][leaves[t]]
+        return jax.lax.fori_loop(0, T, body, jnp.zeros(n, jnp.float64))
+    k = num_class
+    lv3 = leaf_values.reshape(T // k, k, leaf_values.shape[1])
+    lf3 = leaves.reshape(T // k, k, n)
+
+    def body(r, s):
+        return s + jnp.take_along_axis(lv3[r], lf3[r], axis=1).T
+
+    return jax.lax.fori_loop(0, T // k, body,
+                             jnp.zeros((n, k), jnp.float64))
+
+
+_serve_walk = None    # lazily-built watched_jits (import must stay jax-free)
+_serve_score = None
 
 
 def _get_walk():
     global _serve_walk
     if _serve_walk is None:
         from ..telemetry import watched_jit
-        # buckets legitimately re-specialize per ladder shape: count traces
-        # for the zero-recompiles-after-warmup gate without warning
-        _serve_walk = watched_jit(_walk_impl, name="serve_predict",
+        # leaf-index-only program: the host-accumulation fallback and the
+        # leaves() introspection surface (buckets legitimately
+        # re-specialize per ladder shape: count, never warn)
+        _serve_walk = watched_jit(_walk_impl, name="serve_leaves",
                                   warn_after=0,
                                   static_argnames=("max_depth",))
     return _serve_walk
+
+
+def _get_score():
+    global _serve_score
+    if _serve_score is None:
+        from ..telemetry import watched_jit
+        # the serving hot path: walk + f64 accumulation in ONE program.
+        # Keeps the historical entry name — every zero-recompiles gate
+        # (tests, BENCH_SERVE, /stats) keys off "serve_predict"
+        _serve_score = watched_jit(_score_impl, name="serve_predict",
+                                   warn_after=0,
+                                   static_argnames=("max_depth",
+                                                    "num_class"))
+    return _serve_score
 
 
 def bucket_ladder(max_batch: int, spec: str = "",
@@ -171,7 +284,9 @@ def bucket_ladder(max_batch: int, spec: str = "",
 
 class CompiledPredictor:
     """Pre-packed model + bucket ladder; every call pads to a bucket and
-    dispatches one already-traced program, then finishes on the host."""
+    dispatches one already-traced program that returns FINISHED float64
+    raw scores (device accumulation), or leaf indices on f64-less
+    backends (host accumulation fallback)."""
 
     def __init__(self, trees: Sequence, num_class: int, num_features: int,
                  max_batch: int = 256, buckets: Optional[Sequence[int]] = None):
@@ -229,6 +344,26 @@ class CompiledPredictor:
             thr_lo=jnp.asarray(tlo), decision_type=jnp.asarray(dt),
             left_child=jnp.asarray(lc), right_child=jnp.asarray(rc),
             cat_ord=jnp.asarray(co), cat_words=jnp.asarray(cw))
+        # (T, L) float64 leaf-value table for the on-device accumulation;
+        # created under the x64 scope so the device array is real f64
+        self.device_accum = (device_accumulation_supported()
+                             and (self.num_class == 1
+                                  or nt % self.num_class == 0))
+        self._lv_dev = None
+        if self.device_accum:
+            lvt = np.zeros((max(nt, 1), M + 1), np.float64)
+            for ti, t in enumerate(trees):
+                nlv = min(t.num_leaves, M + 1)
+                lvt[ti, :nlv] = np.asarray(t.leaf_value[:nlv], np.float64)
+            with _x64_scope():
+                self._lv_dev = jnp.asarray(lvt)
+        # pinned per-bucket pad buffers: one (bucket, F) set per bucket,
+        # filled in place per chunk — the hot path never np.pad-allocates.
+        # One dispatch at a time per predictor (the micro-batcher's single
+        # worker is the expected caller; direct concurrent callers
+        # serialize on this lock rather than corrupt each other's pads)
+        self._buf_lock = threading.Lock()
+        self._pads: Dict[int, Tuple[np.ndarray, ...]] = {}
 
     # -- host-side row encoding -------------------------------------------
     def _encode(self, X: np.ndarray):
@@ -248,34 +383,82 @@ class CompiledPredictor:
                 return b
         return self.buckets[-1]
 
+    def _pad_buffers(self, bucket: int) -> Tuple[np.ndarray, ...]:
+        """The pinned (bucket, F) khi/klo/nan/iv pad set (caller holds
+        ``_buf_lock``).  Pad rows keep whatever the previous chunk left —
+        their walk output is sliced away, so stale contents are unread."""
+        bufs = self._pads.get(bucket)
+        if bufs is None:
+            F = self.num_features
+            bufs = (np.zeros((bucket, F), np.uint32),
+                    np.zeros((bucket, F), np.uint32),
+                    np.zeros((bucket, F), bool),
+                    np.zeros((bucket, F), np.int32))
+            self._pads[bucket] = bufs
+        return bufs
+
+    def _fill(self, bucket: int, khi, klo, nan, iv, s: int, m: int):
+        bufs = self._pad_buffers(bucket)
+        for buf, src in zip(bufs, (khi, klo, nan, iv)):
+            buf[:m] = src[s:s + m]
+        return bufs
+
     def leaves(self, X: np.ndarray) -> np.ndarray:
         """(T, n) leaf indices; internally chunks to the largest bucket
-        and pads each chunk, so any n works without a fresh trace."""
+        and pads each chunk, so any n works without a fresh trace.
+        Introspection / host-accumulation surface — the serving hot path
+        is :meth:`raw_scores`."""
         import jax.numpy as jnp
         n = X.shape[0]
         khi, klo, nan, iv = self._encode(X)
         cap = self.buckets[-1]
         walk = _get_walk()
         outs = []
-        for s in range(0, n, cap) if n else []:
-            m = min(cap, n - s)
-            b = self.bucket_for(m)
-            pad = ((0, b - m), (0, 0))
-            out = walk(self._pack,
-                       jnp.asarray(np.pad(khi[s:s + m], pad)),
-                       jnp.asarray(np.pad(klo[s:s + m], pad)),
-                       jnp.asarray(np.pad(nan[s:s + m], pad)),
-                       jnp.asarray(np.pad(iv[s:s + m], pad)),
-                       max_depth=self.max_depth)
-            outs.append(np.asarray(out)[:, :m])
+        with self._buf_lock:
+            for s in range(0, n, cap) if n else []:
+                m = min(cap, n - s)
+                b = self.bucket_for(m)
+                bufs = self._fill(b, khi, klo, nan, iv, s, m)
+                out = walk(self._pack, jnp.asarray(bufs[0]),
+                           jnp.asarray(bufs[1]), jnp.asarray(bufs[2]),
+                           jnp.asarray(bufs[3]), max_depth=self.max_depth)
+                outs.append(np.asarray(out)[:, :m])
         if not outs:
             return np.zeros((len(self._leaf_values), 0), np.int32)
         return np.concatenate(outs, axis=1)
 
     def raw_scores(self, X: np.ndarray) -> np.ndarray:
-        """Pre-average raw scores, (n,) or (n, K) float64 — accumulated on
-        the host tree-by-tree in the exact order of the Booster.predict
-        host loop, so results are bitwise identical to it."""
+        """Pre-average raw scores, (n,) or (n, K) float64 — bitwise
+        identical to the ``Booster.predict`` host loop.  Device path:
+        walk + float64 leaf accumulation inside one compiled program per
+        bucket.  Fallback (f64-less backend / LGBTPU_SERVE_ACCUM=host):
+        device walk to leaf indices, host float64 loop in tree order."""
+        n = X.shape[0]
+        k = self.num_class
+        if self._lv_dev is None:
+            return self._raw_scores_host(X)
+        import jax.numpy as jnp
+        khi, klo, nan, iv = self._encode(X)
+        cap = self.buckets[-1]
+        score = _get_score()
+        outs = []
+        with self._buf_lock, _x64_scope():
+            for s in range(0, n, cap) if n else []:
+                m = min(cap, n - s)
+                b = self.bucket_for(m)
+                bufs = self._fill(b, khi, klo, nan, iv, s, m)
+                out = score(self._pack, self._lv_dev, jnp.asarray(bufs[0]),
+                            jnp.asarray(bufs[1]), jnp.asarray(bufs[2]),
+                            jnp.asarray(bufs[3]), max_depth=self.max_depth,
+                            num_class=k)
+                outs.append(np.asarray(out)[:m])
+        if not outs:
+            return np.zeros((0,) if k == 1 else (0, k), np.float64)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def _raw_scores_host(self, X: np.ndarray) -> np.ndarray:
+        """Host float64 accumulation over device leaf indices, in the
+        exact order of the Booster.predict host loop."""
         n = X.shape[0]
         k = self.num_class
         leaves = self.leaves(X)
@@ -294,5 +477,5 @@ class CompiledPredictor:
         version swap, so live traffic never pays a compile). Returns the
         number of buckets primed."""
         for b in self.buckets:
-            self.leaves(np.zeros((b, self.num_features), np.float64))
+            self.raw_scores(np.zeros((b, self.num_features), np.float64))
         return len(self.buckets)

@@ -227,7 +227,12 @@ def _replica_main(spec_path: str, rank: int) -> int:
         slo_availability=float(spec.get("slo_availability", 0.999)),
         slo_p99_ms=float(spec.get("slo_p99_ms", 0.0)),
         slo_window_s=float(spec.get("slo_window_s", 60.0)),
-        slo_burn=float(spec.get("slo_burn", 14.4)))
+        slo_burn=float(spec.get("slo_burn", 14.4)),
+        # binary wire: every replica opens its OWN ephemeral wire port
+        # (published in replica_<r>.json below) — replica-aware clients
+        # (wire.FleetBinaryClient) discover and route around failures
+        binary_port=(0 if int(spec.get("binary_port", -1)) >= 0 else -1),
+        binary_accept_threads=int(spec.get("binary_accept_threads", 2)))
     app.replica_rank = rank
     app.generation = int(pointer["generation"])
     app.seen_generation = app.generation
@@ -276,6 +281,7 @@ def _replica_main(spec_path: str, rank: int) -> int:
     atomic_write_text(
         os.path.join(fleet_dir, f"replica_{rank}.json"),
         json.dumps({"rank": rank, "host": app.host, "port": app.port,
+                    "binary_port": app.binary_port,
                     "pid": os.getpid(), "started_unix": time.time()}))
     threading.Thread(target=_watch_promotions,
                      name=f"lgbtpu-replica{rank}-promote",
@@ -331,6 +337,7 @@ class ServingFleet:
                  access_log: str = "",
                  slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
                  slo_window_s: float = 60.0, slo_burn: float = 14.4,
+                 binary_port: int = -1, binary_accept_threads: int = 2,
                  python: str = sys.executable):
         from .server import reuseport_available
 
@@ -405,6 +412,8 @@ class ServingFleet:
             # later; set serve_fleet_dir to keep shards for the
             # collector (docs/OBSERVABILITY.md)
             "ephemeral_dir": self._own_dir,
+            "binary_port": int(binary_port),
+            "binary_accept_threads": int(binary_accept_threads),
             **self.slo_params,
         }
         self._spec_path = os.path.join(self.dir, "replica_spec.json")
@@ -444,6 +453,17 @@ class ServingFleet:
             ep = self.endpoint(r)
             if ep is not None:
                 out[r] = ep
+        return out
+
+    def binary_endpoints(self) -> Dict[int, Any]:
+        """rank -> (host, binary_port) of live replicas with an open
+        binary wire — the discovery hook wire.FleetBinaryClient routes
+        off (re-read per call: a restarted replica publishes a NEW port)."""
+        out: Dict[int, Any] = {}
+        for r, ep in self.endpoints().items():
+            bp = ep.get("binary_port")
+            if bp:
+                out[r] = (ep["host"], int(bp))
         return out
 
     def _spawn(self, rank: int) -> None:
@@ -747,7 +767,9 @@ def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
         slo_availability=cfg.serve_slo_availability,
         slo_p99_ms=cfg.serve_slo_p99_ms,
         slo_window_s=cfg.serve_slo_window_s,
-        slo_burn=cfg.serve_slo_burn)
+        slo_burn=cfg.serve_slo_burn,
+        binary_port=cfg.serve_binary_port,
+        binary_accept_threads=cfg.serve_binary_accept_threads)
 
 
 def run_fleet(params: Dict[str, Any]) -> int:

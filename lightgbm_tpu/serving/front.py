@@ -509,6 +509,15 @@ class FanoutFront:
                 "slo": self.slo.state(),
                 "trace_tail": self.tail.snapshot(last=20),
                 "trace_sample": self.trace_sample,
+                # binary-wire discovery for REMOTE clients: the per-
+                # replica wire ports live in fleet-dir files a network
+                # client cannot read — wire.FleetBinaryClient can poll
+                # this /stats field instead (docs/SERVING.md "Binary
+                # wire protocol")
+                "binary_endpoints": {
+                    str(r): {"host": hp[0], "port": hp[1]}
+                    for r, hp in sorted(getattr(
+                        self.fleet, "binary_endpoints", dict)().items())},
                 "fleet": self.fleet.describe(states=cached)}
 
     def metrics_text(self, fleet_scope: bool = False) -> str:

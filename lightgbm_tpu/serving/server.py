@@ -1,5 +1,12 @@
 """JSON-over-HTTP serving front end (stdlib ``http.server`` only).
 
+HTTP/1.1 with keep-alive: a client reusing its connection pays the TCP
+handshake once, not per request.  ``serve_binary_port >= 0`` additionally
+opens the persistent-connection binary row wire (:mod:`.wire`) next to
+HTTP — same registry and micro-batcher, length-prefixed f32 frames
+instead of JSON (docs/SERVING.md "Binary wire protocol") — the 10k+ QPS
+path.
+
 Endpoints:
 
   ``POST /predict``  body {"rows": [[...], ...]} or {"row": [...]},
@@ -114,7 +121,8 @@ class ServingApp:
                  reuse_port: bool = False, trace_sample: float = 0.01,
                  trace_tail: int = 256, access_log: str = "",
                  slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
-                 slo_window_s: float = 60.0, slo_burn: float = 14.4):
+                 slo_window_s: float = 60.0, slo_burn: float = 14.4,
+                 binary_port: int = -1, binary_accept_threads: int = 2):
         from ..telemetry import AccessLog, TailRing
         from .slo import SLOMonitor
 
@@ -130,6 +138,16 @@ class ServingApp:
         self._httpd = server_cls((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.app = self          # handler back-pointer
+        # binary row wire next to HTTP (serve_binary_port >= 0; 0 picks
+        # an ephemeral port) — same registry + batcher, frames instead of
+        # JSON (docs/SERVING.md "Binary wire protocol")
+        self.binary = None
+        if int(binary_port) >= 0:
+            from .wire import BinaryServer
+            self.binary = BinaryServer(self, host=host,
+                                       port=int(binary_port),
+                                       accept_threads=binary_accept_threads,
+                                       reuse_port=reuse_port)
         self._thread: Optional[threading.Thread] = None
         self._draining = False
         # default per-request budget (ms) when the body carries no
@@ -172,6 +190,10 @@ class ServingApp:
         return self._httpd.server_address[1]
 
     @property
+    def binary_port(self) -> Optional[int]:
+        return self.binary.port if self.binary is not None else None
+
+    @property
     def draining(self) -> bool:
         return self._draining
 
@@ -190,8 +212,12 @@ class ServingApp:
                                         name="lgbtpu-serve-http",
                                         daemon=True)
         self._thread.start()
+        if self.binary is not None:
+            self.binary.start()
         log_info(f"serving on http://{self.host}:{self.port} "
-                 f"(model v{self.registry.version})")
+                 + (f"+ binary :{self.binary.port} "
+                    if self.binary is not None else "")
+                 + f"(model v{self.registry.version})")
         return self
 
     def shutdown(self, drain: bool = True) -> None:
@@ -199,9 +225,13 @@ class ServingApp:
         the worker.  Idempotent."""
         self._draining = True
         self._slo_stop.set()
+        if self.binary is not None:
+            self.binary.stop_accepting()
         self._httpd.shutdown()
         self._httpd.server_close()
         self.batcher.stop(drain=drain)
+        if self.binary is not None:
+            self.binary.stop()      # after the drain: futures resolved
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(5.0)
         if self._slo_thread is not None and self._slo_thread.is_alive():
@@ -546,6 +576,8 @@ class _Handler(BaseHTTPRequestHandler):
             "slo": app.slo.state(),
             "trace_tail": app.tail.snapshot(last=20),
             "trace_sample": app.trace_sample,
+            "binary": (app.binary.stats() if app.binary is not None
+                       else None),
         }
 
 
@@ -573,7 +605,9 @@ def serve_from_params(params: Dict[str, Any]) -> ServingApp:
         slo_availability=cfg.serve_slo_availability,
         slo_p99_ms=cfg.serve_slo_p99_ms,
         slo_window_s=cfg.serve_slo_window_s,
-        slo_burn=cfg.serve_slo_burn)
+        slo_burn=cfg.serve_slo_burn,
+        binary_port=cfg.serve_binary_port,
+        binary_accept_threads=cfg.serve_binary_accept_threads)
 
 
 def run_server(params: Dict[str, Any]) -> int:

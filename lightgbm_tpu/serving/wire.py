@@ -1,0 +1,711 @@
+"""Persistent-connection binary row protocol (the serving fast wire).
+
+The JSON/HTTP path pays parse + dict + float repr per request — fine at
+hundreds of QPS, dominant at thousands.  This wire replaces it with
+length-prefixed binary frames over long-lived TCP connections, so the
+per-request server cost drops to one buffered ``recv`` + ``memcpy`` into
+the micro-batcher (reference analog: the pre-bound
+``PredictForMatSingleRowFast`` contract, c_api.h:1399-1428 — all setup
+hoisted out of the per-row path).  Requests pipeline: a client may have
+any number of frames in flight and responses match on ``request_id``
+(they can return out of order across batcher dispatches).
+
+Frame layout (all little-endian; docs/SERVING.md "Binary wire protocol"):
+
+  handshake  client->server then server->client, 8 bytes each:
+             ``b"LGBW"`` + u8 version (1) + 3 reserved zero bytes.
+
+  request    u32 length            bytes AFTER this field
+             u32 request_id        echoed verbatim in the response
+             u8  op                1 = predict
+             u8  flags             1 raw_score | 2 fast | 4 trace attached
+             u16 n_cols
+             u32 n_rows
+             f32 deadline_ms       0 = server default (serve_deadline_ms)
+             f32 x n_rows*n_cols   row-major feature values
+             [u8 trace_len + trace bytes]   iff flags & 4 — the same
+             ``<trace_id>[;s=0|1]`` context the X-LGBTPU-Trace header
+             carries (docs/OBSERVABILITY.md)
+
+  response   u32 length
+             u32 request_id
+             u8  status            0 ok | 2 overload | 3 deadline_expired
+                                   | 4 bad_request | 5 server_error
+                                   | 6 draining
+             u8  sha_len           model sha256 hex length (ok), else 0
+             u16 k                 values per row (ok), else 0
+             u32 n_rows            (ok), else 0
+             u32 model_version
+             f32 retry_after_s     backoff hint on sheds, else 0
+             [sha_len sha hex bytes][f64 x n_rows*k predictions]   (ok)
+             [u16 msg_len + utf8 message]                          (error)
+
+Predictions travel as float64, so the wire is exactly as bitwise-auditable
+against ``Booster.predict`` as the JSON path (BENCH_FLEET keys its
+zero-mis-versioned gate off the sha + f64 payload).
+
+Malformed input never wedges a worker (the LGB008 discipline applied to
+the accept loop): a truncated length prefix or mid-frame disconnect is a
+clean close, an oversize length or bad header draws a structured error
+frame and then a close, a wrong row width draws an error frame and the
+connection keeps serving.  Responses are written by a per-connection
+writer thread behind a bounded queue — a client that stops reading gets
+disconnected instead of blocking the batcher worker.
+
+The server runs a MULTI-ACCEPT front: ``accept_threads`` acceptors share
+the listening socket so connection setup never serializes behind one
+thread.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import CancelledError
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..robustness import chaos
+from ..utils.log import LightGBMError, log_debug, log_info
+from .batcher import DeadlineError, OverloadError
+
+MAGIC = b"LGBW"
+VERSION = 1
+HANDSHAKE = MAGIC + bytes([VERSION, 0, 0, 0])
+MAX_FRAME = 8 * 2 ** 20          # request bytes after the length prefix
+# responses can legally outgrow requests (f32 rows in, f64 x num_class
+# predictions out), so the client-side bound is wider: 2x for the dtype
+# plus headroom for num_class > n_cols models and the sha/header tail
+MAX_RESP_FRAME = 8 * MAX_FRAME
+OP_PREDICT = 1
+
+FLAG_RAW = 1
+FLAG_FAST = 2
+FLAG_TRACE = 4
+
+ST_OK = 0
+ST_OVERLOAD = 2
+ST_DEADLINE = 3
+ST_BAD_REQUEST = 4
+ST_ERROR = 5
+ST_DRAINING = 6
+
+_LEN = struct.Struct("<I")
+_REQ_HEAD = struct.Struct("<IBBHIf")     # id, op, flags, ncols, nrows, ddl
+# id, status, sha_len (u8 — hex sha is 64 bytes), k (u16 — num_class up
+# to 65535; a u8 here would break >255-class models), nrows, version, ra
+_RESP_HEAD = struct.Struct("<IBBHIIf")
+
+
+class WireError(LightGBMError):
+    """Malformed frame (protocol violation, not a transport failure)."""
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def encode_request(request_id: int, rows: np.ndarray, *,
+                   raw_score: bool = False, fast: bool = False,
+                   deadline_ms: float = 0.0,
+                   trace: Optional[str] = None) -> bytes:
+    """One request frame (length prefix included)."""
+    rows = np.ascontiguousarray(rows, dtype="<f4")
+    if rows.ndim == 1:
+        rows = rows.reshape(1, -1)
+    n, c = rows.shape
+    flags = (FLAG_RAW if raw_score else 0) | (FLAG_FAST if fast else 0)
+    tail = b""
+    if trace:
+        tb = str(trace).encode("utf-8")[:255]
+        tail = bytes([len(tb)]) + tb
+        flags |= FLAG_TRACE
+    body = (_REQ_HEAD.pack(request_id & 0xFFFFFFFF, OP_PREDICT, flags,
+                           c, n, float(deadline_ms))
+            + rows.tobytes() + tail)
+    return _LEN.pack(len(body)) + body
+
+
+def parse_request(payload: bytes) -> Dict[str, Any]:
+    """Decode a request frame body (everything after the length prefix).
+    Raises :class:`WireError` on any malformation."""
+    if len(payload) < _REQ_HEAD.size:
+        raise WireError(f"request frame too short ({len(payload)} < "
+                        f"{_REQ_HEAD.size} header bytes)")
+    req_id, op, flags, ncols, nrows, deadline_ms = _REQ_HEAD.unpack_from(
+        payload)
+    if op != OP_PREDICT:
+        raise WireError(f"unknown wire op {op}")
+    want = nrows * ncols * 4
+    off = _REQ_HEAD.size
+    if len(payload) < off + want:
+        raise WireError(
+            f"request frame payload short: {nrows}x{ncols} f32 rows need "
+            f"{want} bytes, frame carries {len(payload) - off}")
+    rows = np.frombuffer(payload, dtype="<f4", count=nrows * ncols,
+                         offset=off).reshape(nrows, ncols)
+    off += want
+    trace = None
+    if flags & FLAG_TRACE:
+        if len(payload) < off + 1:
+            raise WireError("trace flag set but no trace bytes")
+        tl = payload[off]
+        if len(payload) < off + 1 + tl:
+            raise WireError("trace bytes truncated")
+        trace = payload[off + 1:off + 1 + tl].decode("utf-8",
+                                                     errors="replace")
+    return {"request_id": req_id, "rows": rows,
+            "raw_score": bool(flags & FLAG_RAW),
+            "fast": bool(flags & FLAG_FAST),
+            "deadline_ms": float(deadline_ms), "trace": trace}
+
+
+def encode_response_ok(request_id: int, values: np.ndarray,
+                       model_version: int, sha256: str) -> bytes:
+    v = np.ascontiguousarray(values, dtype="<f8")
+    if v.ndim == 1:
+        n, k = v.shape[0], 1
+    else:
+        n, k = v.shape
+    if k > 0xFFFF:
+        raise WireError(f"num_class {k} exceeds the wire's u16 field")
+    sha_b = (sha256 or "").encode("ascii")[:255]
+    body = (_RESP_HEAD.pack(request_id & 0xFFFFFFFF, ST_OK, len(sha_b), k,
+                            n, int(model_version), 0.0)
+            + sha_b + v.tobytes())
+    return _LEN.pack(len(body)) + body
+
+
+def encode_response_error(request_id: int, status: int, message: str,
+                          retry_after_s: float = 0.0) -> bytes:
+    mb = str(message).encode("utf-8")[:2048]
+    body = (_RESP_HEAD.pack(request_id & 0xFFFFFFFF, status, 0, 0, 0, 0,
+                            float(retry_after_s))
+            + struct.pack("<H", len(mb)) + mb)
+    return _LEN.pack(len(body)) + body
+
+
+def parse_response(payload: bytes) -> Dict[str, Any]:
+    if len(payload) < _RESP_HEAD.size:
+        raise WireError(f"response frame too short ({len(payload)})")
+    (req_id, status, sha_len, k, nrows, version,
+     retry_after) = _RESP_HEAD.unpack_from(payload)
+    off = _RESP_HEAD.size
+    out: Dict[str, Any] = {"request_id": req_id, "status": status,
+                           "model_version": version,
+                           "retry_after_s": retry_after}
+    if status == ST_OK:
+        if len(payload) < off + sha_len + nrows * k * 8:
+            raise WireError("ok response frame truncated")
+        out["model_sha256"] = payload[off:off + sha_len].decode("ascii")
+        off += sha_len
+        v = np.frombuffer(payload, dtype="<f8", count=nrows * k, offset=off)
+        out["predictions"] = v if k == 1 else v.reshape(nrows, k)
+    else:
+        if len(payload) >= off + 2:
+            (ml,) = struct.unpack_from("<H", payload, off)
+            out["error"] = payload[off + 2:off + 2 + ml].decode(
+                "utf-8", errors="replace")
+        else:
+            out["error"] = ""
+    return out
+
+
+def _read_exact(f, n: int) -> Optional[bytes]:
+    """Read exactly n bytes from a buffered file-like; None on EOF before
+    the first byte, :class:`WireError` on EOF mid-read."""
+    data = f.read(n)
+    if not data:
+        return None
+    if len(data) < n:
+        raise WireError(f"connection closed mid-frame ({len(data)}/{n} "
+                        "bytes)")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    """One client connection: socket + bounded outbound queue + writer
+    thread, so a response producer (the batcher worker resolving a
+    future) never blocks on a slow client's send buffer."""
+
+    def __init__(self, sock: socket.socket, out_depth: int = 1024):
+        self.sock = sock
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(out_depth)
+        self._closed = threading.Event()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name="lgbtpu-binwire-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    def send(self, frame: bytes) -> None:
+        try:
+            self._q.put_nowait(frame)
+        except queue.Full:
+            # the client stopped reading: disconnecting it is the bounded
+            # behavior — blocking here would wedge the batcher worker
+            log_debug("binary wire: outbound queue full; dropping client")
+            self.close()
+
+    def _write_loop(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None:
+                return
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                self.close()
+                return
+
+    def close(self, flush: bool = False) -> None:
+        """``flush=True`` drains queued frames (bounded wait) before the
+        socket closes — a structured refusal must reach the client."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._q.put(None, timeout=0.5 if flush else 0.0)
+        except queue.Full:
+            pass
+        if flush and self._writer.is_alive() \
+                and threading.current_thread() is not self._writer:
+            self._writer.join(2.0)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class BinaryServer:
+    """Multi-accept binary front riding the same registry + micro-batcher
+    as the HTTP endpoints (``serve_binary_port``)."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 accept_threads: int = 2, reuse_port: bool = False,
+                 max_frame: int = MAX_FRAME):
+        self.app = app
+        self.accept_threads = max(int(accept_threads), 1)
+        self.max_frame = int(max_frame)
+        self._lock = threading.Lock()
+        self._conns: List[_Conn] = []
+        self._threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+        self.requests = 0
+        self.bad_frames = 0
+        self.connections = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port and hasattr(socket, "SO_REUSEPORT"):
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()[:2]
+
+    def start(self) -> "BinaryServer":
+        for i in range(self.accept_threads):
+            t = threading.Thread(target=self._accept_loop,
+                                 name=f"lgbtpu-binwire-accept{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        log_info(f"binary wire on {self.host}:{self.port} "
+                 f"({self.accept_threads} acceptors)")
+        return self
+
+    def stop_accepting(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        """Close the listener and every live connection.  Called after
+        the batcher drain so in-flight futures already resolved — the
+        flush makes sure their queued response frames reach the client
+        before the FIN (the drain contract: admitted work is answered)."""
+        self.stop_accepting()
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close(flush=True)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"requests": self.requests,
+                    "bad_frames": self.bad_frames,
+                    "connections": self.connections,
+                    "open_connections": sum(1 for c in self._conns
+                                            if not c.closed)}
+
+    # -- accept + per-connection serve loops ------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                sock, _addr = self._sock.accept()
+            except OSError:
+                return     # listener closed
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(sock,),
+                                 name="lgbtpu-binwire-conn", daemon=True)
+            t.start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        from .. import telemetry
+
+        conn = _Conn(sock)
+        with self._lock:
+            self._conns.append(conn)
+            self.connections += 1
+        telemetry.inc("serve/bin_connections")
+        f = sock.makefile("rb", buffering=256 * 1024)
+        try:
+            hello = _read_exact(f, len(HANDSHAKE))
+            if hello is None or hello[:4] != MAGIC or hello[4] != VERSION:
+                return     # not our protocol (or wrong version): close
+            sock.sendall(HANDSHAKE)
+            while not conn.closed:
+                head = f.read(_LEN.size)
+                if not head:
+                    return                     # clean close between frames
+                if len(head) < _LEN.size:
+                    raise WireError("truncated length prefix")
+                (length,) = _LEN.unpack(head)
+                if length < _REQ_HEAD.size or length > self.max_frame:
+                    # structured refusal, then close: an oversize length
+                    # cannot be resynchronized past
+                    with self._lock:
+                        self.bad_frames += 1
+                    telemetry.inc("serve/bin_bad_frames")
+                    conn.send(encode_response_error(
+                        0, ST_BAD_REQUEST,
+                        f"frame length {length} outside "
+                        f"[{_REQ_HEAD.size}, {self.max_frame}]"))
+                    return
+                payload = _read_exact(f, length)
+                if payload is None:
+                    raise WireError("connection closed after length prefix")
+                self._handle_frame(conn, payload)
+        except WireError as e:
+            with self._lock:
+                self.bad_frames += 1
+            telemetry.inc("serve/bin_bad_frames")
+            log_debug(f"binary wire: {e}; closing connection")
+        except chaos.DropConnection:
+            pass
+        except OSError as e:
+            log_debug(f"binary wire connection error: {e}")
+        finally:
+            try:
+                f.close()
+            except OSError:
+                pass
+            conn.close(flush=True)
+            with self._lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+
+    def _handle_frame(self, conn: _Conn, payload: bytes) -> None:
+        from .. import telemetry
+
+        try:
+            req = parse_request(payload)
+        except WireError as e:
+            with self._lock:
+                self.bad_frames += 1
+            telemetry.inc("serve/bin_bad_frames")
+            conn.send(encode_response_error(0, ST_BAD_REQUEST, str(e)))
+            return
+        rid = req["request_id"]
+        with self._lock:
+            self.requests += 1
+        chaos.request_hook()     # may raise DropConnection (handled above)
+        app = self.app
+        if app.draining:
+            conn.send(encode_response_error(rid, ST_DRAINING,
+                                            "shutting down", 1.0))
+            return
+        ctx = None
+        if req["trace"]:
+            ctx = telemetry.TraceContext.from_header(req["trace"])
+        budget_ms = req["deadline_ms"] or app.deadline_ms
+        deadline = (time.perf_counter() + budget_ms / 1e3
+                    if budget_ms and budget_ms > 0 else None)
+        rows = np.asarray(req["rows"], np.float64)
+        try:
+            fut = app.batcher.submit(
+                rows, raw_score=req["raw_score"],
+                fast=req["fast"] and rows.shape[0] == 1,
+                deadline=deadline, trace=ctx)
+        except DeadlineError as e:
+            conn.send(encode_response_error(rid, ST_DEADLINE, str(e),
+                                            e.retry_after_s))
+            return
+        except OverloadError as e:
+            conn.send(encode_response_error(rid, ST_OVERLOAD, str(e),
+                                            e.retry_after_s))
+            return
+        except LightGBMError as e:
+            conn.send(encode_response_error(rid, ST_BAD_REQUEST, str(e)))
+            return
+        fut.add_done_callback(
+            lambda fu, c=conn, r=rid: self._reply(c, r, fu))
+
+    def _reply(self, conn: _Conn, rid: int, fut) -> None:
+        """Resolve one future into a response frame (runs on whichever
+        thread resolved the future — encode is microseconds, the send is
+        a bounded-queue handoff)."""
+        from .. import telemetry
+
+        try:
+            res = fut.result(timeout=0)
+            sha = self.app.registry.sha_for_version(res.model_version) or ""
+            frame = encode_response_ok(rid, res.values, res.model_version,
+                                       sha)
+        except DeadlineError as e:
+            frame = encode_response_error(rid, ST_DEADLINE, str(e),
+                                          e.retry_after_s)
+        except OverloadError as e:
+            frame = encode_response_error(rid, ST_OVERLOAD, str(e),
+                                          e.retry_after_s)
+        except LightGBMError as e:
+            frame = encode_response_error(rid, ST_BAD_REQUEST, str(e))
+        except CancelledError:
+            frame = encode_response_error(rid, ST_DRAINING,
+                                          "shutting down", 1.0)
+        except Exception as e:  # noqa: BLE001 — the wire must answer
+            frame = encode_response_error(rid, ST_ERROR,
+                                          f"{type(e).__name__}: {e}")
+            telemetry.inc("serve/bin_errors")
+        conn.send(frame)
+
+
+# ---------------------------------------------------------------------------
+# clients
+# ---------------------------------------------------------------------------
+
+class BinaryClient:
+    """Blocking single-connection client (tests, bench, simple callers).
+
+    ``request`` is one synchronous round trip; ``pipeline`` sends a burst
+    of requests before reading any response — the shape that saturates
+    the micro-batcher (responses are matched back by request_id)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.sendall(HANDSHAKE)
+        self._f = self.sock.makefile("rb", buffering=256 * 1024)
+        hello = _read_exact(self._f, len(HANDSHAKE))
+        if hello is None or hello[:4] != MAGIC:
+            raise WireError("server did not answer the wire handshake")
+        self._next_id = 0
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "BinaryClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def send_request(self, rows, *, raw_score: bool = False,
+                     fast: bool = False, deadline_ms: float = 0.0,
+                     trace: Optional[str] = None) -> int:
+        self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+        rid = self._next_id
+        self.sock.sendall(encode_request(rid, np.asarray(rows),
+                                         raw_score=raw_score, fast=fast,
+                                         deadline_ms=deadline_ms,
+                                         trace=trace))
+        return rid
+
+    def read_response(self) -> Dict[str, Any]:
+        head = _read_exact(self._f, _LEN.size)
+        if head is None:
+            raise WireError("connection closed by server")
+        (length,) = _LEN.unpack(head)
+        if length > MAX_RESP_FRAME:
+            raise WireError(f"oversize response frame ({length})")
+        payload = _read_exact(self._f, length)
+        if payload is None:
+            raise WireError("response frame truncated")
+        return parse_response(payload)
+
+    def request(self, rows, *, raw_score: bool = False, fast: bool = False,
+                deadline_ms: float = 0.0,
+                trace: Optional[str] = None) -> Dict[str, Any]:
+        rid = self.send_request(rows, raw_score=raw_score, fast=fast,
+                                deadline_ms=deadline_ms, trace=trace)
+        while True:
+            resp = self.read_response()
+            if resp["request_id"] == rid or resp["request_id"] == 0:
+                return resp
+
+    def pipeline(self, bodies: List[np.ndarray], *,
+                 raw_score: bool = False,
+                 deadline_ms: float = 0.0) -> List[Dict[str, Any]]:
+        """Send every body back to back, then collect every response
+        (responses may arrive out of order; returned in request order)."""
+        ids = []
+        frames = []
+        for rows in bodies:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            ids.append(self._next_id)
+            frames.append(encode_request(self._next_id, np.asarray(rows),
+                                         raw_score=raw_score,
+                                         deadline_ms=deadline_ms))
+        self.sock.sendall(b"".join(frames))
+        got: Dict[int, Dict[str, Any]] = {}
+        want = set(ids)
+        while want:
+            resp = self.read_response()
+            rid = resp["request_id"]
+            if rid in want:
+                want.discard(rid)
+                got[rid] = resp
+            elif rid == 0:
+                # connection-level refusal (bad frame): attribute to all
+                for w in want:
+                    got[w] = resp
+                break
+        return [got[i] for i in ids]
+
+
+class FleetBinaryClient:
+    """Replica-aware binary client: per-replica persistent connections,
+    deadline-split retry on a DIFFERENT replica after a transport
+    failure, and a short cooldown for failed replicas — the client-side
+    analog of the fanout front's route-around behavior (the binary wire
+    has no proxy tier; smart clients route)."""
+
+    def __init__(self, endpoints_fn: Callable[[], Dict[int, Tuple[str, int]]],
+                 attempts: int = 3, cooldown_s: float = 1.0,
+                 connect_timeout: float = 2.0,
+                 endpoints_ttl_s: float = 0.5):
+        self._endpoints_fn = endpoints_fn
+        self.attempts = max(int(attempts), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.connect_timeout = float(connect_timeout)
+        # discovery can be file reads / an HTTP scrape — cache it and
+        # refresh only on TTL expiry or after a transport failure (the
+        # moment a stale port could matter), never per steady request
+        self.endpoints_ttl_s = float(endpoints_ttl_s)
+        self._eps: Dict[int, Tuple[str, int]] = {}
+        self._eps_at = float("-inf")
+        self._conns: Dict[int, BinaryClient] = {}
+        self._addr: Dict[int, Tuple[str, int]] = {}
+        self._bad_until: Dict[int, float] = {}
+        # round-robin base so concurrent clients / successive requests
+        # spread across replicas instead of all camping on the lowest rank
+        self._rr = 0
+        self.retries = 0
+
+    def _endpoints(self, force: bool = False) -> Dict[int, Tuple[str, int]]:
+        now = time.perf_counter()
+        if force or now - self._eps_at > self.endpoints_ttl_s:
+            try:
+                self._eps = dict(self._endpoints_fn())
+            except OSError:
+                self._eps = {}
+            self._eps_at = now
+        return self._eps
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+
+    def _drop(self, rank: int) -> None:
+        c = self._conns.pop(rank, None)
+        if c is not None:
+            c.close()
+        self._bad_until[rank] = time.perf_counter() + self.cooldown_s
+
+    def _conn(self, rank: int, addr: Tuple[str, int],
+              timeout: float) -> BinaryClient:
+        c = self._conns.get(rank)
+        if c is not None and self._addr.get(rank) == addr:
+            c.sock.settimeout(timeout)
+            return c
+        if c is not None:
+            c.close()
+            del self._conns[rank]
+        c = BinaryClient(addr[0], addr[1],
+                         timeout=max(self.connect_timeout, timeout))
+        c.sock.settimeout(timeout)
+        self._conns[rank] = c
+        self._addr[rank] = addr
+        return c
+
+    def request(self, rows, *, raw_score: bool = False,
+                deadline_ms: float = 2000.0) -> Dict[str, Any]:
+        """Returns the wire response dict; transport failures surface as
+        ``{"status": ST_OVERLOAD, "error": "retries_exhausted"}`` after
+        the bounded route-around (the HTTP front's structured-503
+        analog)."""
+        t_end = time.perf_counter() + deadline_ms / 1e3
+        tried: set = set()
+        last: Optional[Dict[str, Any]] = None
+        self._rr += 1
+        for attempt in range(self.attempts):
+            remaining = t_end - time.perf_counter()
+            if remaining <= 0:
+                break
+            # retries force a discovery refresh — a restarted replica
+            # publishes a NEW port; steady state rides the cached map
+            eps = self._endpoints(force=attempt > 0)
+            if not eps:
+                time.sleep(min(0.05, max(remaining, 0)))
+                continue
+            now = time.perf_counter()
+            fresh = sorted(r for r in eps if r not in tried
+                           and self._bad_until.get(r, 0) <= now)
+            pool = (fresh or sorted(r for r in eps if r not in tried)
+                    or sorted(eps))
+            rank = pool[(self._rr + attempt) % len(pool)]
+            per_timeout = max(remaining / (self.attempts - attempt), 0.05)
+            try:
+                c = self._conn(rank, eps[rank], per_timeout)
+                resp = c.request(rows, raw_score=raw_score,
+                                 deadline_ms=remaining * 1e3)
+            except (OSError, WireError):
+                # killed/hung/reset replica: drop the conn (a late reply
+                # would desync it), cool the replica down, go elsewhere
+                self._drop(rank)
+                tried.add(rank)
+                self.retries += 1
+                continue
+            if resp["status"] in (ST_OK, ST_BAD_REQUEST):
+                return resp
+            # overload / deadline / draining: divert, keep the connection
+            last = resp
+            tried.add(rank)
+            self.retries += 1
+        if last is not None:
+            return last
+        return {"request_id": 0, "status": ST_OVERLOAD,
+                "model_version": 0, "retry_after_s": 0.05,
+                "error": "retries_exhausted"}

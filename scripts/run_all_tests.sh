@@ -32,7 +32,7 @@ python -m pytest tests/test_robustness.py -x -q -m 'not slow'
 # micro-batcher, hot reload) is bit-identity-gated against predict, so a
 # regression here flags scoring breakage before the long suites run
 echo "=== stage: serving fast tier ==="
-python -m pytest tests/test_serving.py -x -q -m 'not slow'
+python -m pytest tests/test_serving.py tests/test_wire.py -x -q -m 'not slow'
 # fleet resilience fast tier: deadline propagation, bounded overload
 # shedding, circuit breaker, replica restart-with-backoff, and the
 # poisoned-candidate fleet-wide reload (docs/SERVING.md fleet section)
@@ -80,6 +80,20 @@ BENCH_GOSS_ITERS="${BENCH_GOSS_ITERS:-5}" \
 echo "=== stage: perf sentinel (cost budgets + bench history) ==="
 python scripts/perf_sentinel.py --budgets PERF_BUDGETS.json --measure \
     --history BENCH_HISTORY.jsonl
+# serving throughput bench: the binary-wire hot path must sustain
+# BENCH_SERVE_QPS_MIN (default 10k) loopback QPS with a bounded window
+# p99, zero errors, zero serve_predict recompiles after warmup, and
+# bitwise exactness vs Booster.predict on every bucket size for
+# numeric(+NaN), categorical, and multiclass models — over the wire
+# (docs/SERVING.md "Binary wire protocol"); appends serve_binary_qps
+# to BENCH_HISTORY.jsonl for the sentinel's wall-clock compare
+echo "=== stage: serving throughput bench (BENCH_SERVE=1) ==="
+BENCH_SERVE=1 \
+BENCH_SERVE_ROWS="${BENCH_SERVE_ROWS:-60000}" \
+BENCH_SERVE_MODEL_ITERS="${BENCH_SERVE_MODEL_ITERS:-30}" \
+BENCH_SERVE_SECS="${BENCH_SERVE_SECS:-4}" \
+BENCH_SERVE_HTTP_SECS="${BENCH_SERVE_HTTP_SECS:-2}" \
+    python bench.py
 # fleet chaos bench: 3 replicas under sustained loopback load while
 # chaos SIGKILLs one and wedges another mid-run, with a mid-chaos
 # fleet-wide /reload — gates on zero non-503 errors, bitwise-exact

@@ -372,7 +372,7 @@ def test_fleet_restart_reload_and_poisoned_candidate(served, tmp_path):
                          max_delay_ms=1.0, deadline_ms=5000.0, retries=2,
                          retry_backoff_ms=5.0, breaker_failures=3,
                          breaker_cooldown_s=0.5, restart_backoff_s=0.2,
-                         hang_timeout_s=10.0).start()
+                         hang_timeout_s=10.0, binary_port=0).start()
     try:
         def predict(n=3, timeout=10):
             return http_json(fleet.host, fleet.port, "POST", "/predict",
@@ -385,6 +385,21 @@ def test_fleet_restart_reload_and_poisoned_candidate(served, tmp_path):
         assert np.array_equal(np.asarray(obj["predictions"]),
                               oracle[obj["model_sha256"]][:3])
 
+        # ---- binary wire: every replica published its own wire port,
+        # the front's /stats exposes them for remote discovery, and the
+        # replica-aware client scores bitwise through the wire
+        from lightgbm_tpu.serving import FleetBinaryClient
+        assert sorted(fleet.binary_endpoints()) == [0, 1]
+        st, stats, _ = http_json(fleet.host, fleet.port, "GET", "/stats",
+                                 timeout=10)
+        assert st == 200
+        assert sorted(stats["binary_endpoints"]) == ["0", "1"]
+        fbc = FleetBinaryClient(fleet.binary_endpoints, attempts=3)
+        resp = fbc.request(X[:4], raw_score=True, deadline_ms=4000)
+        assert resp["status"] == 0, resp
+        assert np.array_equal(np.asarray(resp["predictions"]),
+                              oracle[resp["model_sha256"]][:4])
+
         # ---- kill replica 0: traffic keeps flowing (retry/breaker),
         # the supervisor restarts it with backoff
         os.kill(fleet.endpoint(0)["pid"], signal.SIGKILL)
@@ -396,6 +411,12 @@ def test_fleet_restart_reload_and_poisoned_candidate(served, tmp_path):
                 assert np.array_equal(np.asarray(obj["predictions"]),
                                       oracle[obj["model_sha256"]][:2])
             time.sleep(0.02)
+        # the replica-aware binary client routes around the dead wire
+        resp = fbc.request(X[:2], raw_score=True, deadline_ms=4000)
+        assert resp["status"] == 0, resp
+        assert np.array_equal(np.asarray(resp["predictions"]),
+                              oracle[resp["model_sha256"]][:2])
+        fbc.close()
 
         def wait_restarted(deadline_s=30):
             t0 = time.time()

@@ -63,11 +63,11 @@ def test_device_predict_multiclass():
     np.testing.assert_allclose(p_dev, p_host, rtol=1e-4, atol=1e-5)
 
 
-def test_categorical_model_falls_back():
-    rs = np.random.RandomState(6)
-    X = 0.01 * rs.randn(1200, 5)
-    X[:, 3] = rs.randint(0, 6, 1200)
-    y = 3.0 * np.isin(X[:, 3], [1, 4]).astype(float) + 0.01 * rs.randn(1200)
+def _train_cat(n=1200, seed=6):
+    rs = np.random.RandomState(seed)
+    X = 0.01 * rs.randn(n, 5)
+    X[:, 3] = rs.randint(0, 6, n)
+    y = 3.0 * np.isin(X[:, 3], [1, 4]).astype(float) + 0.01 * rs.randn(n)
     bst = lgb.train({"objective": "regression", "num_leaves": 15,
                      "verbosity": -1, "min_data_in_leaf": 5,
                      "max_cat_to_onehot": 1},
@@ -78,9 +78,52 @@ def test_categorical_model_falls_back():
         (np.asarray(t.decision_type[:max(t.num_leaves - 1, 0)]) & 1).any()
         for t in use)
     assert has_cat_split, "model should contain categorical splits"
-    assert bst._try_device_predict(X, use, 1) is None  # cat -> host fallback
+    return bst, X, y
+
+
+def test_device_predict_categorical_matches_host():
+    """Categorical splits walk on-device (bin-domain bitset side table);
+    NaN / unseen / negative category values re-bin to the always-zero
+    sentinel bit, reproducing the host walk's route-right."""
+    bst, X, y = _train_cat()
+    use = bst._all_trees()
+    Xt = X.copy()
+    # adversarial category column: NaN, unseen, negative, fractional,
+    # and far-out-of-range values on top of the seen 0..5
+    rs = np.random.RandomState(8)
+    n = len(Xt)
+    Xt[rs.rand(n) < 0.1, 3] = np.nan
+    Xt[rs.rand(n) < 0.05, 3] = 77.0          # unseen category
+    Xt[rs.rand(n) < 0.05, 3] = -3.0          # negative -> missing
+    Xt[rs.rand(n) < 0.05, 3] = 2.7           # truncates to category 2
+    Xt[rs.rand(n) < 0.02, 3] = 1e12          # far past any bitset span
+    p_dev = bst._try_device_predict(Xt, use, 1)
+    assert p_dev is not None, "categorical model must take the device path"
+    big = Booster._DEVICE_PREDICT_MIN_ROWS
+    Booster._DEVICE_PREDICT_MIN_ROWS = 10 ** 9
+    try:
+        p_host = bst.predict(Xt, raw_score=True)
+    finally:
+        Booster._DEVICE_PREDICT_MIN_ROWS = big
+    np.testing.assert_allclose(np.asarray(p_dev), p_host,
+                               rtol=1e-4, atol=1e-5)
     p = bst.predict(X)
     assert np.corrcoef(p, y)[0, 1] > 0.9
+
+
+def test_linear_tree_model_falls_back():
+    rs = np.random.RandomState(6)
+    X = rs.randn(900, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.01 * rs.randn(900)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    use = bst._all_trees()
+    if not any(t.is_linear for t in use):
+        import pytest
+        pytest.skip("no linear trees were grown")
+    assert bst._try_device_predict(X, use, 1) is None  # linear -> host
 
 
 def test_device_predict_early_stop_matches_host():

@@ -114,6 +114,97 @@ def test_bucket_padding_never_changes_outputs(served):
                               full[s:e])
 
 
+def test_device_vs_host_accumulation_bitwise(served, monkeypatch):
+    """The on-device f64 leaf accumulation and the host-loop fallback
+    (LGBTPU_SERVE_ACCUM=host) are the same bits — and both equal
+    Booster.predict."""
+    pa, _, X, ref, _ = served
+    from lightgbm_tpu.serving.compiled import CompiledPredictor
+    trees = ref._all_trees()
+    dev = CompiledPredictor(trees, 1, X.shape[1], max_batch=64)
+    assert dev.device_accum, "CPU backend must support device f64"
+    monkeypatch.setenv("LGBTPU_SERVE_ACCUM", "host")
+    host = CompiledPredictor(trees, 1, X.shape[1], max_batch=64)
+    assert not host.device_accum
+    want = np.zeros(200, np.float64)
+    for t in trees:
+        want += t.predict_raw(X[:200])
+    for got in (dev.raw_scores(X[:200]), host.raw_scores(X[:200])):
+        assert np.array_equal(got, want)
+    # leaves() introspection surface agrees with the scored walk
+    lv = dev.leaves(X[:50])
+    assert lv.shape == (len(trees), 50)
+    acc = np.zeros(50, np.float64)
+    for i, t in enumerate(trees):
+        acc += np.asarray(t.leaf_value, np.float64)[lv[i]]
+    assert np.array_equal(acc, want[:50])
+
+
+def test_serve_accum_env_validation(monkeypatch):
+    from lightgbm_tpu.serving.compiled import device_accumulation_supported
+    monkeypatch.setenv("LGBTPU_SERVE_ACCUM", "sideways")
+    with pytest.raises(lgb.LightGBMError, match="LGBTPU_SERVE_ACCUM"):
+        device_accumulation_supported()
+
+
+def test_categorical_bitset_edges_bitwise(served, tmp_path):
+    """The device categorical walk's word-index edges, mirroring the
+    native UBSan fixture (tests/test_native_sanitizers.py): bits 31 / 32
+    / 63 of a two-word bitset, the first word index past the span (64),
+    far-out-of-range (1e12, 2^31 + epsilon), negative, fractional, and
+    NaN values — every one bitwise equal to Booster.predict."""
+    rs = np.random.RandomState(6)
+    X = 0.01 * rs.randn(900, 6)
+    X[:, 4] = rs.randint(0, 6, 900)
+    yc = 3.0 * np.isin(X[:, 4], [1, 4]).astype(float) \
+        + 0.01 * rs.randn(900)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "max_cat_to_onehot": 1},
+                    lgb.Dataset(X, label=yc, categorical_feature=[4]),
+                    num_boost_round=4)
+    trees = bst._all_trees()
+    patched = 0
+    for t in trees:
+        ni = max(t.num_leaves - 1, 0)
+        cat_nodes = np.nonzero(
+            (np.asarray(t.decision_type[:ni]).astype(np.int64) & 1) > 0)[0]
+        if len(cat_nodes) == 0:
+            continue
+        # every cat node gets a fresh TWO-WORD bitset holding exactly
+        # bits {31, 32, 63} (word 0 bit 31; word 1 bits 0 and 31)
+        bounds = [0]
+        words = []
+        for k, i in enumerate(cat_nodes):
+            t.threshold_bin[i] = k
+            t.threshold[i] = float(k)
+            words.extend([np.uint32(1 << 31), np.uint32(1 | (1 << 31))])
+            bounds.append(bounds[-1] + 2)
+        t.cat_boundaries = np.asarray(bounds, np.int32)
+        t.cat_threshold = np.asarray(words, np.uint32)
+        patched += len(cat_nodes)
+    assert patched > 0, "model should contain categorical splits"
+    mp = tmp_path / "edges.txt"
+    bst.save_model(str(mp))
+
+    ref = lgb.Booster(model_file=str(mp))
+    model = ModelRegistry(str(mp), max_batch=64).current()
+    edge_vals = [31.0, 32.0, 63.0, 30.0, 33.0, 64.0, 95.0, 1e12,
+                 -3.0, -0.5, 2.5, 31.9, float(2 ** 31) + 7.0,
+                 float(np.nan), 0.0]
+    Xt = np.repeat(X[:1], len(edge_vals), axis=0)
+    Xt[:, 4] = edge_vals
+    for sz in (1, len(edge_vals)):
+        got = model.predict(Xt[:sz], raw_score=True)
+        want = ref.predict(Xt[:sz], raw_score=True)
+        assert np.array_equal(got, want), \
+            f"size {sz}: |diff| {np.abs(got - want).max()}"
+    # the crafted bitset has routing power: in-set (31/32/63) and
+    # out-of-set (30/64/huge) values land on different scores
+    full = ref.predict(Xt, raw_score=True)
+    assert not np.allclose(full[0], full[5])
+
+
 def test_zero_rows_and_feature_mismatch(served):
     pa, _, X, _, _ = served
     model = ModelRegistry(pa).current()

@@ -1,0 +1,342 @@
+"""Binary wire protocol (docs/SERVING.md "Binary wire protocol").
+
+The wire contract under test:
+
+  * codec roundtrips (request and response frames, trace tail, errors);
+  * end-to-end over a live ServingApp: every bucket size bitwise equal
+    to ``Booster.predict`` (raw + transformed, binary + multiclass with
+    categorical/NaN rows), pipelined bursts included;
+  * deadline propagation: an expired budget draws a structured
+    deadline frame, never a scored response;
+  * malformed-frame fuzz: truncated length prefix, oversize length,
+    wrong row width, mid-frame disconnect, junk handshake — each yields
+    a structured error frame or a clean close, never a wedged worker
+    (the LGB008 discipline applied to the accept loop);
+  * HTTP/1.1 keep-alive on the JSON path (connection reuse asserted).
+"""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import BinaryClient, ServingApp, WireError
+from lightgbm_tpu.serving import wire
+
+
+def _make_data(seed=7, n=800):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6)
+    X[:, 4] = rs.randint(0, 9, n)
+    X[rs.rand(n) < 0.15, 0] = np.nan
+    y = ((X[:, 1] > 0) ^ (X[:, 4] == 3)).astype(np.float64)
+    return X, y
+
+
+def _train_to_file(path, seed=3, objective="binary", num_class=1):
+    X, y = _make_data()
+    if num_class > 1:
+        rs = np.random.RandomState(seed)
+        y = rs.randint(0, num_class, len(y)).astype(np.float64)
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "seed": seed}
+    if num_class > 1:
+        params["num_class"] = num_class
+    bst = lgb.train(params, lgb.Dataset(X, label=y,
+                                        categorical_feature=[4]),
+                    num_boost_round=6)
+    bst.save_model(str(path))
+    return X
+
+
+@pytest.fixture(scope="module")
+def servebin(tmp_path_factory):
+    """(app, X, ref) — a ServingApp with the binary wire open."""
+    td = tmp_path_factory.mktemp("wire")
+    mp = td / "model.txt"
+    X = _train_to_file(mp)
+    app = ServingApp(str(mp), port=0, max_batch=32, max_delay_ms=1.0,
+                     queue_size=256, binary_port=0).start()
+    yield app, X, lgb.Booster(model_file=str(mp))
+    app.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_request_roundtrip():
+    rows = np.arange(12, dtype=np.float64).reshape(3, 4)
+    frame = wire.encode_request(42, rows, raw_score=True,
+                                deadline_ms=125.5, trace="abc123;s=1")
+    (length,) = struct.unpack_from("<I", frame)
+    assert length == len(frame) - 4
+    req = wire.parse_request(frame[4:])
+    assert req["request_id"] == 42
+    assert req["raw_score"] and not req["fast"]
+    assert req["deadline_ms"] == pytest.approx(125.5)
+    assert req["trace"] == "abc123;s=1"
+    np.testing.assert_array_equal(req["rows"],
+                                  rows.astype(np.float32))
+
+
+def test_response_roundtrip():
+    v = np.asarray([0.125, -3.5, 7.0])
+    frame = wire.encode_response_ok(7, v, 3, "ab" * 32)
+    resp = wire.parse_response(frame[4:])
+    assert resp["status"] == wire.ST_OK
+    assert resp["model_version"] == 3
+    assert resp["model_sha256"] == "ab" * 32
+    np.testing.assert_array_equal(resp["predictions"], v)   # f64 exact
+
+    frame = wire.encode_response_error(9, wire.ST_OVERLOAD, "queue full",
+                                       retry_after_s=0.25)
+    resp = wire.parse_response(frame[4:])
+    assert resp["status"] == wire.ST_OVERLOAD
+    assert resp["error"] == "queue full"
+    assert resp["retry_after_s"] == pytest.approx(0.25)
+
+
+def test_parse_request_malformed():
+    with pytest.raises(WireError, match="too short"):
+        wire.parse_request(b"\x01\x02")
+    rows = np.zeros((2, 3))
+    frame = wire.encode_request(1, rows)
+    with pytest.raises(WireError, match="payload short"):
+        wire.parse_request(frame[4:-5])      # truncated rows
+    bad_op = bytearray(frame[4:])
+    bad_op[4] = 99
+    with pytest.raises(WireError, match="unknown wire op"):
+        wire.parse_request(bytes(bad_op))
+
+
+# ---------------------------------------------------------------------------
+# end to end
+# ---------------------------------------------------------------------------
+
+def test_binary_bitwise_every_bucket(servebin):
+    app, X, ref = servebin
+    with BinaryClient(app.host, app.binary_port) as c:
+        for sz in (1, 2, 7, 8, 9, 31, 32, 33, 200):
+            for raw in (True, False):
+                resp = c.request(X[:sz], raw_score=raw)
+                assert resp["status"] == wire.ST_OK, resp
+                want = ref.predict(X[:sz], raw_score=raw)
+                got = np.asarray(resp["predictions"])
+                assert got.shape == want.shape
+                assert np.array_equal(got, want), \
+                    f"size {sz} raw={raw}: |diff| {np.abs(got-want).max()}"
+                assert resp["model_sha256"] == app.registry.current().sha256
+
+
+def test_binary_multiclass_bitwise(tmp_path):
+    mp = tmp_path / "mc.txt"
+    X = _train_to_file(mp, objective="multiclass", num_class=3)
+    ref = lgb.Booster(model_file=str(mp))
+    app = ServingApp(str(mp), port=0, max_batch=16, max_delay_ms=1.0,
+                     binary_port=0).start()
+    try:
+        with BinaryClient(app.host, app.binary_port) as c:
+            for sz in (1, 5, 17):
+                for raw in (True, False):
+                    resp = c.request(X[:sz], raw_score=raw)
+                    assert resp["status"] == wire.ST_OK
+                    assert np.array_equal(
+                        np.asarray(resp["predictions"]),
+                        ref.predict(X[:sz], raw_score=raw))
+    finally:
+        app.shutdown(drain=True)
+
+
+def test_binary_pipelined_burst(servebin):
+    """Many frames in flight coalesce into batcher dispatches; every
+    response still matches its request bitwise."""
+    app, X, ref = servebin
+    want = ref.predict(X[:200], raw_score=True)
+    with BinaryClient(app.host, app.binary_port) as c:
+        spans = [(int(s), int(s + m)) for s, m in
+                 zip(np.arange(0, 180, 3), [1, 2, 5] * 20)]
+        resps = c.pipeline([X[s:e] for s, e in spans], raw_score=True)
+        for (s, e), resp in zip(spans, resps):
+            assert resp["status"] == wire.ST_OK
+            assert np.array_equal(np.asarray(resp["predictions"]),
+                                  want[s:e])
+
+
+def test_binary_fast_flag_and_trace_echo(servebin):
+    app, X, ref = servebin
+    with BinaryClient(app.host, app.binary_port) as c:
+        resp = c.request(X[:1], raw_score=True, fast=True,
+                         trace="cafe01;s=0")
+        assert resp["status"] == wire.ST_OK
+        assert np.array_equal(np.asarray(resp["predictions"]),
+                              ref.predict(X[:1], raw_score=True))
+
+
+def test_binary_deadline_expired(servebin):
+    app, X, _ = servebin
+    with BinaryClient(app.host, app.binary_port) as c:
+        # 1e-3 ms: expired before admission — structured frame, no score
+        resp = c.request(X[:4], deadline_ms=1e-3)
+        assert resp["status"] == wire.ST_DEADLINE
+        assert "deadline" in resp["error"]
+        # the connection keeps serving afterwards
+        resp = c.request(X[:4])
+        assert resp["status"] == wire.ST_OK
+
+
+def test_binary_wrong_row_width(servebin):
+    app, X, _ = servebin
+    with BinaryClient(app.host, app.binary_port) as c:
+        resp = c.request(np.zeros((2, 3)))           # model has 6 features
+        assert resp["status"] == wire.ST_BAD_REQUEST
+        assert "features" in resp["error"]
+        resp = c.request(X[:2])                      # conn still healthy
+        assert resp["status"] == wire.ST_OK
+
+
+# ---------------------------------------------------------------------------
+# malformed-frame fuzz: the accept loop never wedges
+# ---------------------------------------------------------------------------
+
+def _raw_conn(app):
+    s = socket.create_connection((app.host, app.binary_port), timeout=10)
+    s.sendall(wire.HANDSHAKE)
+    hello = s.recv(8)
+    assert hello[:4] == wire.MAGIC
+    return s
+
+
+def _assert_still_serving(app, X):
+    with BinaryClient(app.host, app.binary_port) as c:
+        assert c.request(X[:2])["status"] == wire.ST_OK
+    assert app.batcher.worker_alive
+
+
+def test_fuzz_truncated_length_prefix(servebin):
+    app, X, _ = servebin
+    s = _raw_conn(app)
+    s.sendall(b"\x07")            # 1 of 4 length bytes, then vanish
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_fuzz_oversize_length(servebin):
+    app, X, _ = servebin
+    s = _raw_conn(app)
+    s.sendall(struct.pack("<I", 2 ** 31 - 1))
+    f = s.makefile("rb")
+    head = f.read(4)              # structured refusal frame, then close
+    assert head, "server closed without an error frame"
+    (length,) = struct.unpack("<I", head)
+    resp = wire.parse_response(f.read(length))
+    assert resp["status"] == wire.ST_BAD_REQUEST
+    assert "length" in resp["error"]
+    assert f.read(1) == b""       # connection closed after the refusal
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_fuzz_mid_frame_disconnect(servebin):
+    app, X, _ = servebin
+    s = _raw_conn(app)
+    frame = wire.encode_request(5, X[:8])
+    s.sendall(frame[:len(frame) // 2])    # half a frame, then vanish
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_fuzz_garbage_header_payload(servebin):
+    app, X, _ = servebin
+    s = _raw_conn(app)
+    s.sendall(struct.pack("<I", 16) + b"\xff" * 16)   # bad op byte
+    f = s.makefile("rb")
+    head = f.read(4)
+    (length,) = struct.unpack("<I", head)
+    resp = wire.parse_response(f.read(length))
+    assert resp["status"] == wire.ST_BAD_REQUEST
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_fuzz_junk_handshake(servebin):
+    app, X, _ = servebin
+    s = socket.create_connection((app.host, app.binary_port), timeout=10)
+    s.sendall(b"GET / HTTP/1.1\r\n\r\n")   # an HTTP client on the wire port
+    assert s.recv(64) == b""               # silently closed, nothing leaked
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_binary_stats_surface(servebin):
+    """Self-sufficient (no reliance on sibling tests having run): drive
+    one good request and one bad frame, then assert the counters."""
+    app, X, _ = servebin
+    before = app.binary.stats()
+    with BinaryClient(app.host, app.binary_port) as c:
+        assert c.request(X[:2])["status"] == wire.ST_OK
+    s = _raw_conn(app)
+    s.sendall(struct.pack("<I", 16) + b"\xff" * 16)   # bad op byte
+    s.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = app.binary.stats()
+        if (st["bad_frames"] > before["bad_frames"]
+                and st["requests"] > before["requests"]):
+            break
+        time.sleep(0.02)
+    assert st["requests"] > before["requests"]
+    assert st["connections"] > before["connections"]
+    assert st["bad_frames"] > before["bad_frames"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP keep-alive satellite: the JSON path reuses connections
+# ---------------------------------------------------------------------------
+
+def test_http_keepalive_connection_reuse(servebin):
+    import http.client
+
+    app, X, ref = servebin
+    conn = http.client.HTTPConnection(app.host, app.port, timeout=15)
+    try:
+        socks = []
+        for _ in range(3):
+            conn.request("POST", "/predict",
+                         json.dumps({"rows": X[:3].tolist(),
+                                     "raw_score": True}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            obj = json.loads(r.read())
+            assert r.status == 200
+            assert np.array_equal(np.asarray(obj["predictions"]),
+                                  ref.predict(X[:3], raw_score=True))
+            socks.append(conn.sock)
+        # HTTP/1.1 keep-alive: one TCP connection served all three
+        # requests (a Connection: close server would null conn.sock
+        # after each response and reconnect)
+        assert socks[0] is not None
+        assert all(s is socks[0] for s in socks), \
+            "connection was re-established between requests"
+    finally:
+        conn.close()
+
+
+def test_binary_draining_refusal(tmp_path):
+    mp = tmp_path / "m.txt"
+    X = _train_to_file(mp, seed=5)
+    app = ServingApp(str(mp), port=0, max_batch=16, binary_port=0).start()
+    c = BinaryClient(app.host, app.binary_port)
+    try:
+        assert c.request(X[:2])["status"] == wire.ST_OK
+        app._draining = True
+        resp = c.request(X[:2])
+        assert resp["status"] == wire.ST_DRAINING
+    finally:
+        app._draining = False
+        c.close()
+        app.shutdown(drain=True)
+        time.sleep(0.05)
