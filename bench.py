@@ -1564,9 +1564,245 @@ def run_fleet_bench():
     return ok
 
 
+def _write_synth_csv(path, n_rows, n_feat, seed=7, chunk=200_000,
+                     decimals=None):
+    """Stream a synthetic HIGGS-like CSV to disk chunk by chunk — the
+    generator itself never materializes the matrix (the whole point of
+    the out-of-core gate is that nothing full-size ever exists in RAM)."""
+    from lightgbm_tpu.robustness.checkpoint import atomic_open
+    with atomic_open(path, "w") as fh:
+        for ci, s in enumerate(range(0, n_rows, chunk)):
+            m = min(chunk, n_rows - s)
+            rng = np.random.RandomState(seed + ci)
+            X = rng.randn(m, n_feat)
+            if decimals is not None:
+                X = np.round(X, decimals)
+            y = (X[:, 0] + 0.6 * X[:, 1] + 0.25 * rng.randn(m)
+                 > 0).astype(np.float64)
+            np.savetxt(fh, np.column_stack([y, X]), delimiter=",",
+                       fmt="%.6g")
+    return os.path.getsize(path)
+
+
+def _ingest_child() -> bool:
+    """Subprocess arm of BENCH_INGEST: stream-ingest the CSV written by
+    the parent and train a couple of iterations, reporting peak-RSS
+    delta and ingest throughput as one JSON line on stdout.  A child
+    process gives the RSS gate a clean ru_maxrss baseline (the parent's
+    own allocations never leak into the measurement)."""
+    import lightgbm_tpu as lgb
+    path = os.environ["_BENCH_INGEST_PATH"]
+    params = json.loads(os.environ["_BENCH_INGEST_PARAMS"])
+    rounds = int(os.environ.get("BENCH_INGEST_TRAIN_ROUNDS", 2))
+    rss0 = _rss_kb() * 1024
+    ds = lgb.Dataset(path, params=params)
+    ds.construct()
+    stats = ds.ingest_stats or {}
+    # the RSS gate judges INGEST (stats peak sampled during both
+    # passes): on TPU the shipped bins + train state live in HBM, so
+    # the CPU sim box's training allocations (device buffers = host
+    # RAM here) are reported separately, not gated
+    rss_ingest = int(stats.get("peak_rss_bytes") or (_rss_kb() * 1024))
+    trees = 0
+    if rounds > 0:
+        bst = lgb.train(params, ds, num_boost_round=rounds)
+        trees = bst.num_trees()
+    out = {
+        "rss_baseline_bytes": rss0,
+        "rss_peak_bytes": rss_ingest,
+        "rss_after_train_bytes": _rss_kb() * 1024,
+        "ingest": {k: stats.get(k) for k in
+                   ("rows", "chunks", "wall_s", "rows_per_s",
+                    "bytes_per_s", "bytes", "peak_rss_bytes",
+                    "cache_hit", "sketch_exact", "mode")},
+        "trees": trees,
+    }
+    print("INGEST_CHILD " + json.dumps(out), flush=True)
+    return bool(stats) and trees == rounds
+
+
+def run_ingest():
+    """BENCH_TASK=ingest: the out-of-core ingest gate (docs/INGEST.md).
+
+    (a) BIT-IDENTITY at a size where every loader fits: trees from the
+        in-memory loader, the streaming loader, and a binned-cache
+        re-run must be bytewise equal (LGBTPU_INGEST env A/B keeps the
+        recorded params identical across arms).
+    (b) SCALE: a subprocess stream-ingests a synthetic CSV whose raw
+        float64 materialization exceeds the configured host-RAM budget
+        (BENCH_INGEST_RSS_BUDGET_GB, default raw/2), and its peak-RSS
+        DELTA must stay under that budget while ingest sustains
+        BENCH_INGEST_MIN_ROWS_S rows/s.  Writes BENCH_INGEST.json and
+        appends ingest_stream_rows_per_s to BENCH_HISTORY.jsonl only on
+        a passing gate."""
+    import shutil
+    import tempfile
+
+    td = tempfile.mkdtemp(prefix="bench_ingest_")
+    try:
+        # the synthetic CSVs run to GB scale — never leak them, even on
+        # a mid-gate exception or child timeout
+        return _run_ingest_gate(td)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def _run_ingest_gate(td):
+    import subprocess
+
+    import lightgbm_tpu as lgb
+
+    ok = True
+    # ---- (a) identity gate ---------------------------------------------
+    n_id = int(os.environ.get("BENCH_INGEST_ID_ROWS", 120_000))
+    f_id = int(os.environ.get("BENCH_INGEST_FEATURES", 16))
+    id_csv = os.path.join(td, "ident.csv")
+    _write_synth_csv(id_csv, n_id, f_id, seed=3, decimals=3)
+    params = {
+        "objective": "binary", "num_leaves": 31, "max_bin": 63,
+        "verbosity": -1, "min_data_in_leaf": 20,
+        # every loader must see the SAME effective sample: all rows
+        "bin_construct_sample_cnt": max(200_000, n_id),
+        "ingest_sketch_size": 262_144,
+        "ingest_cache_path": os.path.join(td, "ident.lgbcache"),
+    }
+    models = {}
+    for arm, env in (("inmem", {"LGBTPU_INGEST": "inmem"}),
+                     ("stream", {"LGBTPU_INGEST": "stream"}),
+                     ("cache_write", {"LGBTPU_INGEST": "stream"}),
+                     ("cache_hit", {"LGBTPU_INGEST": "stream"})):
+        p = dict(params)
+        if arm.startswith("cache"):
+            p["ingest_cache"] = "auto"
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            ds = lgb.Dataset(id_csv, params=p)
+            bst = lgb.train(p, ds, num_boost_round=10)
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+        # the params block records each arm's knobs; the TREES are the
+        # identity surface
+        models[arm] = bst.model_to_string().split("parameters:")[0]
+        if arm == "cache_hit" and not (ds.ingest_stats or {}).get(
+                "cache_hit"):
+            print("BENCH_INGEST: cache arm missed its cache", flush=True)
+            ok = False
+    identical = (models["inmem"] == models["stream"]
+                 == models["cache_write"] == models["cache_hit"])
+    if not identical:
+        print("BENCH_INGEST: inmem/stream/cache trees NOT bit-identical",
+              flush=True)
+        ok = False
+
+    # ---- (b) scale gate -------------------------------------------------
+    n_big = int(os.environ.get("BENCH_INGEST_ROWS", 2_000_000))
+    f_big = int(os.environ.get("BENCH_INGEST_FEATURES", 28))
+    raw_bytes = n_big * (f_big + 1) * 8
+    budget = float(os.environ.get("BENCH_INGEST_RSS_BUDGET_GB", 0)) * 1e9 \
+        or raw_bytes / 2
+    min_rows_s = float(os.environ.get("BENCH_INGEST_MIN_ROWS_S", 50_000))
+    big_csv = os.path.join(td, "big.csv")
+    t0 = time.time()
+    csv_bytes = _write_synth_csv(big_csv, n_big, f_big, seed=11)
+    gen_s = time.time() - t0
+    child_params = {
+        "objective": "binary", "num_leaves": 31, "max_bin": 63,
+        "verbosity": -1, "ingest_mode": "stream",
+        "ingest_chunk_rows": int(os.environ.get("BENCH_INGEST_CHUNK",
+                                                262_144)),
+    }
+    env = dict(os.environ, _BENCH_INGEST_CHILD="1",
+               _BENCH_INGEST_PATH=big_csv,
+               _BENCH_INGEST_PARAMS=json.dumps(child_params),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", ""))
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, timeout=3600,
+                           env=env)
+        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
+    except subprocess.TimeoutExpired as exc:
+        rc = -1
+        out = exc.stdout if isinstance(exc.stdout, str) else ""
+        err = (exc.stderr if isinstance(exc.stderr, str) else "") \
+            + "\nBENCH_INGEST: child timed out after 3600s"
+    child = None
+    for ln in out.splitlines():
+        if ln.startswith("INGEST_CHILD "):
+            child = json.loads(ln[len("INGEST_CHILD "):])
+    if rc != 0 or child is None:
+        print(f"BENCH_INGEST: child failed rc={rc}\n"
+              f"{out[-2000:]}\n{err[-2000:]}", flush=True)
+        ok = False
+        child = {"rss_baseline_bytes": 0, "rss_peak_bytes": 0,
+                 "ingest": {}}
+    rss_delta = child["rss_peak_bytes"] - child["rss_baseline_bytes"]
+    ing = child["ingest"]
+    rows_per_s = float(ing.get("rows_per_s") or 0)
+    if raw_bytes < 2 * budget - 1:
+        print(f"BENCH_INGEST: raw dataset ({raw_bytes / 1e9:.2f} GB) does "
+              f"not exceed 2x the RSS budget ({budget / 1e9:.2f} GB) — "
+              "the out-of-core claim would be vacuous", flush=True)
+        ok = False
+    if rss_delta > budget:
+        print(f"BENCH_INGEST: peak RSS delta {rss_delta / 1e9:.2f} GB "
+              f"over budget {budget / 1e9:.2f} GB", flush=True)
+        ok = False
+    if rows_per_s < min_rows_s:
+        print(f"BENCH_INGEST: {rows_per_s:.0f} rows/s under gate "
+              f"{min_rows_s:.0f}", flush=True)
+        ok = False
+
+    import jax
+    record = {
+        "metric": "ingest_stream_rows_per_s",
+        "value": round(rows_per_s, 1),
+        "unit": (f"rows/s streaming {n_big} x {f_big} CSV "
+                 f"({csv_bytes / 1e9:.2f} GB file, raw f64 "
+                 f"{raw_bytes / 1e9:.2f} GB); peak RSS delta "
+                 f"{rss_delta / 1e9:.2f} GB "
+                 f"{'<=' if rss_delta <= budget else '> GATE '}"
+                 f"{budget / 1e9:.2f} GB budget; trees bit-identical "
+                 f"inmem==stream==cache: {identical}"),
+        "vs_baseline": (round(raw_bytes / max(rss_delta, 1), 2)
+                        if ok else 0.0),
+        "rows": n_big,
+        "features": f_big,
+        "csv_bytes": csv_bytes,
+        "raw_bytes": raw_bytes,
+        "rss_budget_bytes": int(budget),
+        "rss_delta_bytes": int(rss_delta),
+        "rss_after_train_bytes": int(child.get("rss_after_train_bytes", 0)),
+        "train_rounds": int(os.environ.get("BENCH_INGEST_TRAIN_ROUNDS", 2)),
+        "bytes_per_s": int(ing.get("bytes_per_s") or 0),
+        "chunks": ing.get("chunks"),
+        "sketch_exact": ing.get("sketch_exact"),
+        "csv_gen_s": round(gen_s, 1),
+        "identity_rows": n_id,
+        "bit_identical": identical,
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(record), flush=True)
+    _append_history(record, ok=ok)
+    if ok and os.environ.get("BENCH_INGEST_SMOKE", "") != "1":
+        # the committed artifact holds the last PASSING full-size
+        # measurement; the reduced-size CI smoke (BENCH_INGEST_SMOKE=1)
+        # gates without clobbering it (the BENCH_GOSS lesson)
+        from lightgbm_tpu.robustness.checkpoint import atomic_open
+        with atomic_open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_INGEST.json"), "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
+    return ok
+
+
 if __name__ == "__main__":
     if os.environ.get("_BENCH_MC_CHILD", "") == "1":
         sys.exit(0 if _multichip_child() else 1)
+    if os.environ.get("_BENCH_INGEST_CHILD", "") == "1":
+        sys.exit(0 if _ingest_child() else 1)
     if os.environ.get("BENCH_MULTICHIP", "") == "1":
         sys.exit(0 if run_multichip_bench() else 1)
     if os.environ.get("BENCH_SERVE", "") == "1":
@@ -1574,11 +1810,13 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_FLEET", "") == "1":
         sys.exit(0 if run_fleet_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
-    if task not in ("", "higgs", "ranking", "multiclass", "goss"):
+    if task not in ("", "higgs", "ranking", "multiclass", "goss", "ingest"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "
-                 "multiclass, goss")
+                 "multiclass, goss, ingest")
     if task == "goss":
         sys.exit(0 if run_goss() else 1)
+    if task == "ingest":
+        sys.exit(0 if run_ingest() else 1)
     ok = True
     if task in ("", "higgs"):
         ok = main() and ok

@@ -173,14 +173,22 @@ class Dataset:
                  feature_name: Union[str, List[str]] = "auto",
                  categorical_feature: Union[str, List] = "auto",
                  params: Optional[Dict[str, Any]] = None,
-                 free_raw_data: bool = False, position=None):
+                 free_raw_data: Optional[bool] = None, position=None):
         self.params = dict(params or {})
         self.reference = reference
+        # None = auto: file-loaded datasets free the raw matrix after
+        # construct() (nothing re-reads it and it is the largest host
+        # allocation — stock frees file data too); in-memory containers
+        # stay referenced unless the caller opts in
         self.free_raw_data = free_raw_data
+        self._from_file = isinstance(data, (str, Path))
         self._feature_name_arg = feature_name
         self._categorical_feature_arg = categorical_feature
         self._predictor = None
         self._dist = None
+        self._stream = None              # streaming-ingest source info
+        self._streamed = False
+        self.ingest_stats = None
         self.pandas_categorical = None   # training category lists (DataFrames)
         self._raw_container = None       # original user container (get_data)
         self.raw_seq = None
@@ -216,6 +224,45 @@ class Dataset:
             if isinstance(feature_name, list):
                 self._resolved_feature_names = [str(x) for x in feature_name]
             return
+        if isinstance(data, (str, Path)):
+            from .ingest import resolve_ingest_mode
+            if resolve_ingest_mode(self.params, str(data)) == "stream":
+                from .dataset_io import detect_file_format
+                if detect_file_format(str(data)) != "libsvm":
+                    # defer ALL parsing to construct(): the streaming
+                    # two-pass loader (docs/INGEST.md) reads the file in
+                    # O(ingest_chunk_rows) chunks — num_data/num_feature
+                    # are unknown until pass 1 runs
+                    from .parallel.dist_data import dist_context
+                    dist = None
+                    if not self.params.get("pre_partition", False):
+                        dist = dist_context()
+                    self._stream = {"kind": "file", "path": str(data),
+                                    "dist": dist}
+                    if dist is not None:
+                        self._dist = {"rank": dist[0], "nproc": dist[1]}
+                    self.raw_data = None
+                    self.raw_sparse = None
+                    self._pandas_names = None
+                    self._pandas_cat_idx = []
+                    self.num_data_ = -1
+                    self.num_feature_ = -1
+                    self.label = None if label is None else \
+                        np.asarray(label, np.float64).reshape(-1)
+                    self.weight = None if weight is None else \
+                        np.asarray(weight, np.float64).reshape(-1)
+                    self.init_score = None if init_score is None else \
+                        np.asarray(init_score, np.float64)
+                    self.position = None if position is None else \
+                        np.asarray(position, np.int32).reshape(-1)
+                    self.group = None if group is None else \
+                        np.asarray(group, np.int64).reshape(-1)
+                    self.binned = None
+                    self._device = None
+                    self._resolved_feature_names = None
+                    return
+                log_info("ingest_mode=stream: LibSVM input falls back to "
+                         "the in-memory loader")
         if isinstance(data, (str, Path)):
             from .dataset_io import load_data_file
             from .parallel.dist_data import dist_context
@@ -340,8 +387,9 @@ class Dataset:
                 "ranking data must be pre-partitioned on query boundaries")
         fg = check_uniform_features(self.num_feature_)
         if fg != self.num_feature_:
-            self.raw_data = np.pad(self.raw_data,
-                                   ((0, 0), (0, fg - self.num_feature_)))
+            if self.raw_data is not None:
+                self.raw_data = np.pad(self.raw_data,
+                                       ((0, 0), (0, fg - self.num_feature_)))
             self.num_feature_ = fg
         n_local = self.num_data_
         base = shard_pad_base()
@@ -500,17 +548,100 @@ class Dataset:
         elif self._pandas_names is not None:
             names = self._pandas_names
         else:
+            if self.num_feature_ < 0:
+                # deferred streaming ingest: width unknown until pass 1 —
+                # don't cache an empty list
+                return []
             names = [f"Column_{i}" for i in range(self.num_feature_)]
         self._resolved_feature_names = names
         return names
 
     # ------------------------------------------------------------------
+    def _should_free_raw(self) -> bool:
+        """Explicit free_raw_data only; the file-source auto-free is
+        deferred to the training path (_free_raw_after_train) because
+        construct() cannot know whether subset() (lgb.cv folds) or the
+        linear-tree fitter will still need the raw matrix."""
+        if self.free_raw_data is not None:
+            return bool(self.free_raw_data)
+        return self._streamed and self._from_file
+
+    def _free_raw_after_train(self, cfg) -> None:
+        """Auto-free for file-loaded datasets once a Booster owns the
+        binned data: nothing re-reads the raw matrix on the training
+        path and it is the largest host allocation.  linear_tree keeps
+        it (the leaf fitter reads raw feature values); an explicit
+        free_raw_data=False always wins."""
+        if self.free_raw_data is None and self._from_file \
+                and not cfg.linear_tree:
+            self.raw_data = None
+            self.raw_sparse = None
+            self._raw_container = None
+
+    def _eagerize_stream_file(self) -> None:
+        """Replace the deferred streaming file source with the eager
+        in-memory load (same parse + sidecars as __init__'s file path).
+        linear_tree needs this: its leaf fitter reads raw feature
+        values, which streaming ingest never materializes."""
+        from .dataset_io import load_data_file
+        info = self._stream
+        dist = info.get("dist")
+        if dist is not None:
+            rank, nproc = dist
+            data, label_file, extras = load_data_file(
+                info["path"], self.params, rank=rank, num_machines=nproc)
+        else:
+            data, label_file, extras = load_data_file(info["path"],
+                                                      self.params)
+        if self.label is None and label_file is not None:
+            self.label = np.asarray(label_file, np.float64).reshape(-1)
+        if self.weight is None and extras.get("weight") is not None:
+            self.weight = np.asarray(extras["weight"],
+                                     np.float64).reshape(-1)
+        if self.group is None and extras.get("group") is not None:
+            self.group = np.asarray(extras["group"], np.int64).reshape(-1)
+        if self.position is None and extras.get("position") is not None:
+            self.position = np.asarray(extras["position"],
+                                       np.int32).reshape(-1)
+        if self.init_score is None and extras.get("init_score") is not None:
+            self.init_score = np.asarray(extras["init_score"], np.float64)
+        self.raw_data = np.asarray(data, np.float64)
+        self.num_data_, self.num_feature_ = self.raw_data.shape
+        self._stream = None
+        if self._dist is not None:
+            self._finalize_distributed()
+
     def construct(self) -> "Dataset":
         if self.binned is not None:
             return self
+        cfg = Config.from_params(self.params)
+        if self._stream is not None and cfg.linear_tree:
+            log_warning(
+                "linear_tree needs the raw feature matrix, which "
+                "streaming ingest never materializes — falling back to "
+                "the in-memory loader")
+            self._eagerize_stream_file()
+        if self._stream is not None or (
+                str(cfg.ingest_mode).lower() == "stream"
+                and not self._from_file
+                and self.raw_sparse is None
+                and (self.raw_data is not None or self.raw_seq is not None
+                     or self.raw_arrow is not None)):
+            # streaming two-pass ingest (docs/INGEST.md): deferred file
+            # sources always route here; in-memory containers route here
+            # when ingest_mode=stream is explicit (sketch-based mappers,
+            # chunked bin fill, optional memory-mapped cache)
+            from .ingest import stream_construct
+            stream_construct(self, cfg)
+            self._streamed = True
+            if self._should_free_raw():
+                self.raw_data = None
+                self.raw_seq = None
+                self.raw_arrow = None
+                self._raw_container = None
+            return self
         if self.num_data_ == 0:
             raise LightGBMError("Cannot construct Dataset: it has no rows")
-        cfg = Config.from_params(self.params)
         if self._dist is not None:
             return self._construct_distributed(cfg)
         if self.raw_seq is not None:
@@ -576,8 +707,12 @@ class Dataset:
                                    for f in range(self.num_feature_)]
                     groups = find_feature_groups(sample_bins, mappers,
                                                  enable_bundle=True)
+                    # the sampled per-feature bin pool is dead the moment
+                    # groups exist — free it BEFORE the full bin fill
+                    # allocates the (N, G) matrix (peak-memory moment)
+                    del sample_bins
                 self.binned = construct_binned(self.raw_data, mappers, groups)
-        if self.free_raw_data:
+        if self._should_free_raw():
             self.raw_data = None
             self.raw_sparse = None
             self._raw_container = None
@@ -639,13 +774,14 @@ class Dataset:
             sample_bins = [mappers[f].transform(samples[f]) for f in range(F)]
             groups = find_feature_groups(sample_bins, mappers,
                                          enable_bundle=True)
+            del sample_bins
         del samples
         self.binned = construct_binned_columns(
             None, n, F, mappers, groups,
             get_col_chunks=lambda f: (
                 (s, np.asarray(v, np.float64))
                 for s, v in self._arrow_col_chunks(f)))
-        if self.free_raw_data:
+        if self._should_free_raw():
             self.raw_arrow = None
         return self
 
@@ -655,7 +791,6 @@ class Dataset:
         through binning batch by batch into the uint8 matrix (reference:
         two-round sampling + push-rows, dataset_loader.cpp:258 /
         DatasetPushRows)."""
-        from dataclasses import replace
         from .binning import load_forced_bins
         seqs = self.raw_seq
         n = self.num_data_
@@ -688,9 +823,17 @@ class Dataset:
                            for f in range(self.num_feature_)]
             groups = find_feature_groups(sample_bins, mappers,
                                          enable_bundle=True)
-        # stream batches through binning into the final uint8 matrix
-        proto = construct_binned(sample[:1], mappers, groups)
-        bins = np.empty((n, proto.bins.shape[1]), proto.bins.dtype)
+            del sample_bins
+        # the sample pool is dead once mappers + groups exist — free it
+        # BEFORE allocating the full (N, G) bin matrix
+        del sample
+        # stream batches straight into ONE preallocated bin matrix: each
+        # chunk's rows bin in place (binning.bin_rows_into), no per-chunk
+        # BinnedData/array allocation
+        from .binning import BinnedData, bin_rows_into, binned_layout
+        (groups, group_bin_counts, group_offsets, feature_offsets,
+         feature_num_bins, dtype) = binned_layout(mappers, groups)
+        bins = np.empty((n, len(groups)), dtype)
         row = 0
         for q in seqs:
             bs = max(int(getattr(q, "batch_size", 4096) or 4096), 1)
@@ -698,11 +841,17 @@ class Dataset:
                 chunk = np.asarray(q[s_:min(s_ + bs, len(q))], np.float64)
                 if chunk.ndim == 1:
                     chunk = chunk.reshape(1, -1)
-                bins[row:row + len(chunk)] = construct_binned(
-                    chunk, mappers, groups).bins
+                bin_rows_into(chunk, mappers, groups, bins, row)
                 row += len(chunk)
-        self.binned = replace(proto, bins=bins, num_data=n)
-        if self.free_raw_data:
+        self.binned = BinnedData(
+            bins=bins, group_features=groups,
+            group_offsets=np.asarray(group_offsets, np.int32),
+            group_bin_counts=np.asarray(group_bin_counts, np.int32),
+            feature_offsets=np.asarray(feature_offsets, np.int32),
+            feature_num_bins=np.asarray(feature_num_bins, np.int32),
+            bin_mappers=mappers, num_data=n,
+            num_features=self.num_feature_)
+        if self._should_free_raw():
             self.raw_seq = None
         return self
 
@@ -753,19 +902,27 @@ class Dataset:
                            for f in range(self.num_feature_)]
             groups = find_feature_groups(sample_bins, mappers,
                                          enable_bundle=True)
+            del sample_bins
+        del sample
         local = construct_binned(self.raw_data, mappers, groups)
         n_shard = d["n_shard"]
         bins = np.pad(local.bins, ((0, n_shard - local.bins.shape[0]),
                                    (0, 0)))
         self.binned = replace(local, bins=bins, num_data=n_shard)
-        if self.free_raw_data:
+        if self._should_free_raw():
             self.raw_data = None
         return self
 
     def device_data(self) -> DeviceData:
         if self._device is None:
             self.construct()
-            self._device = to_device(self.binned)
+            ship = None
+            if self._streamed and self.ingest_stats:
+                # streamed datasets ship chunk by chunk into a donated
+                # device buffer where the backend supports it, so the
+                # host never stages a padded full-size copy
+                ship = self.ingest_stats.get("chunk_rows")
+            self._device = to_device(self.binned, ship_chunk_rows=ship)
         return self._device
 
     def bin_mappers(self):
@@ -1074,6 +1231,10 @@ class Booster:
             # merge dataset params (dataset params win for binning keys)
             train_set.params = {**params, **train_set.params}
             train_set.construct()
+            # a Booster owns the binned data now — drop a file-loaded
+            # dataset's raw matrix (largest host allocation; kept for
+            # linear_tree and under explicit free_raw_data=False)
+            train_set._free_raw_after_train(cfg)
             objective = create_objective(cfg)
             if objective is not None:
                 n = train_set.num_data()

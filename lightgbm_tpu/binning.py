@@ -66,30 +66,66 @@ class BinMapper:
         total_sample_cnt: total rows the sample stands for; rows beyond
         len(sample) are implicit zeros (sparse ingestion)."""
         sample = np.asarray(sample, dtype=np.float64)
-        n_total = int(total_sample_cnt if total_sample_cnt is not None
-                      else len(sample))
         vals = sample[~np.isnan(sample)]
-        n_nonnan = len(vals)
-        na_cnt = 0
+        # summarize and delegate: the missing-type decision, zero-count
+        # restoration, ulp-merge, boundary finders, and most_freq_bin
+        # selection live ONLY in find_numerical_counts, so the sample
+        # and sketch paths cannot drift (the stream-vs-inmem identity
+        # guarantee, docs/INGEST.md)
+        distinct, counts = np.unique(vals, return_counts=True)
+        # normalize -0.0 -> +0.0 (the raw sort's ulp-run keeps the last,
+        # i.e. +0.0, of a -0.0/+0.0 pair; the sketch normalizes too)
+        distinct = np.where(distinct == 0.0, 0.0, distinct)
+        return BinMapper.find_numerical_counts(
+            distinct, counts.astype(np.int64), len(sample) - len(vals),
+            max_bin, min_data_in_bin, use_missing, zero_as_missing,
+            total_sample_cnt=total_sample_cnt,
+            forced_bounds=forced_bounds)
+
+    @staticmethod
+    def find_numerical_counts(distinct: np.ndarray, counts: np.ndarray,
+                              na_cnt: int, max_bin: int, min_data_in_bin: int,
+                              use_missing: bool, zero_as_missing: bool,
+                              total_sample_cnt: Optional[int] = None,
+                              forced_bounds: Optional[Sequence[float]] = None
+                              ) -> "BinMapper":
+        """find_numerical fed by a (sorted distinct values, counts, NaN
+        count) summary instead of the raw sample — the entry point for the
+        streaming ingest sketch (ingest.FeatureSketch).  When the summary
+        is exact (every value/count preserved), the result is IDENTICAL to
+        ``find_numerical`` on the equivalent sample: both funnel through
+        the same ulp-merge / zero-insertion and the same boundary finders
+        (tested in tests/test_ingest.py).
+
+        distinct: strictly increasing non-NaN values; counts: per-value
+        occurrence counts; na_cnt: NaN occurrences in the summarized
+        sample; total_sample_cnt: total rows the summary stands for (rows
+        beyond the summarized count are implicit zeros, sparse ingestion)."""
+        distinct = np.asarray(distinct, np.float64)
+        counts = np.asarray(counts, np.int64)
+        n_nonnan = int(counts.sum())
+        sample_len = n_nonnan + int(na_cnt)
+        n_total = int(total_sample_cnt if total_sample_cnt is not None
+                      else sample_len)
         if not use_missing:
             missing_type = MISSING_NONE
+            na_cnt = 0
         elif zero_as_missing:
             missing_type = MISSING_ZERO
-        elif n_nonnan == len(sample):
+            na_cnt = 0
+        elif na_cnt == 0:
             missing_type = MISSING_NONE
         else:
             missing_type = MISSING_NAN
-            na_cnt = len(sample) - n_nonnan
-        zero_cnt = n_total - n_nonnan - na_cnt
+        zero_cnt = n_total - n_nonnan - int(na_cnt)
 
-        distinct, counts = _distinct_with_zero(vals, zero_cnt)
+        distinct, counts = _distinct_counts_with_zero(distinct, counts,
+                                                      zero_cnt)
         if len(distinct) == 0:
             return BinMapper(missing_type=missing_type,
                              num_bins=2 if missing_type == MISSING_NAN else 1)
         min_val, max_val = float(distinct[0]), float(distinct[-1])
 
-        # forced bounds route to the predefined-bin finder (reference:
-        # FindBinWithZeroAsOneBin's forced_upper_bounds overload, bin.cpp:316)
         def _find(mb, tc):
             if forced_bounds:
                 return _find_bin_predefined(distinct, counts, mb, tc,
@@ -99,7 +135,7 @@ class BinMapper:
 
         if missing_type == MISSING_NAN:
             bounds = _find(max_bin - 1, n_total - na_cnt)
-            num_bins = len(bounds) + 1      # + NaN bin (last)
+            num_bins = len(bounds) + 1
         else:
             bounds = _find(max_bin, n_total)
             if missing_type == MISSING_ZERO and len(bounds) == 2:
@@ -112,7 +148,6 @@ class BinMapper:
         m.min_val, m.max_val = min_val, max_val
         if num_bins <= 1:
             return m
-        # per-bin sample counts -> default/most_freq bins (bin.cpp:401-507)
         cnt_in_bin = np.zeros(num_bins, np.int64)
         idx = np.searchsorted(m.upper_bounds, distinct, side="left")
         np.add.at(cnt_in_bin, np.minimum(idx, len(bounds) - 1), counts)
@@ -124,6 +159,52 @@ class BinMapper:
                 cnt_in_bin[most_freq] / max(n_total, 1) < 0.7:  # kSparseThreshold
             most_freq = m.default_bin
         m.most_freq_bin = most_freq
+        return m
+
+    @staticmethod
+    def find_categorical_counts(distinct: np.ndarray, counts: np.ndarray,
+                                max_bin: int, min_data_in_bin: int,
+                                use_missing: bool,
+                                dropped_cnt: int = 0) -> "BinMapper":
+        """find_categorical fed by a (sorted distinct raw values, counts)
+        summary — NaNs must already be excluded (the sketch counts them
+        separately).  Replicates the sample path exactly: values truncate
+        to int64, negatives drop with a warning, categories sort by count
+        desc with the ascending-value stable tie-break.
+
+        dropped_cnt: tail mass a compressed sketch discarded — it joins
+        the denominator of the 99%-coverage cut so compression cannot
+        inflate the kept categories' apparent coverage."""
+        distinct = np.asarray(distinct, np.float64)
+        counts = np.asarray(counts, np.int64)
+        ivals = distinct.astype(np.int64)
+        neg = ivals < 0
+        if neg.any():
+            log_warning("negative categorical values found; treated as "
+                        "missing/zero category")
+            ivals, counts = ivals[~neg], counts[~neg]
+        if ivals.size == 0:
+            return BinMapper(bin_type=BIN_CATEGORICAL)
+        # distinct floats may truncate onto the same int (the sample path
+        # unique()s AFTER truncation) — re-aggregate counts per int key
+        uniq, inv = np.unique(ivals, return_inverse=True)
+        agg = np.zeros(len(uniq), np.int64)
+        np.add.at(agg, inv, counts)
+        order = np.argsort(-agg, kind="stable")
+        uniq, agg = uniq[order], agg[order]
+        keep = min(len(uniq), max_bin)
+        cum = np.cumsum(agg)
+        total = cum[-1] + int(dropped_cnt)
+        cut = int(np.searchsorted(cum, 0.99 * total) + 1)
+        # dropped_cnt > 0 means the true cardinality exceeded the sketch
+        # budget (>> max_bin), so the coverage cut applies as it would
+        # have on the exact path
+        over = len(uniq) > max_bin or dropped_cnt > 0
+        keep = max(1, min(keep, cut)) if over else keep
+        cats = uniq[:keep]
+        m = BinMapper(bin_type=BIN_CATEGORICAL, categories=cats,
+                      num_bins=int(keep), upper_bounds=np.array([np.inf]))
+        m.missing_type = MISSING_NAN if use_missing else MISSING_NONE
         return m
 
     @staticmethod
@@ -201,32 +282,44 @@ class BinMapper:
 
 def _distinct_with_zero(vals: np.ndarray, zero_cnt: int):
     """Sorted distinct values + counts with the implicit zeros restored at
-    their sorted position (reference: BinMapper::FindBin, bin.cpp:344-380 —
-    a 0.0 entry is inserted between the last negative and first positive
-    distinct value even when zero_cnt is 0; adjacent values within one ulp
-    merge keeping the larger)."""
-    vals = np.sort(vals, kind="stable")
-    n = len(vals)
+    their sorted position (reference: BinMapper::FindBin, bin.cpp:344-380).
+    Thin wrapper: the ulp-run merge / zero-insertion rules live ONLY in
+    _distinct_counts_with_zero (shared with the streaming sketch path)."""
+    distinct, counts = np.unique(np.asarray(vals, np.float64),
+                                 return_counts=True)
+    distinct = np.where(distinct == 0.0, 0.0, distinct)
+    return _distinct_counts_with_zero(distinct, counts.astype(np.int64),
+                                      zero_cnt)
+
+
+def _distinct_counts_with_zero(distinct: np.ndarray, counts: np.ndarray,
+                               zero_cnt: int):
+    """_distinct_with_zero for inputs already summarized as (strictly
+    increasing distinct values, counts) — the ulp-run merge and the zero
+    insertion are byte-for-byte the same rules, applied to the summary
+    instead of the raw sample (sketch ingestion, docs/INGEST.md)."""
+    n = len(distinct)
     if n == 0:
         if zero_cnt > 0:
             return np.array([0.0]), np.array([zero_cnt], np.int64)
         return np.array([]), np.array([], np.int64)
-    # merge ulp-adjacent duplicates (CheckDoubleEqualOrdered): a run where
-    # each value <= nextafter(previous) collapses to its LAST value
+    # runs where each value <= nextafter(previous) collapse to their LAST
+    # value (CheckDoubleEqualOrdered) — counts sum over the run
     new_grp = np.empty(n, bool)
     new_grp[0] = True
-    new_grp[1:] = vals[1:] > np.nextafter(vals[:-1], np.inf)
-    grp = np.cumsum(new_grp) - 1
-    k = int(grp[-1]) + 1
+    new_grp[1:] = distinct[1:] > np.nextafter(distinct[:-1], np.inf)
+    starts = np.flatnonzero(new_grp)
     run_last = np.flatnonzero(np.append(new_grp[1:], True))
-    distinct = vals[run_last]                   # last (largest) of each run
-    counts = np.bincount(grp, minlength=k).astype(np.int64)
+    distinct = distinct[run_last]
+    counts = np.add.reduceat(np.asarray(counts, np.int64), starts)
+    k = len(distinct)
 
     neg = distinct < 0.0
     pos = distinct > 0.0
     has_zero_val = np.any(~neg & ~pos)
     if has_zero_val:
         zi = int(np.flatnonzero(~neg & ~pos)[0])
+        counts = counts.copy()
         counts[zi] += zero_cnt
         return distinct, counts
     insert_at = int(np.sum(neg))
@@ -654,6 +747,61 @@ def _group_layout(groups: List[List[int]], bin_mappers: List[BinMapper],
     max_group_bins = max(group_bin_counts) if group_bin_counts else 1
     dtype = np.uint8 if max_group_bins <= 256 else np.uint16
     return group_bin_counts, group_offsets, feature_offsets, feature_num_bins, dtype
+
+
+def binned_layout(bin_mappers: List[BinMapper],
+                  groups: Optional[List[List[int]]] = None):
+    """Full static bin layout WITHOUT touching data: device-ordered groups
+    plus (group_bin_counts, group_offsets, feature_offsets,
+    feature_num_bins, dtype), with feature_offsets assigned exactly as the
+    construct paths assign them during binning — the streaming pass-2
+    bin-and-ship (ingest.py) preallocates its output from this and fills
+    rows chunk by chunk."""
+    num_features = len(bin_mappers)
+    if groups is None:
+        groups = [[f] for f in range(num_features)]
+    groups = device_group_order(groups, bin_mappers)
+    (group_bin_counts, group_offsets, feature_offsets, feature_num_bins,
+     dtype) = _group_layout(groups, bin_mappers, num_features)
+    for gi, g in enumerate(groups):
+        if len(g) == 1:
+            feature_offsets[g[0]] = group_offsets[gi]
+        else:
+            in_group = 1
+            for f in g:
+                feature_offsets[f] = group_offsets[gi] + in_group - 1
+                in_group += int(bin_mappers[f].num_bins) - 1
+    return (groups, group_bin_counts, group_offsets, feature_offsets,
+            feature_num_bins, dtype)
+
+
+def bin_rows_into(chunk: np.ndarray, bin_mappers: List[BinMapper],
+                  groups: List[List[int]], out: np.ndarray,
+                  row0: int) -> None:
+    """Bin a (n, F) float chunk into ``out[row0:row0+n, :]`` — the
+    per-chunk fill of the streaming two-pass loader and the Sequence
+    batch loop.  ``groups`` must already be device-ordered and ``out``
+    allocated from binned_layout's dtype; output rows are byte-identical
+    to construct_binned on the same rows (tested).  Reuses the caller's
+    buffer: no per-chunk output allocation."""
+    n = chunk.shape[0]
+    dtype = out.dtype
+    for gi, g in enumerate(groups):
+        if len(g) == 1:
+            f = g[0]
+            out[row0:row0 + n, gi] = \
+                bin_mappers[f].transform(chunk[:, f]).astype(dtype)
+        else:
+            in_group = 1
+            col = np.zeros(n, dtype=np.int64)
+            for f in g:
+                m = bin_mappers[f]
+                b = m.transform(chunk[:, f]).astype(np.int64)
+                nondef = b != m.default_bin
+                local = np.where(b > m.default_bin, b - 1, b)
+                col = np.where(nondef, in_group + local, col)
+                in_group += m.num_bins - 1
+            out[row0:row0 + n, gi] = col.astype(dtype)
 
 
 def construct_binned(data: np.ndarray, bin_mappers: List[BinMapper],

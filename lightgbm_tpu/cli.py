@@ -190,6 +190,10 @@ def run_train(params: Dict[str, Any]) -> None:
     out_model = str(params.get("output_model", "LightGBM_model.txt"))
     bst.save_model(out_model)
     log_info(f"Finished training; model saved to {out_model}")
+    stats = getattr(ds, "ingest_stats", None) or {}
+    log_info("ingest summary: mode=%s cache_hit=%s"
+             % (stats.get("mode", "inmem"),
+                stats.get("cache_hit", False)))
     from . import telemetry as _telemetry
     if _telemetry.enabled():
         import json

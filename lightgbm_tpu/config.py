@@ -113,6 +113,11 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "feature_pre_filter": [],
     "pre_partition": ["is_pre_partition"],
     "two_round": ["two_round_loading", "use_two_round_loading"],
+    "ingest_mode": ["ingest"],
+    "ingest_chunk_rows": ["ingest_batch_rows"],
+    "ingest_cache": ["binned_cache"],
+    "ingest_cache_path": ["binned_cache_path"],
+    "ingest_sketch_size": ["sketch_size"],
     "header": ["has_header"],
     "label_column": ["label"],
     "weight_column": ["weight"],
@@ -403,6 +408,27 @@ class Config:
     feature_pre_filter: bool = True
     pre_partition: bool = False
     two_round: bool = False
+    # streaming two-pass ingest (docs/INGEST.md): inmem materializes the
+    # raw matrix before binning; stream reads O(ingest_chunk_rows) rows
+    # at a time through a mergeable per-feature quantile sketch (pass 1)
+    # and a chunked bin fill (pass 2); auto = stream for CSV/TSV files
+    # >= 512 MB or whenever the binned cache is enabled
+    ingest_mode: str = "auto"
+    # rows per streamed chunk — the peak transient host allocation of
+    # both ingest passes
+    ingest_chunk_rows: int = 262144
+    # memory-mapped binned cache: off | auto (open a valid cache, else
+    # rebuild and write one) | read (require a valid cache) | rebuild
+    # (ignore and rewrite); corrupt caches fall back to raw parsing
+    # under auto and raise under read
+    ingest_cache: str = "off"
+    # cache file location; defaults to <data-file>.lgbcache
+    ingest_cache_path: str = ""
+    # per-feature sketch budget (distinct values tracked exactly):
+    # boundaries are IDENTICAL to the in-memory loader while every
+    # feature's sampled cardinality stays within it, and deterministic
+    # approximate quantiles past it
+    ingest_sketch_size: int = 16384
     header: bool = False
     label_column: str = ""
     weight_column: str = ""
@@ -726,6 +752,24 @@ class Config:
             raise LightGBMError(
                 f"eval_fetch_freq={self.eval_fetch_freq} must be >= 0 "
                 "(0 = auto)")
+        if str(self.ingest_mode).strip().lower() not in (
+                "auto", "stream", "inmem"):
+            raise LightGBMError(
+                f"ingest_mode={self.ingest_mode!r} is not one of "
+                "'auto', 'stream', 'inmem'")
+        if str(self.ingest_cache).strip().lower() not in (
+                "", "off", "auto", "read", "rebuild"):
+            raise LightGBMError(
+                f"ingest_cache={self.ingest_cache!r} is not one of "
+                "'off', 'auto', 'read', 'rebuild'")
+        if self.ingest_chunk_rows < 256:
+            raise LightGBMError(
+                f"ingest_chunk_rows={self.ingest_chunk_rows} must be "
+                ">= 256")
+        if self.ingest_sketch_size < 256:
+            raise LightGBMError(
+                f"ingest_sketch_size={self.ingest_sketch_size} must be "
+                ">= 256")
         if self.hist_comms_pipeline < 0:
             raise LightGBMError(
                 f"hist_comms_pipeline={self.hist_comms_pipeline} must be "

@@ -91,18 +91,11 @@ def load_data_file(path: str, params: Dict[str, Any],
     global first row (reference: dataset_loader.cpp:211 rank sharding)."""
     if not os.path.exists(path):
         raise LightGBMError(f"data file {path} not found")
-    with open(path) as f:
-        head = [f.readline() for _ in range(3)]
-    fmt = _detect_format(head)
+    fmt = detect_file_format(path)
     if rank is not None and num_machines is not None and num_machines > 1:
         return _load_data_file_shard(path, params, fmt, rank, num_machines)
     has_header = bool(params.get("header", False))
-    label_col = 0
-    lc = str(params.get("label_column", ""))
-    if lc.startswith("column="):
-        label_col = int(lc.split("=")[1])
-    elif lc.isdigit():
-        label_col = int(lc)
+    label_col = _label_col_of(params)
 
     extras: Dict[str, Any] = {}
     w = load_weight_file(path)
@@ -185,12 +178,7 @@ def _load_data_file_shard(path: str, params: Dict[str, Any], fmt: str,
         with open(path, "rb") as f:
             f.seek(start)
             blob = f.read(end - start)
-    label_col = 0
-    lc = str(params.get("label_column", ""))
-    if lc.startswith("column="):
-        label_col = int(lc.split("=")[1])
-    elif lc.isdigit():
-        label_col = int(lc)
+    label_col = _label_col_of(params)
 
     if fmt == "libsvm":
         import io
@@ -272,6 +260,93 @@ def _parse_libsvm_lines(f):
     return out, np.asarray(labels, np.float64), q
 
 
+# ---------------------------------------------------------------------------
+# Chunked text reading — the streaming two-pass loader's file source
+# (reference: TextReader ReadPartAndParallelProcess chunked line blocks,
+# dataset_loader.cpp:211; docs/INGEST.md)
+# ---------------------------------------------------------------------------
+
+def _label_col_of(params: Dict[str, Any]) -> int:
+    lc = str(params.get("label_column", ""))
+    if lc.startswith("column="):
+        return int(lc.split("=")[1])
+    if lc.isdigit():
+        return int(lc)
+    return 0
+
+
+def _parse_text_chunk(lines, delim: str, label_col: int):
+    blob = b"\n".join(lines) + b"\n"
+    from .native import parse_csv_bytes
+    data = parse_csv_bytes(blob, delim=delim)
+    if data is None:
+        rows = [ln for ln in blob.decode().splitlines() if ln.strip()]
+        data = np.asarray([[_fast_float(t) for t in ln.split(delim)]
+                           for ln in rows], np.float64)
+    if data.ndim == 1:
+        data = data.reshape(-1, 1)
+    label = data[:, label_col].copy()
+    feats = np.delete(data, label_col, axis=1)
+    return feats, label
+
+
+def detect_file_format(path: str) -> str:
+    """csv | tsv | libsvm (the eager loader's auto-detection)."""
+    with open(path) as f:
+        head = [f.readline() for _ in range(3)]
+    return _detect_format(head)
+
+
+def iter_file_chunks(path: str, params: Dict[str, Any], chunk_rows: int,
+                     byte_start: Optional[int] = None,
+                     byte_end: Optional[int] = None):
+    """Yield ``(features, label)`` float64 chunks of at most ``chunk_rows``
+    data lines each from a CSV/TSV file — the repeatable chunk source both
+    passes of the streaming loader iterate (docs/INGEST.md).  Peak memory
+    is O(chunk); blank and '#'-comment lines are skipped exactly like the
+    eager parsers, and the chunk boundaries are a pure function of
+    ``chunk_rows`` (pass 1 and pass 2 see identical chunks).
+
+    byte_start/byte_end: a rank's shard range from shard_byte_range —
+    cuts land on line boundaries, so every line belongs to one rank."""
+    fmt = detect_file_format(path)
+    if fmt == "libsvm":
+        raise LightGBMError(
+            "streaming ingest reads CSV/TSV files; LibSVM files use the "
+            "in-memory loader (ingest_mode=inmem)")
+    delim = "," if fmt == "csv" else "\t"
+    label_col = _label_col_of(params)
+    has_header = bool(params.get("header", False))
+    chunk_rows = max(int(chunk_rows), 1)
+    with open(path, "rb") as f:
+        if byte_start is not None:
+            f.seek(byte_start)
+        elif has_header:
+            f.readline()
+        lines: list = []
+        tail = b""
+        while True:
+            to_read = 1 << 22
+            if byte_end is not None:
+                to_read = min(to_read, byte_end - f.tell())
+            blob = f.read(to_read) if to_read > 0 else b""
+            if not blob:
+                break
+            parts = (tail + blob).split(b"\n")
+            tail = parts.pop()
+            for ln in parts:
+                if ln.strip() and not ln.lstrip().startswith(b"#"):
+                    lines.append(ln)
+            while len(lines) >= chunk_rows:
+                yield _parse_text_chunk(lines[:chunk_rows], delim, label_col)
+                del lines[:chunk_rows]
+        if tail.strip() and not tail.lstrip().startswith(b"#"):
+            lines.append(tail)
+        while lines:
+            yield _parse_text_chunk(lines[:chunk_rows], delim, label_col)
+            del lines[:chunk_rows]
+
+
 def load_query_file(path: str) -> Optional[np.ndarray]:
     """Load .query file (group sizes, one per line) if present."""
     qpath = path + ".query"
@@ -314,3 +389,253 @@ def load_position_file(path: str) -> Optional[np.ndarray]:
         rank_of_unique = np.argsort(np.argsort(first_idx))
         return rank_of_unique[inv].astype(np.int32)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Memory-mapped binned cache (reference: Dataset::SaveBinaryFile /
+# LoadFromBinFile, generalized for out-of-core opens): a re-run skips raw
+# parsing entirely, and a cache LARGER than host RAM opens as an
+# np.memmap whose pages the OS faults in on demand (docs/INGEST.md).
+#
+# Layout (little-endian):
+#   [0:16)   magic  b"LGBTPU.CACHE.v1\n"  (version token inside the magic)
+#   [16:24)  u64 meta_offset   — start of the trailing JSON meta block
+#   [24:32)  u64 bins_offset   — start of the row-major bins block (= 32)
+#   [32:..)  bins block: num_data * num_groups * itemsize bytes
+#   ...      per-row metadata arrays (label/weight/...), raw bytes
+#   [meta_offset:EOF)  JSON meta: params_hash, layout, mappers, per-column
+#                      sha256 digests, array directory
+# ---------------------------------------------------------------------------
+
+CACHE_MAGIC = b"LGBTPU.CACHE.v1\n"
+_CACHE_MAGIC_PREFIX = b"LGBTPU.CACHE."
+_CACHE_BINS_OFFSET = 32
+
+
+def _cache_err(path: str, field: str, detail: str) -> "LightGBMError":
+    """Structured cache-corruption error naming the offending field
+    (mirrors model_io.load_model_string's truncation checks)."""
+    return LightGBMError(
+        f"corrupt binned cache {path}: {field}: {detail}")
+
+
+class BinnedCacheWriter:
+    """Streaming cache writer: rows append chunk by chunk, per-column
+    sha256 digests update incrementally, and the whole file rides
+    robustness.checkpoint.atomic_open — a killed writer never leaves a
+    partial cache behind (LGB005)."""
+
+    def __init__(self, path: str, *, params_hash: str, num_feature: int,
+                 feature_names, group_features, group_offsets,
+                 group_bin_counts, feature_offsets, feature_num_bins,
+                 mappers, dtype, source: Optional[Dict[str, Any]] = None):
+        import hashlib
+        from .robustness.checkpoint import atomic_open
+        self.path = str(path)
+        self._dtype = np.dtype(dtype)
+        self._g = len(group_features)
+        self._rows = 0
+        self._hashers = [hashlib.sha256() for _ in range(self._g)]
+        self._arrays: Dict[str, Dict[str, Any]] = {}
+        self._meta = {
+            "format_version": 1,
+            "params_hash": str(params_hash),
+            "num_feature": int(num_feature),
+            "feature_names": list(feature_names),
+            "group_features": [list(map(int, g)) for g in group_features],
+            "group_offsets": [int(v) for v in group_offsets],
+            "group_bin_counts": [int(v) for v in group_bin_counts],
+            "feature_offsets": [int(v) for v in feature_offsets],
+            "feature_num_bins": [int(v) for v in feature_num_bins],
+            "bins_dtype": self._dtype.str,
+            "mappers": [[int(m.bin_type), int(m.missing_type),
+                         int(m.num_bins), int(m.default_bin),
+                         int(m.most_freq_bin), float(m.min_val),
+                         float(m.max_val),
+                         [float(v) for v in np.asarray(m.upper_bounds)],
+                         [int(v) for v in np.asarray(m.categories)]]
+                        for m in mappers],
+            "source": dict(source or {}),
+        }
+        self._cm = atomic_open(self.path, "wb")
+        self._f = self._cm.__enter__()
+        self._f.write(CACHE_MAGIC)
+        import struct
+        self._f.write(struct.pack("<QQ", 0, _CACHE_BINS_OFFSET))
+
+    def append_rows(self, chunk: np.ndarray) -> None:
+        chunk = np.ascontiguousarray(chunk, dtype=self._dtype)
+        assert chunk.ndim == 2 and chunk.shape[1] == self._g
+        self._f.write(chunk.tobytes())
+        for g in range(self._g):
+            self._hashers[g].update(np.ascontiguousarray(
+                chunk[:, g]).tobytes())
+        self._rows += chunk.shape[0]
+
+    def add_array(self, name: str, arr: np.ndarray) -> None:
+        """Per-row metadata array (label/weight/...) appended after the
+        bins block so a cache hit restores it without the raw file."""
+        arr = np.ascontiguousarray(arr)
+        self._arrays[name] = {"offset": self._f.tell(),
+                              "dtype": arr.dtype.str,
+                              "shape": [int(s) for s in arr.shape]}
+        self._f.write(arr.tobytes())
+
+    def finalize(self) -> str:
+        import json
+        import struct
+        meta = dict(self._meta)
+        meta["num_data"] = int(self._rows)
+        meta["col_sha256"] = [h.hexdigest() for h in self._hashers]
+        meta["arrays"] = self._arrays
+        meta_off = self._f.tell()
+        self._f.write(json.dumps(meta).encode())
+        self._f.seek(16)
+        self._f.write(struct.pack("<Q", meta_off))
+        self._f.seek(0, os.SEEK_END)
+        self._cm.__exit__(None, None, None)
+        return self.path
+
+    def abort(self) -> None:
+        try:
+            self._cm.__exit__(RuntimeError, RuntimeError("aborted"), None)
+        except Exception:
+            pass
+
+
+def read_cache_meta(path: str) -> Dict[str, Any]:
+    """Parse + structurally validate a cache file's header and meta block;
+    raises a structured LightGBMError naming the field on any truncation,
+    garbage, or version mismatch (docs/INGEST.md corruption matrix)."""
+    import json
+    import struct
+    try:
+        size = os.path.getsize(path)
+    except OSError as exc:
+        raise LightGBMError(f"binned cache {path} not readable: {exc}")
+    with open(path, "rb") as f:
+        head = f.read(_CACHE_BINS_OFFSET)
+        if len(head) < _CACHE_BINS_OFFSET or \
+                not head.startswith(_CACHE_MAGIC_PREFIX):
+            raise _cache_err(path, "magic",
+                            "not a binned cache file (bad magic)")
+        if head[:16] != CACHE_MAGIC:
+            ver = head[len(_CACHE_MAGIC_PREFIX):16].rstrip(b"\n")
+            ours = CACHE_MAGIC[len(_CACHE_MAGIC_PREFIX):].rstrip(b"\n")
+            raise _cache_err(
+                path, "format_version",
+                f"unsupported cache version {ver!r} (this release reads "
+                f"{ours!r}); rebuild with ingest_cache=rebuild")
+        meta_off, bins_off = struct.unpack("<QQ", head[16:32])
+        if meta_off == 0 or meta_off > size:
+            raise _cache_err(path, "meta_offset",
+                            f"offset {meta_off} out of bounds for "
+                            f"{size}-byte file (truncated write)")
+        if bins_off != _CACHE_BINS_OFFSET:
+            raise _cache_err(path, "bins_offset",
+                            f"expected {_CACHE_BINS_OFFSET}, got {bins_off}")
+        f.seek(meta_off)
+        blob = f.read(size - meta_off)
+    try:
+        meta = json.loads(blob.decode())
+    except Exception as exc:
+        raise _cache_err(path, "meta", f"JSON block unreadable ({exc})")
+    for field in ("format_version", "params_hash", "num_data", "num_feature",
+                  "bins_dtype", "group_features", "group_offsets",
+                  "group_bin_counts", "feature_offsets", "feature_num_bins",
+                  "mappers", "col_sha256", "arrays", "feature_names"):
+        if field not in meta:
+            raise _cache_err(path, field, "missing from meta block")
+    if int(meta["format_version"]) != 1:
+        raise _cache_err(path, "format_version",
+                        f"unsupported version {meta['format_version']}")
+    n = int(meta["num_data"])
+    g = len(meta["group_features"])
+    itemsize = np.dtype(meta["bins_dtype"]).itemsize
+    if bins_off + n * g * itemsize > meta_off:
+        raise _cache_err(path, "bins",
+                        f"bins block needs {n * g * itemsize} bytes but "
+                        f"only {meta_off - bins_off} precede the meta "
+                        "block (truncated)")
+    if len(meta["col_sha256"]) != g:
+        raise _cache_err(path, "col_sha256",
+                        f"{len(meta['col_sha256'])} digests for {g} "
+                        "group columns")
+    for name, spec in meta["arrays"].items():
+        end = spec["offset"] + int(np.prod(spec["shape"] or [1])) * \
+            np.dtype(spec["dtype"]).itemsize
+        if end > meta_off:
+            raise _cache_err(path, f"arrays.{name}",
+                            "extends past the meta block (truncated)")
+    meta["_meta_offset"] = meta_off
+    return meta
+
+
+def open_binned_cache(path: str, params_hash: Optional[str] = None,
+                      verify: bool = True):
+    """Open a binned cache: returns ``(BinnedData, extras, meta)`` with
+    the bins block as a read-only np.memmap — a cache larger than host
+    RAM opens in O(1) memory and pages stream in on demand.
+
+    params_hash: when given, a mismatch raises (the cache was built under
+    different binning parameters or from different data).
+    verify: re-hash every group column against the stored sha256 digests
+    (one sequential read of the bins block)."""
+    import hashlib
+    from .binning import BinMapper, BinnedData
+    meta = read_cache_meta(path)
+    if params_hash is not None and meta["params_hash"] != params_hash:
+        raise _cache_err(
+            path, "params_hash",
+            f"cache built under {meta['params_hash'][:12]}..., current "
+            f"parameters/data hash to {params_hash[:12]}... — rebuild "
+            "the cache (ingest_cache=rebuild) or pass matching parameters")
+    n, g = int(meta["num_data"]), len(meta["group_features"])
+    dtype = np.dtype(meta["bins_dtype"])
+    bins = np.memmap(path, dtype=dtype, mode="r",
+                     offset=_CACHE_BINS_OFFSET, shape=(n, g))
+    if verify:
+        block = max(1, (64 << 20) // max(1, g * dtype.itemsize))
+        hashers = [hashlib.sha256() for _ in range(g)]
+        for s in range(0, n, block):
+            part = np.asarray(bins[s:s + block])
+            for gi in range(g):
+                hashers[gi].update(np.ascontiguousarray(
+                    part[:, gi]).tobytes())
+        for gi in range(g):
+            if hashers[gi].hexdigest() != meta["col_sha256"][gi]:
+                raise _cache_err(
+                    path, f"col_sha256[{gi}]",
+                    "group column bytes do not match the stored digest "
+                    "(bit rot or a torn write)")
+    mappers = []
+    for ms in meta["mappers"]:
+        bt, mt, nb, db, mfb, mn, mx, ub, cats = ms
+        mappers.append(BinMapper(
+            upper_bounds=np.asarray(ub, np.float64),
+            bin_type=int(bt), missing_type=int(mt),
+            categories=np.asarray(cats, np.int64),
+            num_bins=int(nb), default_bin=int(db), most_freq_bin=int(mfb),
+            min_val=float(mn), max_val=float(mx)))
+    binned = BinnedData(
+        bins=bins,
+        group_features=[list(map(int, grp))
+                        for grp in meta["group_features"]],
+        group_offsets=np.asarray(meta["group_offsets"], np.int32),
+        group_bin_counts=np.asarray(meta["group_bin_counts"], np.int32),
+        feature_offsets=np.asarray(meta["feature_offsets"], np.int32),
+        feature_num_bins=np.asarray(meta["feature_num_bins"], np.int32),
+        bin_mappers=mappers,
+        num_data=n, num_features=int(meta["num_feature"]))
+    extras: Dict[str, Any] = {}
+    with open(path, "rb") as f:
+        for name, spec in meta["arrays"].items():
+            f.seek(spec["offset"])
+            dt = np.dtype(spec["dtype"])
+            count = int(np.prod(spec["shape"] or [1]))
+            buf = f.read(count * dt.itemsize)
+            if len(buf) != count * dt.itemsize:
+                raise _cache_err(path, f"arrays.{name}", "short read")
+            extras[name] = np.frombuffer(buf, dtype=dt).reshape(
+                spec["shape"]).copy()
+    return binned, extras, meta

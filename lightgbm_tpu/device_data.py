@@ -113,15 +113,69 @@ def build_layouts(binned: BinnedData, pad_rows_to: int = 256):
     return layout, routing, Bmax
 
 
+def _ship_supported() -> bool:
+    """Chunked device ship pays off only where buffer donation lets the
+    update run in place (TPU/GPU); XLA:CPU copies the whole buffer per
+    chunk.  LGBTPU_INGEST_SHIP=1 forces it (tests, perf sentinel)."""
+    import os
+    env = os.environ.get("LGBTPU_INGEST_SHIP", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() not in ("cpu",)
+
+
+_ship_jit = None
+
+
+def ship_binned_chunks(bins: np.ndarray, n_pad: int,
+                       chunk_rows: int) -> jax.Array:
+    """Bin-and-ship: place host row blocks into a device-resident
+    (n_pad, G) buffer one chunk at a time through a single compiled
+    dynamic_update_slice program (watched_jit name ``ingest_ship``,
+    donated buffer) — the host never stages a padded full-size copy.
+    Chunks are padded to one fixed shape so the program compiles once."""
+    global _ship_jit
+    from .telemetry import watched_jit
+    if _ship_jit is None:
+        def _ship(buf, chunk, start):
+            return jax.lax.dynamic_update_slice(
+                buf, chunk, (start, jnp.int32(0)))
+        _ship_jit = watched_jit(_ship, name="ingest_ship",
+                                donate_argnums=(0,))
+    n, g = bins.shape
+    R = max(256, -(-int(chunk_rows) // 256) * 256)
+    n_ship = -(-n_pad // R) * R
+    buf = jnp.zeros((n_ship, g), bins.dtype)
+    staged = np.zeros((R, g), bins.dtype)
+    for s in range(0, n, R):
+        m = min(R, n - s)
+        staged[:m] = bins[s:s + m]
+        if m < R:
+            staged[m:] = 0
+        buf = _ship_jit(buf, jnp.asarray(staged), jnp.int32(s))
+    return buf[:n_pad] if n_ship != n_pad else buf
+
+
 def to_device(binned: BinnedData, pad_rows_to: int = 256,
-              sharding=None) -> DeviceData:
+              sharding=None, ship_chunk_rows=None) -> DeviceData:
     layout, routing, Bmax = build_layouts(binned)
-    bins = np.ascontiguousarray(binned.bins)
+    bins = binned.bins
     n = bins.shape[0]
     n_pad = -(-n // pad_rows_to) * pad_rows_to
-    if n_pad != n:
-        bins = np.pad(bins, ((0, n_pad - n), (0, 0)))
-    arr = jnp.asarray(bins)
+    if ship_chunk_rows and _ship_supported():
+        arr = ship_binned_chunks(bins, n_pad, int(ship_chunk_rows))
+    elif isinstance(bins, np.memmap):
+        # out-of-core bins: transfer straight from the mapping (pages
+        # stream in, file-backed and reclaimable) and pad ON DEVICE —
+        # never materialize a padded full-size host copy
+        arr = jnp.asarray(bins)
+        if n_pad != n:
+            arr = jnp.pad(arr, ((0, n_pad - n), (0, 0)))
+    else:
+        bins = np.ascontiguousarray(bins)
+        if n_pad != n:
+            bins = np.pad(bins, ((0, n_pad - n), (0, 0)))
+        arr = jnp.asarray(bins)
     if sharding is not None:
         arr = jax.device_put(arr, sharding)
     return DeviceData(bins=arr, layout=layout, routing=routing,

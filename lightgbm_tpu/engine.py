@@ -71,6 +71,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                  f"{start_iteration}/{num_boost_round}")
 
     booster = Booster(params=params, train_set=train_set)
+    ingest_stats = getattr(train_set, "ingest_stats", None)
+    if ingest_stats:
+        # one-line ingest provenance next to the training log: which
+        # loader built the binned data and whether the cache served it
+        log_info(
+            "ingest: mode=%s cache_hit=%s rows=%s rows/s=%s "
+            "peak_rss_gb=%.2f" % (
+                ingest_stats.get("mode"), ingest_stats.get("cache_hit"),
+                ingest_stats.get("rows"), ingest_stats.get("rows_per_s"),
+                ingest_stats.get("peak_rss_bytes", 0) / 1e9))
     if init_model is not None:
         # true continued training: load the trees into the engine and keep
         # boosting (reference: boosting.cpp:42-90, gbdt.cpp:259-263); trees are

@@ -1,0 +1,979 @@
+"""Out-of-core streaming ingest: two-pass sketch-based binning.
+
+Reference: DatasetLoader::LoadFromFile's two-pass loader — per-rank
+sampling, bin boundaries synchronized over the network, then a chunked
+bin fill (dataset_loader.cpp:211, 733-741, 1240-1248).  TPU re-design
+(docs/INGEST.md):
+
+* **Pass 1** streams chunks from the source (CSV/TSV file, ndarray,
+  pyarrow Table, Sequence) through a mergeable per-feature quantile
+  sketch (`FeatureSketch`): exact distinct-value/count summaries up to a
+  budget, deterministic adjacent-collapse compression past it, with NaN
+  and zero counting so `BinMapper.find_numerical`'s min_data_in_bin /
+  zero-bin semantics are preserved.  While the sketch is exact, the
+  resulting boundaries are IDENTICAL to the in-memory loader's — and
+  invariant to the chunk size and to how rows are split across ranks.
+  An EFB row pool (bottom-k hash sample, also chunk/rank-invariant)
+  rides along only when bundling is enabled, and is dropped the moment
+  feature groups are computed.
+
+* Under a multi-process mesh the per-rank sketches (and the EFB pool)
+  are merged with ONE host collective (`dist_data.allgather_np` of a
+  fixed-width blob) so every rank computes identical boundaries —
+  the mapper-sync analog of the reference's Allgather.
+
+* **Pass 2** re-streams the source and bins each chunk into a
+  preallocated buffer (`binning.bin_rows_into`), never holding more
+  than ``ingest_chunk_rows`` binned rows of transient state, writing
+  either the in-RAM bins matrix or the memory-mapped binned cache
+  (`dataset_io.BinnedCacheWriter`) that later runs open in O(1) memory.
+
+Peak host memory is O(chunk) + O(sample pool) + the binned output
+(memmap-backed when the cache is on) — the raw float64 matrix is never
+materialized.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .binning import (BIN_CATEGORICAL, BinMapper, BinnedData, bin_rows_into,
+                      binned_layout, find_feature_groups, load_forced_bins)
+from .utils.log import LightGBMError, log_info, log_warning
+
+_WIRE_HEAD = 6          # [exact, is_cat, na_cnt, total, dropped, n_entries]
+_AUTO_STREAM_BYTES = int(os.environ.get("LGBTPU_INGEST_AUTO_BYTES",
+                                        512 << 20))
+
+
+def _rss_bytes() -> int:
+    try:
+        import resource
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Per-feature quantile sketch
+# ---------------------------------------------------------------------------
+
+class FeatureSketch:
+    """Mergeable per-feature distribution summary.
+
+    Exact mode keeps every (distinct value, count) pair plus NaN/total
+    counters — boundaries derived from it equal ``find_numerical`` on the
+    full stream bit-for-bit, and updates/merges commute (chunk- and
+    rank-order invariant).  Past ``budget`` distinct values the summary
+    compresses: numerical features collapse weight-balanced runs of
+    adjacent values (keeping each region's extremes and never merging
+    across the zero window), categorical features drop lowest-count
+    tail categories; both deterministic, neither exact.
+    """
+
+    __slots__ = ("budget", "is_cat", "values", "counts", "na_cnt", "total",
+                 "dropped", "exact")
+
+    def __init__(self, budget: int = 16384, is_cat: bool = False):
+        self.budget = max(int(budget), 64)
+        self.is_cat = bool(is_cat)
+        self.values = np.empty(0, np.float64)
+        self.counts = np.empty(0, np.int64)
+        self.na_cnt = 0
+        self.total = 0
+        self.dropped = 0        # tail counts lost to categorical compression
+        self.exact = True
+
+    # -- accumulation ---------------------------------------------------
+    def update(self, col: np.ndarray) -> None:
+        col = np.asarray(col, np.float64).reshape(-1)
+        nan = np.isnan(col)
+        n_na = int(nan.sum())
+        self.na_cnt += n_na
+        self.total += len(col)
+        if n_na:
+            col = col[~nan]
+        if len(col) == 0:
+            return
+        uv, uc = np.unique(col, return_counts=True)
+        # normalize -0.0 -> +0.0 so merges never depend on sign-of-zero
+        uv = np.where(uv == 0.0, 0.0, uv)
+        self._combine(uv, uc.astype(np.int64))
+
+    def merge(self, other: "FeatureSketch") -> None:
+        self.na_cnt += other.na_cnt
+        self.total += other.total
+        self.dropped += other.dropped
+        self.exact = self.exact and other.exact
+        if len(other.values):
+            self._combine(other.values, other.counts)
+
+    def _combine(self, v2: np.ndarray, c2: np.ndarray) -> None:
+        if len(self.values) == 0:
+            self.values, self.counts = v2, c2
+        else:
+            allv = np.concatenate([self.values, v2])
+            allc = np.concatenate([self.counts, c2])
+            order = np.argsort(allv, kind="stable")
+            v, c = allv[order], allc[order]
+            keep = np.empty(len(v), bool)
+            keep[0] = True
+            keep[1:] = v[1:] != v[:-1]
+            starts = np.flatnonzero(keep)
+            self.values = v[keep]
+            self.counts = np.add.reduceat(c, starts)
+        if len(self.values) > self.budget:
+            self._compress()
+
+    # -- compression ----------------------------------------------------
+    def _compress(self) -> None:
+        self.exact = False
+        if self.is_cat:
+            # keep the highest-count categories (ascending-value ties),
+            # matching find_categorical's ranking; the dropped tail is
+            # accounted so the 99%-coverage cut stays meaningful
+            target = self.budget // 2
+            order = np.lexsort((self.values, -self.counts))[:target]
+            keep = np.sort(order)
+            self.dropped += int(self.counts.sum()
+                                - self.counts[keep].sum())
+            self.values = self.values[keep]
+            self.counts = self.counts[keep]
+            return
+        target = max(self.budget // 2, 8)
+        v, c = self.values, self.counts
+        from .binning import _ZERO_UB
+        neg = v < -_ZERO_UB
+        zero = (~neg) & (v <= _ZERO_UB)
+        pos = v > _ZERO_UB
+        out_v: List[np.ndarray] = []
+        out_c: List[np.ndarray] = []
+        total = int(c.sum())
+        for region in (neg, zero, pos):
+            rv, rc = v[region], c[region]
+            if len(rv) == 0:
+                continue
+            share = max(8, int(round(target * rc.sum() / max(total, 1))))
+            if len(rv) <= share:
+                # the zero window usually holds at most a couple of
+                # points and stays exact here; a pathological column of
+                # > share distinct near-zero values collapses like any
+                # other region (they all share the zero bin anyway), so
+                # the summary stays O(budget)
+                out_v.append(rv)
+                out_c.append(rc)
+                continue
+            # weight-balanced adjacent collapse: close a run when its
+            # accumulated count reaches the mean run weight.  A run is
+            # represented by its weighted-MEDIAN element — an unbiased
+            # choice, so repeated recompression over a long stream does
+            # not walk the summary sideways (a keep-the-last rule would
+            # drift upward a little on every compress).
+            w = rc.sum() / share
+            cum = np.cumsum(rc)
+            bucket = np.minimum((cum - 1) // max(w, 1), share - 1).astype(
+                np.int64)
+            last_of_run = np.empty(len(rv), bool)
+            last_of_run[-1] = True
+            last_of_run[:-1] = bucket[1:] != bucket[:-1]
+            # the region's minimum stays its own point (it feeds min_val
+            # and GreedyFindBin's lowers[0])
+            last_of_run[0] = True
+            starts = np.flatnonzero(np.concatenate(
+                [[True], last_of_run[:-1]]))
+            lasts = np.flatnonzero(last_of_run)
+            run_start_cum = cum[starts] - rc[starts]
+            half = run_start_cum + (cum[lasts] - run_start_cum) / 2.0
+            med = np.searchsorted(cum, half, side="left")
+            med = np.clip(med, starts, lasts)
+            med[-1] = len(rv) - 1          # the maximum stays exact too
+            out_v.append(rv[med])
+            out_c.append(np.add.reduceat(rc, starts))
+        self.values = np.concatenate(out_v) if out_v else np.empty(0)
+        self.counts = (np.concatenate(out_c).astype(np.int64)
+                       if out_c else np.empty(0, np.int64))
+
+    # -- wire -----------------------------------------------------------
+    @staticmethod
+    def wire_width(budget: int) -> int:
+        return _WIRE_HEAD + 2 * max(int(budget), 64)
+
+    def serialize(self, width: Optional[int] = None) -> np.ndarray:
+        """Fixed-width float64 row (counts are exact below 2^53)."""
+        cap = (width - _WIRE_HEAD) // 2 if width else self.budget
+        row = np.zeros(_WIRE_HEAD + 2 * cap, np.float64)
+        n = len(self.values)
+        assert n <= cap, f"sketch has {n} entries > wire cap {cap}"
+        row[0] = 1.0 if self.exact else 0.0
+        row[1] = 1.0 if self.is_cat else 0.0
+        row[2] = float(self.na_cnt)
+        row[3] = float(self.total)
+        row[4] = float(self.dropped)
+        row[5] = float(n)
+        row[_WIRE_HEAD:_WIRE_HEAD + n] = self.values
+        row[_WIRE_HEAD + cap:_WIRE_HEAD + cap + n] = \
+            self.counts.astype(np.float64)
+        return row
+
+    @classmethod
+    def deserialize(cls, row: np.ndarray, budget: int) -> "FeatureSketch":
+        cap = (len(row) - _WIRE_HEAD) // 2
+        sk = cls(budget=budget, is_cat=bool(row[1]))
+        sk.exact = bool(row[0])
+        sk.na_cnt = int(row[2])
+        sk.total = int(row[3])
+        sk.dropped = int(row[4])
+        n = int(row[5])
+        sk.values = np.asarray(row[_WIRE_HEAD:_WIRE_HEAD + n], np.float64)
+        sk.counts = np.asarray(row[_WIRE_HEAD + cap:_WIRE_HEAD + cap + n],
+                               np.float64).astype(np.int64)
+        return sk
+
+    # -- boundary extraction --------------------------------------------
+    def find_mapper(self, max_bin: int, min_data_in_bin: int,
+                    use_missing: bool, zero_as_missing: bool,
+                    forced_bounds=None) -> BinMapper:
+        if self.is_cat:
+            return BinMapper.find_categorical_counts(
+                self.values, self.counts, max_bin, min_data_in_bin,
+                use_missing, dropped_cnt=self.dropped)
+        return BinMapper.find_numerical_counts(
+            self.values, self.counts, self.na_cnt, max_bin,
+            min_data_in_bin, use_missing, zero_as_missing,
+            forced_bounds=forced_bounds)
+
+
+# ---------------------------------------------------------------------------
+# Bottom-k hash row sample (EFB conflict pool)
+# ---------------------------------------------------------------------------
+
+def _hash_u64(idx: np.ndarray, seed: int) -> np.ndarray:
+    """splitmix64 over global row indices — a uniform, chunk- and
+    rank-partition-invariant priority for bottom-k sampling."""
+    x = idx.astype(np.uint64)
+    x = x + np.uint64((0x9E3779B97F4A7C15 * (seed + 1)) & 0xFFFFFFFFFFFFFFFF)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class BottomKSample:
+    """Keep the k rows with the smallest hash priority.  The final pool
+    is a uniform random row sample that is a pure function of (data,
+    seed, k): invariant to chunk sizes and to how rows are partitioned
+    across ranks — and when n <= k it is exactly ALL rows in row order,
+    matching the in-memory loader's sample."""
+
+    def __init__(self, k: int, seed: int):
+        self.k = max(int(k), 1)
+        self.seed = int(seed)
+        self._h: List[np.ndarray] = []
+        self._idx: List[np.ndarray] = []
+        self._rows: List[np.ndarray] = []
+        self._n = 0
+        self._thresh: Optional[np.uint64] = None
+
+    def offer(self, start_row: int, X: np.ndarray) -> None:
+        n = X.shape[0]
+        idx = np.arange(start_row, start_row + n, dtype=np.int64)
+        h = _hash_u64(idx, self.seed)
+        if self._thresh is not None:
+            m = h <= self._thresh
+            if not m.any():
+                return
+            h, idx, X = h[m], idx[m], X[m]
+        self._h.append(h)
+        self._idx.append(idx)
+        self._rows.append(np.asarray(X, np.float64).copy())
+        self._n += len(h)
+        if self._n > 2 * self.k:
+            self._prune()
+
+    def _prune(self) -> None:
+        if not self._h:
+            self._h = [np.empty(0, np.uint64)]
+            self._idx = [np.empty(0, np.int64)]
+            self._rows = [np.empty((0, 0), np.float64)]
+            return
+        h = np.concatenate(self._h)
+        idx = np.concatenate(self._idx)
+        rows = np.concatenate(self._rows, axis=0)
+        order = np.lexsort((idx, h))[:self.k]
+        self._h, self._idx, self._rows = [h[order]], [idx[order]], \
+            [rows[order]]
+        self._n = len(order)
+        if self._n >= self.k:
+            self._thresh = self._h[0].max()
+
+    def state(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(hash, global_idx, rows) of the current candidates, pruned."""
+        self._prune()
+        return self._h[0], self._idx[0], self._rows[0]
+
+    def finalize(self) -> np.ndarray:
+        """The sampled rows ordered by GLOBAL row index (the order the
+        in-memory loader's sorted sample indices produce)."""
+        self._prune()
+        order = np.argsort(self._idx[0], kind="stable")
+        return self._rows[0][order]
+
+    @classmethod
+    def merged(cls, parts, k: int, seed: int) -> "BottomKSample":
+        """Combine per-rank (hash, idx, rows) states into the global
+        bottom-k — identical to a single-process pool over all rows."""
+        pool = cls(k, seed)
+        for (h, idx, rows) in parts:
+            if len(h) == 0:
+                continue
+            pool._h.append(np.asarray(h, np.uint64))
+            pool._idx.append(np.asarray(idx, np.int64))
+            pool._rows.append(np.asarray(rows, np.float64))
+            pool._n += len(h)
+        pool._prune()
+        return pool
+
+
+# ---------------------------------------------------------------------------
+# Chunk sources — repeatable, O(chunk) transient memory
+# ---------------------------------------------------------------------------
+
+class _ArraySource:
+    def __init__(self, X: np.ndarray, chunk_rows: int):
+        self.X = X
+        self.chunk = max(int(chunk_rows), 1)
+        self.bytes_total = int(X.nbytes)
+        self.num_feature = int(X.shape[1])
+
+    def chunks(self):
+        n = self.X.shape[0]
+        for s in range(0, n, self.chunk):
+            yield s, np.asarray(self.X[s:s + self.chunk], np.float64), None
+
+
+class _SequenceSource:
+    def __init__(self, seqs, chunk_rows: int):
+        self.seqs = seqs
+        self.chunk = max(int(chunk_rows), 1)
+        self.bytes_total = None
+        first = np.asarray(seqs[0][0], np.float64).reshape(-1)
+        self.num_feature = int(first.shape[0])
+
+    def chunks(self):
+        start = 0
+        for q in self.seqs:
+            for s in range(0, len(q), self.chunk):
+                X = np.asarray(q[s:min(s + self.chunk, len(q))], np.float64)
+                if X.ndim == 1:
+                    X = X.reshape(1, -1)
+                yield start, X, None
+                start += len(X)
+
+
+class _ArrowSource:
+    def __init__(self, table, chunk_rows: int):
+        self.table = table
+        self.chunk = max(int(chunk_rows), 1)
+        self.bytes_total = int(getattr(table, "nbytes", 0)) or None
+        self.num_feature = int(table.num_columns)
+
+    def chunks(self):
+        n = int(self.table.num_rows)
+        for s in range(0, n, self.chunk):
+            sl = self.table.slice(s, min(self.chunk, n - s))
+            cols = [np.asarray(sl.column(i).to_numpy(zero_copy_only=False),
+                               np.float64)
+                    for i in range(sl.num_columns)]
+            yield s, np.column_stack(cols), None
+
+
+class _FileSource:
+    """CSV/TSV chunk source; under a distributed run it reads only this
+    rank's byte shard (cut at line boundaries)."""
+
+    def __init__(self, path: str, params: Dict[str, Any], chunk_rows: int,
+                 rank: Optional[int] = None, nproc: Optional[int] = None):
+        from .dataset_io import shard_byte_range
+        self.path = str(path)
+        self.params = params
+        self.chunk = max(int(chunk_rows), 1)
+        self.byte_start = self.byte_end = None
+        self.start_row = 0
+        if rank is not None and nproc is not None and nproc > 1:
+            self.byte_start, self.byte_end, self.start_row = \
+                shard_byte_range(self.path, rank, nproc,
+                                 skip_header=bool(params.get("header",
+                                                             False)))
+            self.bytes_total = self.byte_end - self.byte_start
+        else:
+            self.bytes_total = os.path.getsize(self.path)
+        self.num_feature = None  # discovered from the first chunk
+
+    def chunks(self):
+        from .dataset_io import iter_file_chunks
+        start = self.start_row
+        for X, label in iter_file_chunks(self.path, self.params, self.chunk,
+                                         byte_start=self.byte_start,
+                                         byte_end=self.byte_end):
+            if self.num_feature is None:
+                self.num_feature = int(X.shape[1])
+            yield start, X, label
+            start += len(X)
+
+
+# ---------------------------------------------------------------------------
+# Mode / cache resolution
+# ---------------------------------------------------------------------------
+
+def resolve_ingest_mode(params: Dict[str, Any],
+                        path: Optional[str] = None) -> str:
+    """stream | inmem for this source.  ``auto`` picks stream for file
+    sources that are large (>= LGBTPU_INGEST_AUTO_BYTES, default 512 MB)
+    or have the binned cache enabled; everything else loads in memory."""
+    from .config import resolve_aliases
+    p = resolve_aliases(dict(params or {}))
+    mode = str(os.environ.get("LGBTPU_INGEST")
+               or p.get("ingest_mode", "auto") or "auto").lower()
+    if mode in ("stream", "inmem"):
+        return mode
+    if mode != "auto":
+        raise LightGBMError(
+            f"ingest_mode={mode!r} unknown (stream|inmem|auto)")
+    if str(p.get("linear_tree", "")).lower() in ("true", "1", "yes"):
+        # the linear-tree leaf fitter reads raw feature values, which
+        # streaming ingest never materializes (construct() also guards
+        # the case where linear_tree arrives later via train params)
+        return "inmem"
+    cache = str(p.get("ingest_cache", "off") or "off").lower()
+    if path is not None:
+        if cache not in ("", "off"):
+            return "stream"
+        try:
+            if os.path.getsize(str(path)) >= _AUTO_STREAM_BYTES:
+                return "stream"
+        except OSError:
+            pass
+    return "inmem"
+
+
+def default_cache_path(cfg, info: Dict[str, Any]) -> Optional[str]:
+    if cfg.ingest_cache_path:
+        return str(cfg.ingest_cache_path)
+    if info.get("kind") == "file":
+        return str(info["path"]) + ".lgbcache"
+    return None
+
+
+def _file_sig(path: str):
+    """[size, sha256] source signature: full-content hash up to 16 MB
+    (reading 16 MB is ~10 ms — an in-place edit anywhere invalidates);
+    past that, head 1 MB + 16 strided 64 KiB blocks + the tail 64 KiB
+    (best-effort: catches appends, truncation-rewrites, regeneration,
+    and partial rewrites without re-reading a multi-GB file)."""
+    import hashlib
+    size = os.path.getsize(path)
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        if size <= (1 << 24):
+            while True:
+                blk = f.read(1 << 20)
+                if not blk:
+                    break
+                h.update(blk)
+        else:
+            h.update(f.read(1 << 20))
+            step = max(1 << 20, size // 16)
+            off = 1 << 20
+            while off < size:
+                f.seek(off)
+                h.update(f.read(1 << 16))
+                off += step
+            f.seek(max(size - (1 << 16), 0))
+            h.update(f.read(1 << 16))
+    return [size, h.hexdigest()]
+
+
+def cache_params_hash(cfg, cats, info: Dict[str, Any]) -> str:
+    """sha256 over every parameter that shapes the binned result plus a
+    source signature (_file_sig for files + sidecars; shape/content
+    digests for in-memory containers) — a mismatch means the cache was
+    built from different data or under different binning knobs."""
+    import hashlib
+    import json
+    sig: Dict[str, Any] = {"kind": info.get("kind", "?")}
+    if info.get("kind") == "file":
+        path = str(info["path"])
+        try:
+            sig["content"] = _file_sig(path)
+        except OSError:
+            pass
+        # the .weight/.query/.init/.position sidecars are baked into the
+        # cache's metadata arrays, so their content must join the
+        # signature — editing a sidecar invalidates the cache
+        for suffix in (".weight", ".query", ".init", ".position"):
+            sp = path + suffix
+            try:
+                if os.path.exists(sp):
+                    sig["sidecar" + suffix] = _file_sig(sp)
+            except OSError:
+                pass
+    elif info.get("kind") == "array":
+        arr = info.get("container")
+        if arr is not None:
+            # shape + dtype + a strided row-sample digest: O(64 rows),
+            # catches a regenerated same-shape array reusing the path
+            h = hashlib.sha256()
+            n = int(arr.shape[0])
+            for s in range(0, n, max(1, n // 64)):
+                h.update(np.ascontiguousarray(arr[s]).tobytes())
+            sig["shape"] = [int(x) for x in arr.shape]
+            sig["dtype"] = str(arr.dtype)
+            sig["row_sample_sha"] = h.hexdigest()
+    elif info.get("kind") == "arrow":
+        t = info.get("container")
+        if t is not None:
+            sig["rows"] = int(t.num_rows)
+            sig["schema"] = str(t.schema)
+            sig["nbytes"] = int(getattr(t, "nbytes", 0) or 0)
+    elif info.get("kind") == "seq":
+        seqs = info.get("container")
+        if seqs is not None:
+            sig["rows"] = int(sum(len(q) for q in seqs))
+            first = np.ascontiguousarray(
+                np.asarray(seqs[0][0], np.float64))
+            sig["head_sha"] = hashlib.sha256(first.tobytes()).hexdigest()
+    forced = ""
+    if cfg.forcedbins_filename and os.path.exists(cfg.forcedbins_filename):
+        with open(cfg.forcedbins_filename) as fh:
+            forced = fh.read()
+    keys = {
+        "format": 1,
+        "max_bin": cfg.max_bin,
+        "max_bin_by_feature": cfg.max_bin_by_feature,
+        "min_data_in_bin": cfg.min_data_in_bin,
+        "bin_construct_sample_cnt": cfg.bin_construct_sample_cnt,
+        "data_random_seed": cfg.data_random_seed,
+        "use_missing": cfg.use_missing,
+        "zero_as_missing": cfg.zero_as_missing,
+        "enable_bundle": cfg.enable_bundle,
+        "categorical": sorted(int(c) for c in cats),
+        "forced_bins": forced,
+        "label_column": cfg.label_column,
+        "header": cfg.header,
+        "ingest_sketch_size": cfg.ingest_sketch_size,
+        "ingest_chunk_rows": resolve_chunk_rows(cfg),
+        "source": sig,
+    }
+    blob = json.dumps(keys, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# The two-pass driver
+# ---------------------------------------------------------------------------
+
+def resolve_chunk_rows(cfg) -> int:
+    """ingest_chunk_rows with the LGBTPU_INGEST_CHUNK A/B env override
+    (keeps A/B arms' recorded params — and model files — byte-identical,
+    like LGBTPU_COMPACT / LGBTPU_HIST_COMMS)."""
+    env = os.environ.get("LGBTPU_INGEST_CHUNK", "")
+    return max(int(env) if env else int(cfg.ingest_chunk_rows), 1)
+
+
+def _make_source(ds, cfg, info: Dict[str, Any]):
+    kind = info["kind"]
+    chunk = resolve_chunk_rows(cfg)
+    if kind == "file":
+        dist = info.get("dist")
+        return _FileSource(info["path"], ds.params, chunk,
+                           rank=dist[0] if dist else None,
+                           nproc=dist[1] if dist else None)
+    if kind == "array":
+        return _ArraySource(ds.raw_data, chunk)
+    if kind == "seq":
+        return _SequenceSource(ds.raw_seq, chunk)
+    if kind == "arrow":
+        return _ArrowSource(ds.raw_arrow, chunk)
+    raise LightGBMError(f"unknown stream source kind {kind!r}")
+
+
+def _pack_rank_blob(sketches: List[FeatureSketch], pool:
+                    Optional[BottomKSample], wire_w: int, k: int,
+                    F: int) -> np.ndarray:
+    """One rank's pass-1 state as a single int64 buffer of a shape every
+    rank agrees on without communicating — the payload of the ONE mapper
+    sync collective."""
+    sk = np.stack([s.serialize(wire_w) for s in sketches])  # (F, W) f64
+    parts = [np.asarray([0], np.int64), sk.reshape(-1).view(np.int64)]
+    if pool is not None:
+        h, idx, rows = pool.state()
+        m = len(h)
+        parts[0] = np.asarray([m], np.int64)
+        ph = np.full(k, np.iinfo(np.uint64).max, np.uint64)
+        ph[:m] = h
+        pi = np.zeros(k, np.int64)
+        pi[:m] = idx
+        pr = np.zeros((k, F), np.float64)
+        pr[:m] = rows
+        parts += [ph.view(np.int64), pi, pr.reshape(-1).view(np.int64)]
+    else:
+        parts += [np.zeros(k, np.int64), np.zeros(k, np.int64),
+                  np.zeros(k * F, np.int64)]
+    return np.concatenate(parts)
+
+
+def _merge_rank_blobs(gathered: np.ndarray, budget: int, wire_w: int,
+                      k: int, F: int, seed: int, want_pool: bool):
+    """Merge every rank's blob (rank order, deterministic) back into one
+    global sketch set + EFB pool — identical on every rank."""
+    P = gathered.shape[0]
+    sketches: Optional[List[FeatureSketch]] = None
+    parts = []
+    for r in range(P):
+        blob = gathered[r]
+        m = int(blob[0])
+        off = 1
+        sk = blob[off:off + F * wire_w].view(np.float64).reshape(F, wire_w)
+        off += F * wire_w
+        rs = [FeatureSketch.deserialize(sk[f], budget) for f in range(F)]
+        if sketches is None:
+            sketches = rs
+        else:
+            for f in range(F):
+                sketches[f].merge(rs[f])
+        if want_pool:
+            ph = blob[off:off + k].view(np.uint64)[:m]
+            off += k
+            pi = blob[off:off + k][:m]
+            off += k
+            pr = blob[off:off + k * F].view(np.float64).reshape(k, F)[:m]
+            parts.append((ph, pi, pr))
+    pool = BottomKSample.merged(parts, k, seed) if want_pool else None
+    return sketches, pool
+
+
+def stream_construct(ds, cfg) -> None:
+    """Build ``ds.binned`` (and per-row metadata) with the streaming
+    two-pass pipeline; sets ``ds.ingest_stats``."""
+    from . import telemetry as _tel
+    from .dataset_io import (BinnedCacheWriter, load_init_score_file,
+                             load_position_file, load_query_file,
+                             load_weight_file, open_binned_cache)
+    tracer = _tel.global_tracer
+    reg = _tel.global_registry
+    info = ds._stream if getattr(ds, "_stream", None) is not None else \
+        _infer_stream_info(ds)
+    t0 = time.perf_counter()
+    rss0 = _rss_bytes()
+    stats: Dict[str, Any] = {"mode": "stream", "cache_hit": False,
+                             "kind": info["kind"]}
+    dist = info.get("dist")
+    cache_mode = str(cfg.ingest_cache or "off").lower() or "off"
+    if cache_mode not in ("off", "auto", "read", "rebuild"):
+        raise LightGBMError(
+            f"ingest_cache={cache_mode!r} unknown (off|auto|read|rebuild)")
+    if dist is not None and cache_mode != "off":
+        log_warning("ingest_cache is single-process only for now; "
+                    "disabled under a distributed load")
+        cache_mode = "off"
+    if ds.reference is not None and cache_mode != "off":
+        # a validation set binned with the TRAINING mappers must never be
+        # confused with a cache built from its own sketch boundaries
+        cache_mode = "off"
+    cache_path = default_cache_path(cfg, info) if cache_mode != "off" \
+        else None
+    if cache_mode != "off" and cache_path is None:
+        raise LightGBMError(
+            "ingest_cache needs ingest_cache_path for non-file sources")
+
+    cats_arg = None  # resolved once num_feature is known
+    phash = None
+
+    # ---- cache fast path ------------------------------------------------
+    if cache_mode in ("auto", "read") and cache_path and \
+            os.path.exists(cache_path):
+        # resolving the categorical spec needs feature names; for file
+        # sources the width is in the cache meta itself
+        prev_nf = ds.num_feature_
+        prev_names = ds._resolved_feature_names
+        try:
+            with tracer.span("ingest/cache_open", path=cache_path):
+                from .dataset_io import read_cache_meta
+                meta_probe = read_cache_meta(cache_path)
+                _ensure_width(ds, int(meta_probe["num_feature"]))
+                cats_arg = ds._resolve_categorical()
+                phash = cache_params_hash(cfg, cats_arg, info)
+                binned, extras, meta = open_binned_cache(cache_path, phash)
+        except LightGBMError as exc:
+            if cache_mode == "read":
+                raise
+            # a stale cache's width (and the feature names resolved from
+            # it) must not leak into the raw-parse fallback: pass 1
+            # re-derives both from the stream
+            ds.num_feature_ = prev_nf
+            ds._resolved_feature_names = prev_names
+            cats_arg = None
+            phash = None
+            log_warning(f"ingest_cache=auto: {exc}; falling back to raw "
+                        "parsing")
+        else:
+            _adopt_cache(ds, binned, extras, meta)
+            wall = time.perf_counter() - t0
+            stats.update(cache_hit=True, rows=binned.num_data,
+                         wall_s=round(wall, 3),
+                         rows_per_s=int(binned.num_data / max(wall, 1e-9)),
+                         peak_rss_bytes=max(_rss_bytes(), rss0))
+            _publish_stats(stats, reg)
+            ds.ingest_stats = stats
+            log_info(f"ingest: cache hit {cache_path} "
+                     f"({binned.num_data} rows, {wall:.2f}s)")
+            return
+
+    if cache_mode == "read" and cache_path and \
+            not os.path.exists(cache_path):
+        raise LightGBMError(
+            f"ingest_cache=read: no binned cache at {cache_path} "
+            "(build one with ingest_cache=auto or rebuild)")
+
+    # ---- pass 1: sketches + EFB pool + labels ---------------------------
+    source = _make_source(ds, cfg, info)
+    budget = int(cfg.ingest_sketch_size)
+    sketches: Optional[List[FeatureSketch]] = None
+    pool: Optional[BottomKSample] = None
+    labels: List[np.ndarray] = []
+    rows = 0
+    chunks = 0
+    peak_rss = rss0
+    need_mappers = ds.reference is None
+    with tracer.span("ingest/pass1", kind=info["kind"]):
+        for start, X, lab in source.chunks():
+            if sketches is None:
+                _ensure_width(ds, int(X.shape[1]))
+                cats_arg = ds._resolve_categorical()
+                catset = set(cats_arg)
+                if need_mappers:
+                    sketches = [FeatureSketch(budget, is_cat=(f in catset))
+                                for f in range(X.shape[1])]
+                    if cfg.enable_bundle:
+                        pool = BottomKSample(cfg.bin_construct_sample_cnt,
+                                             cfg.data_random_seed)
+                else:
+                    sketches = []
+            with tracer.span("ingest/chunk", pass_=1, rows=len(X)):
+                for f, sk in enumerate(sketches):
+                    sk.update(X[:, f])
+                if pool is not None:
+                    pool.offer(start, X)
+            if lab is not None:
+                labels.append(lab)
+            rows += len(X)
+            chunks += 1
+            peak_rss = max(peak_rss, _rss_bytes())
+    if rows == 0:
+        raise LightGBMError("Cannot construct Dataset: it has no rows")
+    if cats_arg is None:
+        _ensure_width(ds, int(source.num_feature or 0))
+        cats_arg = ds._resolve_categorical()
+    F = ds.num_feature_
+
+    # per-row metadata (labels parsed in-stream; sidecars are O(N) scalars)
+    if info["kind"] == "file":
+        start_row = getattr(source, "start_row", 0)
+        if ds.label is None and labels:
+            ds.label = np.concatenate(labels)
+        for field, loader in (("weight", load_weight_file),
+                              ("position", load_position_file),
+                              ("init_score", load_init_score_file)):
+            if getattr(ds, field) is None:
+                v = loader(info["path"])
+                if v is not None:
+                    v = v[start_row:start_row + rows]
+                    setattr(ds, field, v)
+        if ds.group is None:
+            qg = load_query_file(info["path"])
+            if qg is not None:
+                if dist is not None:
+                    raise LightGBMError(
+                        "streaming ingest does not yet shard ranking "
+                        "data on query boundaries; use ingest_mode=inmem "
+                        "for distributed .query files")
+                ds.group = qg
+    labels = []
+    ds.num_data_ = rows
+
+    # ---- rank merge: ONE host collective --------------------------------
+    if dist is not None:
+        if need_mappers:
+            from .parallel.dist_data import sync_ingest_blob
+            wire_w = FeatureSketch.wire_width(budget)
+            k = int(cfg.bin_construct_sample_cnt) if pool is not None else 0
+            with tracer.span("ingest/mapper_sync"):
+                blob = _pack_rank_blob(sketches, pool, wire_w, k, F)
+                gathered = sync_ingest_blob(blob)
+                sketches, pool = _merge_rank_blobs(
+                    gathered, budget, wire_w, k, F, cfg.data_random_seed,
+                    want_pool=pool is not None)
+        # global row layout + metadata gather (label/weight/... are O(N)
+        # scalars; the O(N*F) features stay shard-local)
+        ds._finalize_distributed()
+
+    # ---- boundaries + EFB groups ---------------------------------------
+    if need_mappers:
+        forced = load_forced_bins(cfg.forcedbins_filename, F,
+                                  sorted(set(cats_arg))) or [None] * F
+        mbf = cfg.max_bin_by_feature
+        mappers = []
+        for f in range(F):
+            mb = cfg.max_bin if mbf is None else int(mbf[f])
+            mappers.append(sketches[f].find_mapper(
+                mb, cfg.min_data_in_bin, cfg.use_missing,
+                cfg.zero_as_missing, forced_bounds=forced[f]))
+        stats["sketch_exact"] = all(s.exact for s in sketches)
+        sketches = None     # free the summaries before pass 2
+        groups = None
+        if cfg.enable_bundle and pool is not None:
+            sample = pool.finalize()
+            pool = None     # the pool is dropped the moment groups exist
+            sample_bins = [mappers[f].transform(sample[:, f])
+                           for f in range(F)]
+            del sample
+            groups = find_feature_groups(sample_bins, mappers,
+                                         enable_bundle=True)
+            del sample_bins
+    else:
+        ref = ds.reference.construct()
+        mappers = ref.binned.bin_mappers
+        if len(mappers) != F:
+            raise LightGBMError(
+                f"validation data has {F} features but the reference "
+                f"dataset has {len(mappers)}")
+        groups = ref.binned.group_features
+        stats["sketch_exact"] = True
+
+    (groups, group_bin_counts, group_offsets, feature_offsets,
+     feature_num_bins, dtype) = binned_layout(mappers, groups)
+    G = len(groups)
+
+    # ---- pass 2: chunked bin-and-ship -----------------------------------
+    writer = None
+    bins = None
+    n_out = rows if dist is None else ds._dist["n_shard"]
+    if cache_mode in ("auto", "read", "rebuild") and cache_path:
+        phash = phash or cache_params_hash(cfg, cats_arg, info)
+        writer = BinnedCacheWriter(
+            cache_path, params_hash=phash, num_feature=F,
+            feature_names=ds.feature_name(), group_features=groups,
+            group_offsets=group_offsets, group_bin_counts=group_bin_counts,
+            feature_offsets=feature_offsets,
+            feature_num_bins=feature_num_bins, mappers=mappers,
+            dtype=dtype, source={"kind": info["kind"],
+                                 "path": info.get("path", "")})
+        # chunk staging buffer for the cache writer only — the no-cache
+        # stream bins straight into the preallocated matrix
+        buf = np.empty((min(resolve_chunk_rows(cfg), rows), G), dtype)
+    else:
+        bins = np.zeros((n_out, G), dtype)
+        buf = None
+    try:
+        with tracer.span("ingest/pass2", rows=rows):
+            row = 0
+            for start, X, _lab in source.chunks():
+                m = len(X)
+                with tracer.span("ingest/chunk", pass_=2, rows=m):
+                    if writer is not None:
+                        bin_rows_into(X, mappers, groups, buf, 0)
+                        writer.append_rows(buf[:m])
+                    else:
+                        bin_rows_into(X, mappers, groups, bins, row)
+                row += m
+                peak_rss = max(peak_rss, _rss_bytes())
+        if writer is not None:
+            for field in ("label", "weight", "group", "position",
+                          "init_score"):
+                v = getattr(ds, field)
+                if v is not None:
+                    writer.add_array(field, np.asarray(v))
+            writer.finalize()
+            writer = None
+            binned, _extras, _meta = open_binned_cache(
+                cache_path, phash, verify=False)
+            bins = binned.bins
+            stats["cache_written"] = cache_path
+    finally:
+        if writer is not None:
+            writer.abort()
+    del buf
+
+    ds.binned = BinnedData(
+        bins=bins,
+        group_features=groups,
+        group_offsets=np.asarray(group_offsets, np.int32),
+        group_bin_counts=np.asarray(group_bin_counts, np.int32),
+        feature_offsets=np.asarray(feature_offsets, np.int32),
+        feature_num_bins=np.asarray(feature_num_bins, np.int32),
+        bin_mappers=list(mappers),
+        num_data=n_out, num_features=F)
+
+    wall = time.perf_counter() - t0
+    peak_rss = max(peak_rss, _rss_bytes())
+    stats.update(
+        rows=rows, chunks=chunks, wall_s=round(wall, 3),
+        rows_per_s=int(rows / max(wall, 1e-9)),
+        peak_rss_bytes=int(peak_rss),
+        chunk_rows=int(resolve_chunk_rows(cfg)))
+    if source.bytes_total:
+        stats["bytes"] = int(source.bytes_total)
+        stats["bytes_per_s"] = int(source.bytes_total / max(wall, 1e-9))
+    _publish_stats(stats, reg)
+    ds.ingest_stats = stats
+    log_info(
+        f"ingest: mode=stream rows={rows} chunks={chunks} "
+        f"wall={wall:.2f}s rows/s={stats['rows_per_s']} "
+        f"peak_rss={peak_rss / 1e9:.2f}GB"
+        + (f" cache={stats['cache_written']}"
+           if "cache_written" in stats else ""))
+
+
+def _publish_stats(stats: Dict[str, Any], reg) -> None:
+    reg.gauge("ingest/rows_per_s", float(stats.get("rows_per_s", 0)))
+    if "bytes_per_s" in stats:
+        reg.gauge("ingest/bytes_per_s", float(stats["bytes_per_s"]))
+    reg.gauge("ingest/peak_rss_bytes", float(stats.get("peak_rss_bytes", 0)))
+
+
+def _ensure_width(ds, F: int) -> None:
+    if ds.num_feature_ in (None, -1):
+        ds.num_feature_ = int(F)
+    elif int(F) != ds.num_feature_:
+        raise LightGBMError(
+            f"stream chunks carry {F} features but the dataset was "
+            f"declared with {ds.num_feature_}")
+
+
+def _infer_stream_info(ds) -> Dict[str, Any]:
+    # "container" feeds the cache source signature only (never
+    # serialized — BinnedCacheWriter copies just kind/path)
+    if ds.raw_data is not None:
+        return {"kind": "array", "container": ds.raw_data}
+    if ds.raw_seq is not None:
+        return {"kind": "seq", "container": ds.raw_seq}
+    if ds.raw_arrow is not None:
+        return {"kind": "arrow", "container": ds.raw_arrow}
+    raise LightGBMError(
+        "ingest_mode=stream needs an ndarray, Sequence, pyarrow Table, "
+        "or CSV/TSV file source (sparse matrices use the dedicated "
+        "sparse path)")
+
+
+def _adopt_cache(ds, binned, extras: Dict[str, Any], meta) -> None:
+    ds.binned = binned
+    ds.num_data_ = int(binned.num_data)
+    ds.num_feature_ = int(binned.num_features)
+    if ds._resolved_feature_names is None and \
+            not isinstance(ds._feature_name_arg, list):
+        ds._resolved_feature_names = [str(x) for x in meta["feature_names"]]
+    for field in ("label", "weight", "group", "position", "init_score"):
+        if getattr(ds, field) is None and field in extras:
+            setattr(ds, field, extras[field])

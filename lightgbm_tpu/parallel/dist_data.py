@@ -96,6 +96,16 @@ def gather_sample(sample: np.ndarray) -> np.ndarray:
                            for r in range(len(counts))], axis=0)
 
 
+def sync_ingest_blob(blob: np.ndarray) -> np.ndarray:
+    """The streaming loader's mapper sync: ONE host collective carrying
+    each rank's serialized pass-1 state (fixed-width quantile sketches +
+    the EFB bottom-k pool, ingest._pack_rank_blob) — the analog of the
+    reference's bin-mapper Allgather (dataset_loader.cpp:733-741).
+    Every rank merges the gathered blobs in rank order, so boundaries
+    come out identical everywhere without a second round trip."""
+    return allgather_np(np.ascontiguousarray(blob, np.int64))
+
+
 def make_global_bins(local_bins: np.ndarray, mesh, row_axis: str):
     """Assemble per-process binned shards into one global row-sharded device
     array (the features never leave their host except to its own devices)."""

@@ -58,6 +58,12 @@ _VOLATILE_PARAMS = frozenset({
     # bf16_pair changes the arithmetic); eval_fetch_freq only re-times
     # host polls
     "hist_comms", "hist_comms_pipeline", "eval_fetch_freq",
+    # the binned cache is a pure IO shortcut: a cache hit restores the
+    # exact binned matrix the raw parse would have produced (params-hash
+    # gated), so a resumed run may toggle it freely (ingest_mode /
+    # ingest_chunk_rows / ingest_sketch_size are NOT volatile — they can
+    # change sampling or compressed-sketch boundaries)
+    "ingest_cache", "ingest_cache_path",
     "telemetry", "telemetry_out", "trace_out", "telemetry_recompile_threshold",
     "telemetry_straggler_every", "telemetry_straggler_skew",
     "telemetry_cost", "profile_out",

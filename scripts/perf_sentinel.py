@@ -84,6 +84,22 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     finally:
         if cost_env is not None:
             os.environ["LGBTPU_COST"] = cost_env
+    # ingest entry: the streamed chunked bin-and-ship program
+    # (ingest_ship, device_data.ship_binned_chunks) — forced on via the
+    # env override so the CPU sentinel box compiles it too
+    ship_env = os.environ.get("LGBTPU_INGEST_SHIP")
+    os.environ["LGBTPU_INGEST_SHIP"] = "1"
+    try:
+        ship_ds = lgb.Dataset(X, label=y, params={
+            "verbosity": -1, "ingest_mode": "stream",
+            "ingest_chunk_rows": max(4096, int(w["rows"]) // 4),
+            "max_bin": int(w["max_bin"])})
+        ship_ds.device_data()
+    finally:
+        if ship_env is None:
+            os.environ.pop("LGBTPU_INGEST_SHIP", None)
+        else:
+            os.environ["LGBTPU_INGEST_SHIP"] = ship_env
     # serving entry: the bucketed compiled predictor (serve_predict)
     with tempfile.TemporaryDirectory(prefix="lgb_sentinel_") as td:
         path = os.path.join(td, "model.txt")

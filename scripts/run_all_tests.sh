@@ -59,6 +59,12 @@ XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
     | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
 --xla_force_host_platform_device_count=4" \
     python -m pytest tests/test_fused_sharded.py -x -q
+# out-of-core ingest fast tier: sketch-vs-exact boundary equivalence,
+# chunk/rank determinism, stream-vs-inmem tree bit-identity, and the
+# binned-cache corruption matrix (docs/INGEST.md) — the loaders every
+# suite below constructs its datasets through
+echo "=== stage: out-of-core ingest fast tier ==="
+python -m pytest tests/test_ingest.py -x -q -m 'not slow'
 echo "=== stage: full fast tier ==="
 python -m pytest tests/ -x -q
 # GOSS sampling bench: the row-compaction speedup gate (docs/PERF.md
@@ -77,6 +83,19 @@ BENCH_GOSS_ITERS="${BENCH_GOSS_ITERS:-5}" \
 # plus the wall-clock history compare, which only bites where
 # BENCH_HISTORY.jsonl already holds >= 3 same-host runs of a metric
 # (docs/OBSERVABILITY.md "Perf-regression sentinel")
+# out-of-core ingest bench (reduced-size smoke): trees must be bitwise
+# identical across the in-memory loader, the streaming loader, and a
+# binned-cache re-run, and the subprocess stream arm must hold its
+# peak-RSS delta under the configured budget at the gated rows/s
+# (docs/INGEST.md; full-size numbers live in BENCH_INGEST.json)
+echo "=== stage: out-of-core ingest bench (BENCH_TASK=ingest) ==="
+BENCH_TASK=ingest \
+BENCH_INGEST_ID_ROWS="${BENCH_INGEST_ID_ROWS:-60000}" \
+BENCH_INGEST_ROWS="${BENCH_INGEST_ROWS:-400000}" \
+BENCH_INGEST_FEATURES="${BENCH_INGEST_FEATURES:-16}" \
+BENCH_INGEST_SMOKE=1 \
+BENCH_HISTORY=0 \
+    python bench.py
 echo "=== stage: perf sentinel (cost budgets + bench history) ==="
 python scripts/perf_sentinel.py --budgets PERF_BUDGETS.json --measure \
     --history BENCH_HISTORY.jsonl
