@@ -317,6 +317,12 @@ class Config:
     num_iterations: int = 100
     learning_rate: float = 0.1
     num_leaves: int = 31
+    # serial | data | feature | voting (the reference's learner factory,
+    # tree_learner.h:111; docs/DISTRIBUTED.md "choosing a tree_learner").
+    # data shards rows (histogram reduce O(G*B)/round); feature shards
+    # the feature-GROUP axis — zero histogram wire bytes, trees
+    # bit-identical to serial; voting (PV-Tree) shards rows but reduces
+    # only the elected top-2*top_k features' columns (O(2k*B)/round)
     tree_learner: str = "serial"
     num_threads: int = 0
     device_type: str = "tpu"
@@ -369,6 +375,10 @@ class Config:
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_cat_to_onehot: int = 4
+    # voting-parallel: each device votes for its local top_k features
+    # per slot and the global top-2*top_k are elected for the histogram
+    # reduce (voting_parallel_tree_learner.cpp:104/396) — the per-round
+    # payload knob of tree_learner=voting
     top_k: int = 20
     monotone_constraints: Any = None
     monotone_constraints_method: str = "basic"

@@ -108,6 +108,12 @@ class GBDT:
         self._row_sharding = None
         self._row_axis = None
         self._mesh_stream = False
+        # feature-parallel mode (tree_learner=feature under a mesh): bins
+        # sharded over its feature-GROUP axis, every per-row array pinned
+        # fully replicated (docs/DISTRIBUTED.md "feature-parallel")
+        self._feature_mode = False
+        self._feature_axis = None
+        self._replicated_sharding = None
         # voting replaces the grow fn with its own shard_map learner, which
         # never reads the packed stream layout — keep stream (and its packed
         # bins copy) off when voting will engage
@@ -192,6 +198,16 @@ class GBDT:
                 # in-process collectives)
                 self._row_sharding = data_sharding(self.mesh)
                 self._row_axis = self._row_sharding.spec[0]
+            else:
+                # feature sharding: rows stay whole on every device — pin
+                # the per-row arrays (score, grad, hess, bagging mask)
+                # REPLICATED by construction so eager ops can't compile
+                # mixed-placement SPMD programs that race the in-process
+                # collectives (_shard_row_array asserts the placement)
+                from ..parallel.mesh import replicated
+                self._feature_mode = True
+                self._feature_axis = sh.spec[1]
+                self._replicated_sharding = replicated(self.mesh)
         self.dd = dd
         n = dd.bins.shape[0]                  # padded row count
         self.num_data = train_data.num_data()
@@ -223,6 +239,26 @@ class GBDT:
 
         self._check_unsupported_params()
         self._grow_params = self._make_grow_params()
+        if self._feature_mode and (
+                not self._grow_params.plain_growth
+                or self._parse_forced_splits() is not None
+                or config.linear_tree):
+            raise LightGBMError(
+                "tree_learner=feature does not support monotone/"
+                "interaction constraints, forced splits, path smoothing, "
+                "extra_trees, feature_fraction_bynode, cegb_*, or "
+                "linear_tree; remove those parameters or use "
+                "tree_learner=data")
+        if self._feature_mode and \
+                self._grow_params.hist_backend not in ("segsum", "onehot"):
+            # checked here (not just in grow_tree) so the engine never
+            # pre-packs a pallas bin copy of the group-sharded matrix —
+            # pack_bins would replicate the full (N, G) block per device
+            raise LightGBMError(
+                f"tree_learner=feature needs hist_backend=segsum or "
+                f"onehot (got {self._grow_params.hist_backend!r}: the "
+                "stream/pallas kernels pack row-major group words, which "
+                "group sharding cannot slice)")
         packed = None
         # row-compaction capacity quantum: compacted views must stay whole
         # multiples of the stream kernel block (smaller-tier K-widened
@@ -259,8 +295,10 @@ class GBDT:
             forced=self._parse_forced_splits(),
             cegb_coupled=self._cegb_coupled_array(),
             cegb_lazy_pen=self._cegb_lazy_pen_array(),
-            mesh=self.mesh if self._mesh_stream else None,
-            row_axis=self._row_axis)
+            mesh=(self.mesh if (self._mesh_stream or self._feature_mode)
+                  else None),
+            row_axis=self._row_axis,
+            feature_axis=self._feature_axis)
         self._grow_fn = watched_jit(self._grow_partial, name="grow_tree",
                                     owner=self,
                                     static_argnames=("compact_rows",))
@@ -309,14 +347,22 @@ class GBDT:
                                       config.top_k, config,
                                       layout=dd.layout)
             routing = dd.routing
+            vote_mesh, vote_axis = self.mesh, self._row_axis
 
             def _vote_fn(bins, g, h, mask, colm, key=None, packed=None,
-                         cegb_used=None, cegb_lazy=None, gh_scales=None):
+                         cegb_used=None, cegb_lazy=None, gh_scales=None,
+                         compact_rows=0):
                 return grow_tree_voting(bins, g, h, mask, colm,
-                                        sp_root, sp, gp, routing)
+                                        sp_root, sp, gp, routing,
+                                        mesh=vote_mesh, row_axis=vote_axis,
+                                        compact_rows=compact_rows)
 
+            # the voting fn replaces grow_tree as THE grow partial, so the
+            # fused-iteration and per-class-scan paths thread it unchanged
+            self._grow_partial = _vote_fn
             self._grow_fn = watched_jit(_vote_fn, name="grow_tree_voting",
-                                        owner=self)
+                                        owner=self,
+                                        static_argnames=("compact_rows",))
             self._voting = True
         self._needs_grow_key = (self._grow_params.bynode_fraction < 1.0
                                 or self._grow_params.extra_trees)
@@ -368,10 +414,11 @@ class GBDT:
         cmdl = self._comms_model()
         if cmdl is not None:
             log_info(
-                f"data-parallel comms: hist_comms={cmdl['mode']} "
+                f"mesh comms: mode={cmdl['mode']} "
                 f"(dtype={cmdl['dtype']}) over {cmdl['devices']} devices, "
-                f"~{cmdl['per_round_bytes'] / 2 ** 20:.2f} MB histogram "
-                "payload delivered per device per growth round")
+                f"~{cmdl['per_round_bytes'] / 2 ** 20:.3f} MB split payload "
+                f"({cmdl.get('hist_block_bytes', 0) / 2 ** 20:.3f} MB "
+                "histogram columns) delivered per device per growth round")
 
     # ------------------------------------------------------------------
     @property
@@ -405,7 +452,16 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _shard_row_array(self, a):
-        """Place a per-row array ((N,) or (N, K)) on the mesh's row sharding."""
+        """Place a per-row array ((N,) or (N, K)) on the mesh's row
+        sharding — or, in feature-parallel mode, pin it fully REPLICATED
+        across the mesh (rows are never sharded there) and assert the
+        placement so a mixed-placement eager op cannot slip through."""
+        if self._replicated_sharding is not None:
+            a = jax.device_put(a, self._replicated_sharding)
+            assert a.sharding.is_fully_replicated, (
+                "feature-parallel per-row arrays must be fully replicated; "
+                f"got {a.sharding}")
+            return a
         if self._row_sharding is None:
             return a
         if a.ndim == 1:
@@ -445,8 +501,8 @@ class GBDT:
         gp = self._grow_params
         eligible = (mode != "off"
                     and gp.hist_backend in ("stream", "segsum", "onehot")
-                    and not self._voting
-                    and (self.mesh is None or self._mesh_stream))
+                    and (self.mesh is None or self._mesh_stream
+                         or self._voting or self._feature_mode))
         if not eligible and not _tel_tracer.enabled:
             # opted-out / ineligible runs keep the legacy fully-async
             # pipeline: no per-iteration count readback (the sync below
@@ -454,7 +510,10 @@ class GBDT:
             return 0
         n_rows = self.dd.bins.shape[0]
         D = 1
-        if self._mesh_stream and self._row_axis is not None:
+        # per-shard capacity wherever rows are the sharded axis (stream
+        # data-parallel AND the voting learner); feature-parallel
+        # replicates rows, so its capacity covers the full row count
+        if self.mesh is not None and self._row_axis is not None:
             D = int(self.mesh.shape[self._row_axis])
         local = n_rows // D
         # per-mask count cache: bagging reuses one mask for a whole
@@ -519,34 +578,73 @@ class GBDT:
         both modes grow identical trees."""
         if self._comms_model_cache is not None:
             return self._comms_model_cache
-        if (self.mesh is None or not self._mesh_stream
-                or getattr(self, "_voting", False)):
+        if self.mesh is None:
             return None
-        from ..parallel.comms import hist_comms_bytes_per_round
         gp = self._grow_params
+        S2 = 2 * min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+        rounds2 = -(-(gp.num_leaves - 1)
+                    // max(S2 // 2, 1)) + 1
+        k_all = self.num_tree_per_iteration
+        if getattr(self, "_voting", False):
+            # PV-Tree: vote psum + ONLY the elected top-2k features'
+            # histogram columns per slot (O(2k*B), never O(F*B))
+            from ..parallel.comms import voting_bytes_per_round
+            F = self.dd.num_features
+            k2 = min(2 * self.config.top_k, F)
+            per_round = voting_bytes_per_round(S2, F, k2, self.dd.max_bins)
+            self._comms_model_cache = {
+                "mode": "voting", "dtype": "f32",
+                "devices": int(np.prod(self.mesh.devices.shape)),
+                "per_round_bytes": per_round,
+                "hist_block_bytes": S2 * k2 * self.dd.max_bins * 3 * 4,
+                "elected_columns": k2,
+                "per_iter_bytes": per_round * rounds2 * k_all}
+            return self._comms_model_cache
+        if self._feature_mode:
+            # feature-parallel: ZERO histogram bytes — best-split records
+            # (+ owner-shard categorical bitsets) only; routing adds one
+            # int32 per row per round (reported separately)
+            from ..parallel.comms import feature_bytes_per_round
+            d_f = int(self.mesh.shape[self._feature_axis])
+            per_round = feature_bytes_per_round(
+                S2, d_f, self.dd.max_bins, gp.has_categorical)
+            self._comms_model_cache = {
+                "mode": "feature", "dtype": "f32", "devices": d_f,
+                "per_round_bytes": per_round,
+                "hist_block_bytes": 0,
+                "route_bytes_per_round": self.dd.bins.shape[0] * 4,
+                "per_iter_bytes": per_round * rounds2 * k_all}
+            return self._comms_model_cache
+        if self._row_sharding is None:
+            return None
+        # row-sharded data-parallel: stream runs the explicit shard_map
+        # psum/reduce_scatter; non-stream backends get the SAME payload
+        # via GSPMD's automatic histogram all-reduce, so the analytic
+        # psum-convention accounting applies to both
+        from ..parallel.comms import hist_comms_bytes_per_round
         # the collective shards over the ROW axis only (comms.build_shard_plan
         # uses mesh.shape[row_axis]); on multi-axis meshes the other axes do
         # not divide the histogram payload
         d = (int(self.mesh.shape[self._row_axis])
              if self._row_axis is not None
              else int(np.prod(self.mesh.devices.shape)))
-        S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+        S = S2 // 2   # the data reduce moves S smaller-child blocks/round
         # int32 quantized hists stay on the exact psum_scatter wire — the
         # bf16_pair width never applies to them (comms.reduce_hist)
         cdtype = "f32" if gp.int_hist else gp.hist_comms_dtype
         # batched multiclass reduces ONE K-channel block per round; the
         # per-class scan reduces K single-class blocks — same bytes per
         # iteration, different per-round figure
-        k = self.num_tree_per_iteration
+        k = k_all
         kb = k if (k > 1 and self._use_batched_multiclass()) else 1
         per_round = hist_comms_bytes_per_round(
             S, self.dd.num_groups, self.dd.max_bins, d, gp.hist_comms,
             cdtype, num_class=kb)
-        rounds = -(-(gp.num_leaves - 1) // S) + 1
         self._comms_model_cache = {
             "mode": gp.hist_comms, "dtype": cdtype,
             "devices": d, "per_round_bytes": per_round,
-            "per_iter_bytes": per_round * rounds * (k // kb)}
+            "hist_block_bytes": per_round,
+            "per_iter_bytes": per_round * rounds2 * (k // kb)}
         return self._comms_model_cache
 
     # ------------------------------------------------------------------
@@ -1220,7 +1318,11 @@ class GBDT:
         cached = getattr(self, "_mc_batched_static", None)
         if cached is None:
             gp = self._grow_params
+            # voting/feature learners have no grow_tree_k lockstep yet —
+            # their K class trees ride the per-class lax.scan instead
             ok = (gp.plain_growth and not self._needs_grow_key
+                  and not getattr(self, "_voting", False)
+                  and not self._feature_mode
                   and self._parse_forced_splits() is None)
             if ok and gp.hist_backend == "stream":
                 # the widened (m_rows, 2*S*K) histogram block stays VMEM-
@@ -1342,7 +1444,6 @@ class GBDT:
             return False
         base = (not _chaos.has("nan_grad")   # chaos injects eagerly
                 and not c.linear_tree
-                and not self._voting
                 and self._cegb_used is None
                 and not self._dist_mode     # multi-process keeps the
                                             # eager path (rank-local numpy
@@ -1356,9 +1457,14 @@ class GBDT:
         if self.num_tree_per_iteration > 1 \
                 and not self._use_batched_multiclass():
             return False   # the per-class scan stays on the eager path
+        # default ON for every mesh learner: the row-sharded stream path,
+        # the voting (PV-Tree) learner, and the feature-parallel learner —
+        # each extra dispatch pays per-device coordination under a mesh
         return (force == "1" or mode == "on"
                 or jax.default_backend() in ("tpu", "axon")
-                or (self.mesh is not None and self._mesh_stream))
+                or (self.mesh is not None
+                    and (self._mesh_stream or self._voting
+                         or self._feature_mode)))
 
     # ------------------------------------------------------------------
     def _shard_leaf_array(self, a):
@@ -1425,13 +1531,13 @@ class GBDT:
         gp = self._grow_params
         eligible = (cmode in ("auto", "pad")
                     and gp.hist_backend in ("stream", "segsum", "onehot")
-                    and not self._voting
-                    and (self.mesh is None or self._mesh_stream))
+                    and (self.mesh is None or self._mesh_stream
+                         or self._voting or self._feature_mode))
         if not eligible:
             return 0
         n_rows = self.dd.bins.shape[0]
         D = 1
-        if self._mesh_stream and self._row_axis is not None:
+        if self.mesh is not None and self._row_axis is not None:
             D = int(self.mesh.shape[self._row_axis])
         local = n_rows // D
         unit = self._pack_block
@@ -1495,8 +1601,11 @@ class GBDT:
             dd, gp = self.dd, self._grow_params
             mesh = self.mesh if self._mesh_stream else None
             row_axis = self._row_axis
+            # per-shard overflow detection wherever rows are sharded
+            # (stream data-parallel AND voting); feature mode replicates
+            # rows, so its one "shard" is the full row count
             D = (int(self.mesh.shape[row_axis])
-                 if mesh is not None and row_axis is not None else 1)
+                 if self.mesh is not None and row_axis is not None else 1)
             gather = None
             if self._use_leaf_gather_kernel:
                 from ..pallas.stream_kernel import leaf_gather
@@ -1584,9 +1693,10 @@ class GBDT:
                 return new_state, arrays, new_obj
 
             out_sh = None
-            st_sh = state_shardings(self.mesh if self._row_sharding
-                                    is not None else None,
-                                    self._row_axis, k)
+            st_sh = state_shardings(
+                self.mesh if (self._row_sharding is not None
+                              or self._feature_mode) else None,
+                self._row_axis, k, replicate_rows=self._feature_mode)
             if st_sh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 from ..tree import TreeArrays as _TA
@@ -1921,7 +2031,7 @@ class GBDT:
         # launch costs fixed dispatch overhead on a tunneled TPU)
         k_results = None
         if (k > 1 and not self.config.linear_tree
-                and self._cegb_used is None and not self._voting
+                and self._cegb_used is None
                 and not (self.config.use_quantized_grad
                          and self.config.quant_train_renew_leaf)):
             with global_timer.scope("GBDT::TrainTree"), \

@@ -336,6 +336,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
               cegb_used=None, cegb_lazy=None, cegb_lazy_pen=None,
               gh_scales: Optional[jax.Array] = None,
               mesh=None, row_axis: Optional[str] = None,
+              feature_axis: Optional[str] = None,
               compact_rows: int = 0,
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (TreeArrays, leaf_id[N]).
@@ -355,6 +356,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     reference's per-worker fast histogram path + ReduceScatter
     (data_parallel_tree_learner.cpp:285-299); all other backends partition
     via GSPMD without this.
+    mesh/feature_axis: the FEATURE-PARALLEL learner (tree_learner=feature,
+    docs/DISTRIBUTED.md): bins arrives sharded over its feature-GROUP axis
+    (rows replicated), each device builds histograms and runs the full
+    split scan over ONLY its G/D group slice through the static per-shard
+    sub-FeatureLayouts (parallel/comms.py), and only 7-field per-shard
+    best-split records are all_gathered with the exact (max gain, lowest
+    global feature id) tie-break — ZERO histogram bytes cross the wire
+    (the reference Allreduces SplitInfo records only,
+    feature_parallel_tree_learner.cpp:25-83).  Trees are bit-identical to
+    the serial learner.  Mutually exclusive with row_axis.
     compact_rows: static PER-SHARD row capacity for GOSS/bagging row
     compaction (0 = off).  One stable partition per tree (ops/compact.
     plan_sample_rows) gathers the in-bag rows to the front and every
@@ -450,10 +461,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
     # ---- root ----
     use_stream = params.hist_backend == "stream"
+    use_fp = mesh is not None and feature_axis is not None
     use_compact = compact_rows > 0
     if use_compact:
         from .compact import check_compact_supported
-        check_compact_supported(params.hist_backend, mesh)
+        # feature-parallel replicates rows, so its compaction is the
+        # single-device stable partition (bins' sharded GROUP axis is
+        # untouched by the row gather)
+        check_compact_supported(params.hist_backend,
+                                None if use_fp else mesh)
     bins_packed = None
     Bpad = -(-Bmax // 8) * 8
     # reduce_scatter comms (docs/DISTRIBUTED.md): the histogram block is
@@ -475,6 +491,39 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         plan, rs_split, rs_bitset = make_rs_context(
             mesh, row_axis, layout, routing, G, Bmax, params)
         G_h = plan.g_pad
+    # FEATURE-PARALLEL (tree_learner=feature): the histogram state itself
+    # is sharded over the group axis and built shard-locally (no
+    # collective); the split scan reuses the SAME ShardPlan machinery the
+    # rs path proved bit-identical, minus the reduce — the only wire
+    # traffic is best-split records, owner-shard categorical bitsets, and
+    # one int32 per row for routing
+    if use_fp:
+        if params.hist_backend not in ("segsum", "onehot"):
+            raise ValueError(
+                "tree_learner=feature needs a contraction/segsum histogram "
+                "backend (the stream/pallas kernels pack row-major group "
+                "words, which group sharding cannot slice)")
+        if not params.plain_growth or forced:
+            raise ValueError(
+                "tree_learner=feature supports the plain feature set only "
+                "(no monotone/interaction constraints, CEGB, forced "
+                "splits, path smoothing, extra_trees, or "
+                "feature_fraction_bynode)")
+        from ..parallel.comms import (make_rs_context, make_sharded_hist,
+                                      make_sharded_bin_gather)
+        fp_plan, fp_split, fp_bitset = make_rs_context(
+            mesh, feature_axis, layout, routing, G, Bmax, params)
+        if fp_plan.g_pad != G:
+            raise ValueError(
+                f"feature-parallel bins must arrive group-padded to a "
+                f"multiple of the mesh feature axis (got {G} groups, need "
+                f"{fp_plan.g_pad}); the engine pads at construction")
+        G_h = G
+        fp_hist_1 = make_sharded_hist(mesh, feature_axis,
+                                      params.hist_backend, 1, Bmax, hdt)
+        fp_hist_S = make_sharded_hist(mesh, feature_axis,
+                                      params.hist_backend, S, Bmax, hdt)
+        fp_bin = make_sharded_bin_gather(mesh, feature_axis, fp_plan.gs)
     if use_stream:
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
@@ -582,6 +631,21 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             else:
                 from ..pallas.hist_kernel import pack_bins
                 bins_packed = pack_bins(bins)
+
+        if use_fp:
+            # shard-local build: each device histograms only its G/D group
+            # slice (zero collective — per-group sums are independent)
+            def _build_ns(bins_x, slot_x, g_x, h_x, c_x, nslots,
+                          packed_x=None):
+                return (fp_hist_1 if nslots == 1 else fp_hist_S)(
+                    bins_x, slot_x, g_x, h_x, c_x)
+        else:
+            def _build_ns(bins_x, slot_x, g_x, h_x, c_x, nslots,
+                          packed_x=None):
+                return build_histograms(
+                    bins_x, slot_x, g_x, h_x, c_x, nslots, Bmax,
+                    backend=params.hist_backend, bins_packed=packed_x,
+                    acc_dtype=hdt)
         leaf_id = jnp.zeros(N, i32)
         leaf_id_c = jnp.zeros(1, i32)
         if use_compact:
@@ -591,15 +655,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             from .compact import compact_row_views
             bins_c, grad_c, hess_c, cnt_c, c_perm = compact_row_views(
                 bins, grad, hess, cnt_w, compact_rows)
-            root_hist = build_histograms(
+            root_hist = _build_ns(
                 bins_c, jnp.zeros(compact_rows, i32), grad_c, hess_c, cnt_c,
-                1, Bmax, backend=params.hist_backend, bins_packed=None,
-                acc_dtype=hdt)[..., :2]
+                1)[..., :2]
         else:
-            root_hist = build_histograms(
-                bins, leaf_id, grad, hess, cnt_w, 1, Bmax,
-                backend=params.hist_backend, bins_packed=bins_packed,
-                acc_dtype=hdt)[..., :2]
+            root_hist = _build_ns(
+                bins, leaf_id, grad, hess, cnt_w, 1,
+                packed_x=bins_packed)[..., :2]
     root_g = jnp.sum(grad, dtype=hdt)
     root_h = jnp.sum(hess, dtype=hdt)
     root_c = jnp.sum(cnt_w, dtype=hdt)
@@ -614,9 +676,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                   else jnp.zeros(F, bool)) if use_cegb else None
     root_lazy = (lazy_unused_counts(cegb_lazy, jnp.zeros(N, i32), 1)
                  if use_lazy else None)
-    if use_rs:
-        root_split = rs_split(root_hist, root_g[None], root_h[None],
-                              root_c[None], col_mask)
+    if use_rs or use_fp:
+        root_split = (rs_split if use_rs else fp_split)(
+            root_hist, root_g[None], root_h[None], root_c[None], col_mask)
     else:
         root_split = find_splits(
             root_hist, root_g[None], root_h[None], root_c[None],
@@ -633,6 +695,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         if use_amono else None))
 
     hist = jnp.zeros((L, G_h, Bmax, 2), hdt).at[0].set(root_hist[0])
+    if use_fp:
+        # pin the histogram STATE to the group sharding for the whole
+        # while_loop: every per-round build/subtract then stays shard-local
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        hist = jax.lax.with_sharding_constraint(
+            hist, NamedSharding(mesh, _P(None, feature_axis, None, None)))
     state = _GrowState(
         leaf_id=leaf_id,
         leaf_id_c=leaf_id_c,
@@ -772,10 +840,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
 
             # ---- categorical bitsets for the chosen splits ----
             parent_hist = st.hist[pair_old]                       # (S, G, Bmax, 2)
-            if params.has_categorical and use_rs:
+            if params.has_categorical and (use_rs or use_fp):
                 # owner-shard recompute + tiny masked psum (the histogram
                 # slice never leaves its device)
-                bitset = rs_bitset(parent_hist, feat, thr, dirf, pg, ph, pc)
+                bitset = (rs_bitset if use_rs else fp_bitset)(
+                    parent_hist, feat, thr, dirf, pg, ph, pc)
             elif params.has_categorical:
                 hf = gather_feature_histograms(parent_hist, layout, pg, ph)
                 hf_feat = hf[jnp.arange(S), feat]                 # (S, Bmax, 2)
@@ -864,8 +933,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 r_chosen = leaf_chosen[st.leaf_id]
                 r_feat = leaf_feat[st.leaf_id]
                 r_grp = routing.feat_group[r_feat]
-                gb = jnp.take_along_axis(bins, r_grp[:, None].astype(jnp.int32),
-                                         axis=1)[:, 0]
+                if use_fp:
+                    # owner-shard column read + (N,) int32 psum: the split
+                    # feature's bins column lives on one shard only
+                    gb = fp_bin(bins, r_grp)
+                else:
+                    gb = jnp.take_along_axis(
+                        bins, r_grp[:, None].astype(jnp.int32), axis=1)[:, 0]
                 fb = feature_local_bin(gb, r_feat, routing)
                 r_thr = leaf_thr[st.leaf_id]
                 r_dir = leaf_dir[st.leaf_id]
@@ -894,16 +968,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 if use_compact:
                     # O(compact_rows) slot gather + histogram over the
                     # compact row view (the partition plan is per-tree)
-                    hist3 = build_histograms(
-                        bins_c, jnp.take(slot, c_perm, axis=0), grad_c,
-                        hess_c, cnt_c, S, Bmax,
-                        backend=params.hist_backend, bins_packed=None,
-                        acc_dtype=hdt)
+                    hist3 = _build_ns(bins_c, jnp.take(slot, c_perm, axis=0),
+                                      grad_c, hess_c, cnt_c, S)
                 else:
-                    hist3 = build_histograms(
-                        bins, slot, grad, hess, cnt_w, S, Bmax,
-                        backend=params.hist_backend,
-                        bins_packed=bins_packed, acc_dtype=hdt)
+                    hist3 = _build_ns(bins, slot, grad, hess, cnt_w, S,
+                                      packed_x=bins_packed)
                 hist_small = hist3[..., :2]
                 # any one group's bins partition the slot's rows, so group 0's
                 # count channel sums to the exact per-slot data count
@@ -1286,11 +1355,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                    else jnp.zeros((rows2, F), bool),
                                    rkey, rows=rows2)
             with jax.named_scope("find_splits"):
-                if use_rs:
+                if use_rs or use_fp:
                     # shard-local scan on each device's group slice + tiny
                     # best-record all_gather (bit-identical to the full scan)
-                    res = rs_split(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
-                                   st2.cnt[ids2], st.col_mask)
+                    res = (rs_split if use_rs else fp_split)(
+                        hist2, st2.sum_g[ids2], st2.sum_h[ids2],
+                        st2.cnt[ids2], st.col_mask)
                 else:
                     res = find_splits(hist2, st2.sum_g[ids2], st2.sum_h[ids2],
                               st2.cnt[ids2],

@@ -313,6 +313,83 @@ def make_sharded_bitset(mesh, axis: str, plan: ShardPlan, cat_smooth: float,
     return bitset
 
 
+def make_sharded_hist(mesh, axis: str, backend: str, num_slots: int,
+                      bmax: int, acc_dtype):
+    """shard_map-wrapped LOCAL histogram build for the feature-parallel
+    learner: bins is sharded over its GROUP axis (rows replicated), so each
+    device builds the (S, G/D, Bmax, 3) block for its own feature groups
+    with NO collective at all — the reference's feature-parallel workers
+    each histogram only their feature subset
+    (feature_parallel_tree_learner.cpp:25-83).  Per-group sums are
+    independent of other groups, so every shard's block is bitwise equal
+    to the corresponding slice of the serial build."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_rows
+    from ..ops.histogram import build_histograms
+
+    def _local(bins_s, slot, grad, hess, cnt):
+        with jax.named_scope("hist_shard_local"):
+            return build_histograms(bins_s, slot, grad, hess, cnt,
+                                    num_slots, bmax, backend=backend,
+                                    acc_dtype=acc_dtype)
+
+    rep = P()
+    return shard_map_rows(
+        _local, mesh,
+        (P(None, axis), rep, rep, rep, rep),
+        P(None, axis, None, None))
+
+
+def make_sharded_bin_gather(mesh, axis: str, gs: int):
+    """shard_map-wrapped per-row stored-bin fetch for feature-parallel
+    routing: rows are replicated but the bins column of a chosen split
+    feature lives only on its owner shard, so the owner reads its local
+    column slice and a tiny (N,) psum replicates the values — the routing
+    decision costs one int32 per row per round, never a histogram column.
+    ``grp`` is the (N,) replicated GLOBAL group index per row."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_rows
+
+    def _local(bins_s, grp):
+        me = jax.lax.axis_index(axis)
+        local = grp.astype(jnp.int32) - me * gs
+        owned = (local >= 0) & (local < bins_s.shape[1])
+        idx = jnp.clip(local, 0, bins_s.shape[1] - 1)
+        vals = jnp.take_along_axis(bins_s, idx[:, None], axis=1)[:, 0]
+        with jax.named_scope("route_bin_psum"):
+            return jax.lax.psum(
+                jnp.where(owned, vals.astype(jnp.int32), 0), axis)
+
+    return shard_map_rows(_local, mesh, (P(None, axis), P()), P())
+
+
+def feature_bytes_per_round(num_slots: int, d: int, bmax: int,
+                            has_categorical: bool, n_rows: int = 0,
+                            num_class: int = 1) -> int:
+    """Analytic per-device payload DELIVERED per growth round under
+    tree_learner=feature: ZERO histogram bytes — only the 7-field
+    per-shard best-split records (all_gather), the owner-recomputed
+    categorical bitset psum when categorical features exist, and the
+    per-row route-bin psum (one int32 per row; pass n_rows=0 to count
+    split-decision traffic only)."""
+    rec = d * num_class * num_slots * 7 * 4
+    if has_categorical:
+        rec += num_class * num_slots * bmax * 4
+    return rec + n_rows * 4
+
+
+def voting_bytes_per_round(num_slots: int, num_features: int, top_k2: int,
+                           bmax: int, num_class: int = 1) -> int:
+    """Analytic per-device payload DELIVERED per growth round under
+    tree_learner=voting (PV-Tree): the one-hot vote psum (S x F f32) plus
+    ONLY the elected top-2k features' histogram columns (S x 2k x Bmax x
+    3 channels) — O(2k·B) instead of the data-parallel O(F·B)
+    (voting_parallel_tree_learner.cpp:104/396)."""
+    votes = num_class * num_slots * num_features * 4
+    elected = num_class * num_slots * top_k2 * bmax * 3 * 4
+    return votes + elected
+
+
 def hist_comms_bytes_per_round(num_slots: int, num_groups: int, bmax: int,
                                d: int, mode: str, dtype: str = "f32",
                                num_class: int = 1) -> int:

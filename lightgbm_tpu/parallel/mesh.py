@@ -85,6 +85,30 @@ def create_mesh(mesh_shape: str = "", tree_learner: str = "serial",
                  "Proceeding with the devices visible to this process.")
     if mesh_shape:
         names, sizes = parse_mesh_shape(mesh_shape)
+        # combined 2-axis meshes (e.g. "data:4,feature:2") would silently
+        # fall through learner selection — no learner consumes both axes,
+        # so the bins sharding and the split collectives would disagree.
+        # Refuse loudly until 2D (rows x feature-groups) sharding lands;
+        # trailing size-1 axes are harmless (their collectives are
+        # identities) and stay allowed for sweep tooling.
+        big = [f"{nm}:{sz}" for nm, sz in zip(names, sizes) if sz > 1]
+        if len(big) > 1:
+            raise LightGBMError(
+                f"mesh_shape {mesh_shape!r} requests a combined "
+                f"{' x '.join(big)} mesh; 2-axis sharding is not supported "
+                "yet — shard ONE axis (\"data:D\" with tree_learner=data/"
+                "voting, or \"feature:D\" with tree_learner=feature)")
+        if tree_learner == "feature" and FEATURE_AXIS not in names:
+            raise LightGBMError(
+                f"tree_learner=feature needs a mesh with a "
+                f"{FEATURE_AXIS!r} axis but mesh_shape {mesh_shape!r} "
+                f"names {names}; use e.g. \"feature:{n}\"")
+        if tree_learner in ("data", "voting") and FEATURE_AXIS in names \
+                and DATA_AXIS not in names:
+            raise LightGBMError(
+                f"tree_learner={tree_learner} shards rows but mesh_shape "
+                f"{mesh_shape!r} names only the {FEATURE_AXIS!r} axis; use "
+                f"e.g. \"{DATA_AXIS}:{n}\"")
         total = int(np.prod(sizes))
         if total > n:
             raise LightGBMError(f"mesh {mesh_shape} needs {total} devices, have {n}")
@@ -110,6 +134,10 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def bins_sharding(mesh: Mesh, tree_learner: str) -> NamedSharding:
+    if tree_learner == "feature" and FEATURE_AXIS not in mesh.axis_names:
+        raise LightGBMError(
+            f"tree_learner=feature needs a mesh with a {FEATURE_AXIS!r} "
+            f"axis; this mesh names {tuple(mesh.axis_names)}")
     if tree_learner == "feature" or (FEATURE_AXIS in mesh.axis_names
                                      and DATA_AXIS not in mesh.axis_names):
         return NamedSharding(mesh, P(None, FEATURE_AXIS))

@@ -60,17 +60,27 @@ class ShardedTrainState(NamedTuple):
     ok: jax.Array
 
 
-def state_shardings(mesh, row_axis: Optional[str], num_class: int
+def state_shardings(mesh, row_axis: Optional[str], num_class: int,
+                    replicate_rows: bool = False
                     ) -> Optional[ShardedTrainState]:
     """The explicit sharding pytree for a :class:`ShardedTrainState` —
     used as BOTH the in- and out-sharding of the fused step so row-axis
     arrays stay pinned to their devices across iterations.  ``None``
-    without a mesh (single-device runs let jit place everything)."""
-    if mesh is None or row_axis is None:
+    without a mesh (single-device runs let jit place everything).
+
+    ``replicate_rows``: the FEATURE-parallel variant (tree_learner=
+    feature) — the mesh shards bins' feature-group axis, so every per-row
+    state array is pinned fully REPLICATED instead; mixing a replicated
+    score with group-sharded bins is exactly the layout the fp grow
+    program's shard_maps expect, and an accidental row sharding here
+    would silently re-shard every iteration."""
+    if mesh is None or (row_axis is None and not replicate_rows):
         return None
     from jax.sharding import NamedSharding, PartitionSpec as P
-    row = NamedSharding(mesh, P(row_axis))
     rep = NamedSharding(mesh, P())
+    if replicate_rows:
+        return ShardedTrainState(*([rep] * len(ShardedTrainState._fields)))
+    row = NamedSharding(mesh, P(row_axis))
     if num_class == 1:
         score = grad = hess = row
         leaf = row

@@ -236,8 +236,39 @@ def voting_supported(layout, routing) -> bool:
     return True
 
 
+def compact_views_sharded(bins, grad, hess, cnt_w, compact_rows: int,
+                          mesh, row_axis):
+    """Per-shard GOSS/bagging row compaction for the voting learner: every
+    device stable-partitions its OWN row shard (in-bag rows first,
+    original relative order) and truncates to the static ``compact_rows``
+    capacity — no cross-device row movement.  The truncated tail carries
+    exact-zero weights, so every shard-local histogram (and therefore
+    every vote and every elected reduce) is bitwise identical to the
+    dense-masked pass (the SamplePlan contract, ops/compact.py)."""
+    from ..ops.compact import plan_sample_rows
+
+    def _local(b, g, h, c):
+        perm = plan_sample_rows(c, compact_rows).perm
+        return (jnp.take(b, perm, axis=0), jnp.take(g, perm, axis=0),
+                jnp.take(h, perm, axis=0), jnp.take(c, perm, axis=0))
+
+    with jax.named_scope("voting_compact_rows"):
+        if mesh is None:
+            return _local(bins, grad, hess, cnt_w)
+        from .mesh import shard_map_rows
+        row = P(row_axis)
+        return shard_map_rows(
+            _local, mesh,
+            (P(row_axis, None), row, row, row),
+            (P(row_axis, None), row, row, row))(bins, grad, hess, cnt_w)
+
+
 class _VoteState(NamedTuple):
     leaf_id: jax.Array
+    # compacted-view leaf ids (GOSS/bagging row compaction; (1,) dummy
+    # when compaction is off — histogram/vote passes route the compacted
+    # rows, the full-data route keeps `leaf_id` current for every row)
+    leaf_id_c: jax.Array
     split_feature: jax.Array
     threshold_bin: jax.Array
     dir_flags: jax.Array
@@ -266,13 +297,22 @@ class _VoteState(NamedTuple):
 
 
 def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
-                     splitter, params, routing: RoutingLayout
+                     splitter, params, routing: RoutingLayout,
+                     mesh=None, row_axis=None, compact_rows: int = 0
                      ) -> Tuple[TreeArrays, jax.Array]:
     """Voting-parallel batched leaf-wise growth (all layouts).
 
     Unlike ops.grow.grow_tree there is NO global histogram state: every round
     re-derives child best-splits through the elected-feature voting reduce
-    (reference: voting_parallel_tree_learner.cpp Train loop)."""
+    (reference: voting_parallel_tree_learner.cpp Train loop).
+
+    compact_rows: static PER-SHARD capacity for GOSS/bagging row
+    compaction (0 = off): one stable partition per tree gathers each
+    shard's in-bag rows to the front, every vote/histogram pass streams
+    only ``compact_rows`` rows per shard, and a per-round full-data
+    route-only pass keeps ``leaf_id`` current for all N rows (score
+    update).  Bitwise identical to the dense-masked pass — the truncated
+    tail carries exact-zero weights (ops/compact.SamplePlan contract)."""
     N, G = bins.shape
     L = params.num_leaves
     S = min(params.max_splits_per_round, max(L - 1, 1))
@@ -283,14 +323,23 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
         return leaf_output(g, h, params.lambda_l1, params.lambda_l2,
                            params.max_delta_step)
 
+    use_compact = compact_rows > 0
+    if use_compact:
+        bins_h, grad_h, hess_h, cnt_h = compact_views_sharded(
+            bins, grad, hess, cnt_w, compact_rows, mesh, row_axis)
+    else:
+        bins_h, grad_h, hess_h, cnt_h = bins, grad, hess, cnt_w
+    Nh = bins_h.shape[0]
+
     root_g, root_h, root_c = jnp.sum(grad), jnp.sum(hess), jnp.sum(cnt_w)
     (g0, f0, t0, d0, lg0, lh0, lc0, b0) = splitter_root(
-        bins, jnp.zeros(N, i32), grad, hess, cnt_w, root_g[None],
+        bins_h, jnp.zeros(Nh, i32), grad_h, hess_h, cnt_h, root_g[None],
         root_h[None], root_c[None], col_mask)
     Bmax = b0.shape[-1]
 
     state = _VoteState(
         leaf_id=jnp.zeros(N, i32),
+        leaf_id_c=jnp.zeros(Nh if use_compact else 1, i32),
         split_feature=jnp.zeros(L, i32), threshold_bin=jnp.zeros(L, i32),
         dir_flags=jnp.zeros(L, i32),
         left_child=jnp.zeros(L, i32), right_child=jnp.zeros(L, i32),
@@ -381,28 +430,36 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
         leaf_dir = jnp.zeros(L, i32).at[old_idx].set(dirf, mode="drop")
         leaf_bits = jnp.zeros((L, Bmax), bool).at[old_idx].set(bits,
                                                                mode="drop")
-        r_chosen = leaf_chosen[st.leaf_id]
-        r_feat = leaf_feat[st.leaf_id]
-        r_grp = routing.feat_group[r_feat]
-        gb = jnp.take_along_axis(bins, r_grp[:, None].astype(i32),
-                                 axis=1)[:, 0]
-        fb = feature_local_bin(gb, r_feat, routing)
-        r_thr = leaf_thr[st.leaf_id]
-        r_dir = leaf_dir[st.leaf_id]
-        is_cat = (r_dir & DIR_CATEGORICAL) != 0
-        default_left = (r_dir & DIR_DEFAULT_LEFT) != 0
-        is_nan = (routing.nan_bin[r_feat] >= 0) & (fb == routing.nan_bin[r_feat])
-        mzb_r = (routing.mzero_bin[r_feat] if routing.mzero_bin is not None
-                 else jnp.full_like(r_feat, -1))
-        is_miss = is_nan | ((mzb_r >= 0) & (fb == mzb_r))
-        go_left_num = jnp.where(is_miss, default_left, fb <= r_thr)
-        go_left_cat = leaf_bits.reshape(-1)[st.leaf_id * Bmax + fb]
-        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
-        new_leaf = jnp.where(r_chosen & ~go_left,
-                             leaf_new[st.leaf_id], st.leaf_id)
+
+        def route(bins_x, lid_x):
+            r_chosen = leaf_chosen[lid_x]
+            r_feat = leaf_feat[lid_x]
+            r_grp = routing.feat_group[r_feat]
+            gb = jnp.take_along_axis(bins_x, r_grp[:, None].astype(i32),
+                                     axis=1)[:, 0]
+            fb = feature_local_bin(gb, r_feat, routing)
+            r_thr = leaf_thr[lid_x]
+            r_dir = leaf_dir[lid_x]
+            is_cat = (r_dir & DIR_CATEGORICAL) != 0
+            default_left = (r_dir & DIR_DEFAULT_LEFT) != 0
+            is_nan = (routing.nan_bin[r_feat] >= 0) \
+                & (fb == routing.nan_bin[r_feat])
+            mzb_r = (routing.mzero_bin[r_feat]
+                     if routing.mzero_bin is not None
+                     else jnp.full_like(r_feat, -1))
+            is_miss = is_nan | ((mzb_r >= 0) & (fb == mzb_r))
+            go_left_num = jnp.where(is_miss, default_left, fb <= r_thr)
+            go_left_cat = leaf_bits.reshape(-1)[lid_x * Bmax + fb]
+            go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+            return jnp.where(r_chosen & ~go_left, leaf_new[lid_x], lid_x)
+
+        new_leaf = route(bins, st.leaf_id)
+        new_leaf_c = (route(bins_h, st.leaf_id_c) if use_compact
+                      else st.leaf_id_c)
 
         st2 = st2._replace(
             leaf_id=new_leaf,
+            leaf_id_c=new_leaf_c,
             sum_g=st2.sum_g.at[old_idx].set(lg, mode="drop")
                           .at[new_idx].set(rg, mode="drop"),
             sum_h=st2.sum_h.at[old_idx].set(lh, mode="drop")
@@ -418,12 +475,12 @@ def grow_tree_voting(bins, grad, hess, cnt_w, col_mask, splitter_root,
                                             mode="drop")
         slot_map = slot_map.at[new_idx].set(S + jnp.arange(S, dtype=i32),
                                             mode="drop")
-        slot2 = slot_map[new_leaf]
+        slot2 = slot_map[new_leaf_c if use_compact else new_leaf]
         ids2 = jnp.concatenate([pair_old, pair_new])
         valid2 = jnp.concatenate([pair_valid, pair_valid])
         (g2, f2, t2, d2, lg2, lh2, lc2, b2) = splitter(
-            bins, slot2, grad, hess, cnt_w, st2.sum_g[ids2], st2.sum_h[ids2],
-            st2.cnt[ids2], col_mask)
+            bins_h, slot2, grad_h, hess_h, cnt_h, st2.sum_g[ids2],
+            st2.sum_h[ids2], st2.cnt[ids2], col_mask)
         ids2_m = jnp.where(valid2, ids2, drop)
         st2 = st2._replace(
             best_gain=st2.best_gain.at[ids2_m].set(g2, mode="drop"),

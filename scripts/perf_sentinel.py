@@ -126,6 +126,13 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             unavailable.append(name)
             entries[name] = {"available": False,
                              "error": rec.get("error", "")}
+    # feature-parallel grow program (tree_learner=feature): measured in a
+    # SUBPROCESS on a forced 4-device CPU platform (this process's device
+    # count is fixed at jax init) with the fused path off, so grow_tree is
+    # its own watched jit and its XLA cost is attributable
+    entries["grow_tree_feature"] = _measure_feature_grow(w)
+    if entries["grow_tree_feature"].get("available") is False:
+        unavailable.append("grow_tree_feature")
     import jax
     return {
         "workload": w,
@@ -134,6 +141,70 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "launches_per_iter": round(launches_per_iter, 3),
         "unavailable": sorted(unavailable),
     }
+
+
+_FEATURE_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["LGBTPU_FUSE_ITER"] = "0"
+os.environ.pop("LGBTPU_COST", None)
+sys.path.insert(0, sys.argv[1])
+w = json.loads(sys.argv[2])
+# a sitecustomize hook (TPU containers) may have imported jax and
+# registered an accelerator backend at interpreter startup — env vars
+# alone are too late there (the tests/conftest.py pattern)
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+    clear_backends()
+except Exception:
+    pass
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import costmodel
+from lightgbm_tpu.telemetry.profile import _synthetic_data
+X, y = _synthetic_data(int(w["rows"]), int(w["features"]), int(w["seed"]))
+params = {"objective": "binary", "num_leaves": int(w["num_leaves"]),
+          "max_bin": int(w["max_bin"]), "learning_rate": 0.1,
+          "verbosity": -1, "telemetry": True, "telemetry_cost": "full",
+          "tree_learner": "feature"}
+bst = lgb.train(params, lgb.Dataset(X, label=y),
+                num_boost_round=int(w["iters"]))
+assert bst.engine._feature_mode
+rec = costmodel.cost_records().get("grow_tree",
+                                   {"available": False,
+                                    "error": "no grow_tree cost record"})
+print("FEATURE_COST " + json.dumps(rec))
+"""
+
+
+def _measure_feature_grow(w):
+    """Cost record of the feature-parallel grow program on the fixed
+    workload (4-device CPU mesh, subprocess).  Failure -> unavailable,
+    never zero."""
+    import subprocess
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LGBTPU_FUSE_ITER")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _FEATURE_CHILD, ROOT, json.dumps(w)],
+            capture_output=True, text=True, timeout=600, env=env)
+    except subprocess.TimeoutExpired:
+        return {"available": False, "error": "feature-grow child timed out"}
+    for line in r.stdout.splitlines():
+        if line.startswith("FEATURE_COST "):
+            rec = json.loads(line[len("FEATURE_COST "):])
+            if rec.get("available"):
+                return {k: rec[k] for k in
+                        ("flops", "bytes_accessed", "peak_hbm_bytes",
+                         "intensity", "verdict") if k in rec}
+            return {"available": False, "error": rec.get("error", "?")}
+    tail = (r.stdout + r.stderr)[-500:].replace("\n", " | ")
+    return {"available": False,
+            "error": f"feature-grow child failed (rc={r.returncode}): "
+                     f"{tail}"}
 
 
 def compare_budgets(measured: Dict[str, Any], budgets: Dict[str, Any]
@@ -184,7 +255,8 @@ def compare_budgets(measured: Dict[str, Any], budgets: Dict[str, Any]
 def _metric_direction(metric: str) -> int:
     """+1 = higher is better (throughput), -1 = lower is better."""
     m = metric.lower()
-    return +1 if ("qps" in m or "throughput" in m) else -1
+    return +1 if ("qps" in m or "throughput" in m
+                  or "rows_per_s" in m) else -1
 
 
 def check_history(path: str, tolerance: float = 0.25, min_runs: int = 3

@@ -59,6 +59,29 @@ XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
     | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
 --xla_force_host_platform_device_count=4" \
     python -m pytest tests/test_fused_sharded.py -x -q
+# wide-data learners on the same 4-device mesh: feature-parallel must be
+# BYTE-identical to serial across the layout matrix with zero histogram
+# wire traffic, voting (PV-Tree) must pass its layout/compaction/resume
+# matrix — the second device count for both (the full suites run the
+# default 8)
+echo "=== stage: feature/voting learner tier (D=4) ==="
+XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
+    | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
+--xla_force_host_platform_device_count=4" \
+    python -m pytest tests/test_feature_parallel.py tests/test_voting.py \
+    -x -q -m 'not slow'
+# wide-data bench smoke: reduced rows/features, single device count —
+# gates the structural payload claims (feature ships ZERO histogram
+# bytes, voting <= 2k elected columns, both beat data-parallel by the
+# predicted bytes/round ratios) plus AUC; BENCH_WIDE_SMOKE=1 never
+# clobbers the committed BENCH_WIDE.json artifact (the BENCH_GOSS lesson)
+echo "=== stage: wide-data bench smoke (BENCH_TASK=wide) ==="
+BENCH_TASK=wide \
+BENCH_WIDE_SMOKE=1 \
+BENCH_WIDE_F="${BENCH_WIDE_F:-512}" \
+BENCH_WIDE_ROWS="${BENCH_WIDE_ROWS:-6000}" \
+BENCH_HISTORY=0 \
+    python bench.py
 # out-of-core ingest fast tier: sketch-vs-exact boundary equivalence,
 # chunk/rank determinism, stream-vs-inmem tree bit-identity, and the
 # binned-cache corruption matrix (docs/INGEST.md) — the loaders every

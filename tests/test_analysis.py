@@ -148,6 +148,21 @@ def test_lgb003_variable_axis_clean(tmp_path):
     assert run_snippet(tmp_path, src, CollectiveAxisRule()) == []
 
 
+def test_lgb003_feature_axis_vocabulary(tmp_path):
+    """Importing FEATURE_AXIS from parallel.mesh binds 'feature' into the
+    module's axis vocabulary (the feature-parallel learner's collectives
+    — best-record all_gather, owner bitset / route-bin psum — all ride
+    this axis), while a typo'd spelling still trips."""
+    src = ("import jax\n"
+           "from lightgbm_tpu.parallel.mesh import FEATURE_AXIS\n"
+           "def local(h):\n"
+           "    good = jax.lax.all_gather(h, 'feature')\n"
+           "    return jax.lax.psum(good, 'featur')\n")           # line 5
+    found = run_snippet(tmp_path, src, CollectiveAxisRule())
+    assert [(f.rule, f.line) for f in found] == [("LGB003", 5)]
+    assert "feature" in found[0].message
+
+
 def test_lgb004_determinism_trips(tmp_path):
     src = ("import time\n"
            "import numpy as np\n"

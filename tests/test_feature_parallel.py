@@ -1,0 +1,321 @@
+"""Feature-parallel learner (tree_learner=feature) on the CPU mesh.
+
+Reference: src/treelearner/feature_parallel_tree_learner.cpp:25-83 — every
+worker scans only its own feature subset and the workers Allreduce nothing
+but SplitInfo records.  Here bins is sharded over its feature-GROUP axis,
+each device builds histograms and runs the full split scan over ONLY its
+G/D group slice (parallel/comms.py ShardPlan sub-layouts), and the 7-field
+per-shard best records are all_gathered with the exact (max gain, lowest
+global feature id) tie-break — ZERO histogram bytes cross the wire.
+
+Discipline (docs/DISTRIBUTED.md): trees BYTE-IDENTICAL to the serial
+learner across the layout matrix with the fused path off; the fused
+one-launch path proves itself with the PR 10 round-1-byte + structural
+ulp identity.  Runs on the conftest 8-device CPU mesh and on the 4-device
+tier run_all_tests.sh adds.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import host_sync_count, launch_count
+from lightgbm_tpu.utils.log import LightGBMError
+
+from conftest import (make_synthetic_binary, make_synthetic_multiclass,
+                      make_synthetic_regression)
+
+N_DEV = len(jax.devices())
+MESHES = [d for d in (4, 8) if d <= N_DEV]
+needs_mesh = pytest.mark.skipif(N_DEV < 4, reason="needs a >=4-device mesh")
+
+
+def _strip_params(model_str: str) -> str:
+    return model_str.split("\nparameters:")[0]
+
+
+def _set_env(name, value):
+    """Set/unset an env var, returning a restore callable that puts the
+    PRIOR value back (a bare del would clobber a caller's export)."""
+    prior = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+
+    def restore():
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
+    return restore
+
+
+def _train(params, X, y, learner, rounds=4, mesh_dev=None, fuse="0",
+           **ds_kw):
+    p = dict(params, verbosity=-1, tree_learner=learner)
+    if mesh_dev and learner == "feature":
+        p["mesh_shape"] = f"feature:{mesh_dev}"
+    restore = _set_env("LGBTPU_FUSE_ITER", fuse) if fuse is not None \
+        else (lambda: None)
+    try:
+        return lgb.train(p, lgb.Dataset(X, label=y, **ds_kw),
+                         num_boost_round=rounds)
+    finally:
+        restore()
+
+
+def _assert_serial_identity(params, X, y, rounds=4, mesh_dev=None, **ds_kw):
+    """Feature-parallel trees must match the serial learner BYTE-for-byte
+    (fused off on both arms so the gradient programs are identical)."""
+    s = _train(params, X, y, "serial", rounds, **ds_kw)
+    f = _train(params, X, y, "feature", rounds, mesh_dev=mesh_dev, **ds_kw)
+    assert f.engine._feature_mode, "feature learner should be active"
+    assert _strip_params(s.model_to_string()) == \
+        _strip_params(f.model_to_string())
+    return f
+
+
+# ---------------------------------------------------------------------------
+# layout matrix: byte identity vs serial at 4- and 8-way meshes
+# ---------------------------------------------------------------------------
+
+def _layouts():
+    """numeric+NaN, categorical, EFB-bundled, weighted — the distributed
+    layout matrix (mirrors tests/test_distributed._datasets)."""
+    rs = np.random.RandomState(7)
+    out = []
+    X, y = make_synthetic_binary(n=3000)
+    X = X.copy()
+    X[::13, 2] = np.nan
+    out.append(("binary_nan", {"objective": "binary"},
+                dict(data=X, label=y), {}))
+    Xr, yr = make_synthetic_regression(n=2500, f=8, seed=7)
+    Xr = Xr.copy()
+    Xr[:, 3] = rs.randint(0, 6, len(Xr))
+    w = rs.rand(len(Xr)) + 0.5
+    out.append(("reg_cat_weight", {"objective": "regression"},
+                dict(data=Xr, label=yr, weight=w),
+                {"categorical_feature": [3]}))
+    Xs = np.zeros((2000, 12))
+    Xs[:, :4] = rs.randn(2000, 4)
+    hot = rs.randint(4, 12, 2000)
+    Xs[np.arange(2000), hot] = 1.0
+    ys = Xs[:, 0] + 2.0 * (hot == 5) - (hot == 9) + 0.05 * rs.randn(2000)
+    out.append(("reg_efb", {"objective": "regression"},
+                dict(data=Xs, label=ys), {}))
+    return out
+
+
+@needs_mesh
+@pytest.mark.parametrize("mesh_dev", MESHES)
+@pytest.mark.parametrize("name,params,data_kw,ds_kw",
+                         _layouts(), ids=[t[0] for t in _layouts()])
+def test_feature_parallel_bit_identical(name, params, data_kw, ds_kw,
+                                        mesh_dev):
+    p = dict(params, num_leaves=15, min_data_in_leaf=5)
+    _assert_serial_identity(p, data_kw["data"], data_kw["label"],
+                            mesh_dev=mesh_dev,
+                            weight=data_kw.get("weight"), **ds_kw)
+
+
+@needs_mesh
+def test_feature_parallel_multiclass_bit_identical():
+    """K class trees ride the per-class lax.scan (one launch) under the
+    feature mesh and stay byte-identical to serial."""
+    X, y = make_synthetic_multiclass(n=2000, f=8, k=3)
+    _assert_serial_identity({"objective": "multiclass", "num_class": 3,
+                             "num_leaves": 11, "min_data_in_leaf": 5},
+                            X, y, rounds=3)
+
+
+@needs_mesh
+def test_feature_parallel_feature_fraction_identical():
+    """The tree-level column mask rides the replicated col_mask into every
+    shard-local scan — same RNG draw, same trees."""
+    X, y = make_synthetic_binary(n=2000, f=10)
+    _assert_serial_identity({"objective": "binary", "num_leaves": 15,
+                             "min_data_in_leaf": 5,
+                             "feature_fraction": 0.6, "seed": 3}, X, y)
+
+
+@needs_mesh
+def test_feature_parallel_goss_compaction_identical():
+    """GOSS row compaction under the feature mesh: rows are replicated, so
+    the stable-partition compact view is single-device-shaped; any
+    covering capacity grows the identical tree."""
+    X, y = make_synthetic_binary(n=4000, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "data_sample_strategy": "goss", "learning_rate": 0.5,
+         "top_rate": 0.1, "other_rate": 0.15}
+    restore = _set_env("LGBTPU_COMPACT", "off")
+    try:
+        off = _train(p, X, y, "feature", rounds=6)
+    finally:
+        restore()
+    on = _train(p, X, y, "feature", rounds=6)
+    assert on.engine._last_compact_rows > 0, "compaction never engaged"
+    assert _strip_params(off.model_to_string()) == \
+        _strip_params(on.model_to_string())
+    # and the compacted run still matches serial byte-for-byte
+    s = _train(p, X, y, "serial", rounds=6)
+    assert _strip_params(s.model_to_string()) == \
+        _strip_params(on.model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# fused one-launch path
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_feature_parallel_fused_identity():
+    """Fused (default) vs unfused: round-1 tree byte-equal, later rounds
+    structurally identical with ulp float tolerance (the PR 10
+    non-associativity discipline — XLA re-fuses the wider program's
+    gradient chain)."""
+    from tests.test_fused_sharded import _assert_fused_identity
+
+    X, y = make_synthetic_binary(n=2000, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5}
+    f = _train(p, X, y, "feature", fuse=None)
+    assert f.engine._fused_last, "fused path did not engage by default"
+    u = _train(p, X, y, "feature", fuse="0")
+    assert not u.engine._fused_last
+    _assert_fused_identity(f.model_to_string(), u.model_to_string())
+
+
+@needs_mesh
+def test_feature_parallel_single_launch_zero_syncs():
+    """The acceptance contract: <= 1 jitted launch per boosting iteration
+    and 0 host syncs/iter on the fused feature-parallel path."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5}, X, y, "feature", rounds=2,
+                 fuse=None)
+    l0, s0 = launch_count(), host_sync_count()
+    for _ in range(4):
+        bst.update()
+    assert (launch_count() - l0) / 4 <= 1.5
+    assert (host_sync_count() - s0) / 4 == 0.0
+
+
+@needs_mesh
+def test_feature_parallel_state_replicated():
+    """Satellite contract (ISSUE 15): every per-row array — score, grad,
+    hess, mask, leaf routing — is pinned fully REPLICATED across the
+    feature mesh, and the fused state keeps that placement."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5}, X, y, "feature", fuse=None)
+    eng = bst.engine
+    assert eng.score.sharding.is_fully_replicated
+    st = eng._train_state
+    assert st is not None and st.score is eng.score
+    for name in ("score", "grad", "hess", "leaf_id", "mask"):
+        arr = getattr(st, name)
+        assert arr.sharding.is_fully_replicated, \
+            f"state.{name} lost replication: {arr.sharding}"
+    # bins stays sharded over its GROUP axis
+    spec = tuple(eng.dd.bins.sharding.spec)
+    assert eng._feature_axis in spec
+
+
+# ---------------------------------------------------------------------------
+# comms accounting: zero histogram payload
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_feature_parallel_zero_hist_bytes():
+    """comms/hist_bytes carries ONLY split-record traffic: the analytic
+    histogram-column payload is exactly 0 and the per-round record bytes
+    are orders of magnitude below the data-parallel block."""
+    from lightgbm_tpu.telemetry import global_registry
+
+    X, y = make_synthetic_binary(n=1500, f=8)
+    global_registry.reset()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "tree_learner": "feature",
+                     "telemetry": True},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    cm = bst.engine._comms_model()
+    assert cm["mode"] == "feature"
+    assert cm["hist_block_bytes"] == 0
+    recs = [r for r in global_registry.records
+            if r.get("event") == "iteration"]
+    assert recs[-1]["comms_mode"] == "feature"
+    # record payload: 7 fields x 4 bytes x slots x shards (+ cat bitsets)
+    from lightgbm_tpu.parallel.comms import hist_comms_bytes_per_round
+    gp = bst.engine._grow_params
+    S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+    d = cm["devices"]
+    psum_block = hist_comms_bytes_per_round(
+        2 * S, bst.engine.dd.num_groups, bst.engine.dd.max_bins, d, "psum")
+    assert cm["per_round_bytes"] * 20 < psum_block
+
+
+# ---------------------------------------------------------------------------
+# validation: loud errors instead of silent fallthrough
+# ---------------------------------------------------------------------------
+
+def test_combined_mesh_rejected():
+    """data:X,feature:Y combined meshes raise instead of silently falling
+    through learner selection (no learner consumes both axes yet)."""
+    X, y = make_synthetic_binary(n=500, f=4)
+    with pytest.raises(LightGBMError, match="2-axis"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "mesh_shape": "data:2,feature:2"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+@needs_mesh
+def test_feature_learner_needs_feature_axis():
+    X, y = make_synthetic_binary(n=500, f=4)
+    with pytest.raises(LightGBMError, match="feature"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "tree_learner": "feature", "mesh_shape": "data:4"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+@needs_mesh
+def test_feature_learner_rejects_constraints():
+    X, y = make_synthetic_binary(n=500, f=4)
+    with pytest.raises(LightGBMError, match="tree_learner=feature"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "tree_learner": "feature",
+                   "monotone_constraints": [1, 0, 0, 0]},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+@needs_mesh
+def test_feature_learner_rejects_stream_backend():
+    X, y = make_synthetic_binary(n=500, f=4)
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "tree_learner": "feature", "hist_backend": "stream"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_feature_parallel_checkpoint_resume(tmp_path):
+    """A mid-run snapshot resumes BIT-IDENTICALLY under the feature mesh
+    (same discipline as the data-parallel sharded-state resume suite)."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "tree_learner": "feature", "min_data_in_leaf": 5,
+         "snapshot_freq": 3, "snapshot_keep": 8}
+    out = str(tmp_path / "model.txt")
+    full = lgb.train(dict(p, output_model=out), lgb.Dataset(X, label=y),
+                     num_boost_round=6)
+    snap = out + ".snapshot_iter_3"
+    assert os.path.exists(snap)
+    resumed = lgb.train(dict(p, resume_from=snap, output_model=out),
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _strip_params(full.model_to_string()) == \
+        _strip_params(resumed.model_to_string())
