@@ -14,7 +14,9 @@ f-strings whose literal skeleton matches a reviewed low-cardinality
 allow-list:
 
   * ``fleet/replica/<r>/...`` — bounded by ``serve_replicas``;
-  * ``recompile/<name>`` — bounded by the watched_jit entry-point set.
+  * ``recompile/<name>`` — bounded by the watched_jit entry-point set;
+  * ``drift/feature/<i>/...`` — bounded by ``quality_topk``;
+  * ``quality/audit/<field>`` — bounded by the fixed audit stat set.
 
 Everything else — bare variables, ``+`` concatenation, ``%``/
 ``str.format``, unlisted f-strings — is flagged.  Names are data, not
@@ -44,6 +46,13 @@ _ALLOWED_SKELETONS = (
     # cost/<entry>/<field> — bounded by the watched_jit entry-point set
     # (same budget as recompile/<name>); LGB010 keeps the names stable
     re.compile(r"^cost/\*/[a-z0-9_]+$"),
+    # drift/feature/<i>/<field> — bounded by quality_topk (config): only
+    # the current top-k drifted features mint series, never one per
+    # traffic-observed value
+    re.compile(r"^drift/feature/\*/[a-z0-9_]+$"),
+    # quality/audit/<field> — bounded by the fixed shadow-audit stat set
+    # (rows/mismatches/pending/dropped)
+    re.compile(r"^quality/audit/\*$"),
 )
 
 

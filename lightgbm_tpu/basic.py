@@ -1684,10 +1684,30 @@ class Booster:
         # tmp + os.replace: the serving registry hot-reloads model files by
         # path, so a torn write must never be observable (lgbtlint LGB005)
         from .robustness.checkpoint import atomic_write_text
-        atomic_write_text(str(filename),
-                          self.model_to_string(num_iteration, start_iteration,
-                                               importance_type))
+        text = self.model_to_string(num_iteration, start_iteration,
+                                    importance_type)
+        atomic_write_text(str(filename), text)
+        self._write_quality_sidecar(str(filename), text)
         return self
+
+    def _write_quality_sidecar(self, filename: str, text: str) -> None:
+        """Best-effort ``<model>.quality.json`` reference profile next to
+        a trained model (docs/OBSERVABILITY.md "Data & model quality").
+        Loaded boosters have no binned matrix, so only a training-side
+        save emits one; a sidecar failure never fails the model save."""
+        if self._engine is None or self.train_set is None \
+                or getattr(self.train_set, "binned", None) is None:
+            return
+        cfg = getattr(self, "config", None)
+        if cfg is not None and not getattr(cfg, "quality_profile", True):
+            return
+        try:
+            from .telemetry.quality import QualityProfile
+            QualityProfile.from_booster(self, text).save(filename)
+        except Exception as exc:
+            from .utils.log import log_warning
+            log_warning(f"quality: sidecar write failed for {filename}: "
+                        f"{exc}")
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,

@@ -222,6 +222,13 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "serve_slo_p99_ms": ["slo_p99_ms", "slo_latency_target_ms"],
     "serve_slo_window_s": ["slo_window"],
     "serve_slo_burn": ["slo_burn_threshold"],
+    "quality_profile": ["quality_sidecar"],
+    "quality_sample": ["drift_sample"],
+    "quality_audit_sample": ["shadow_audit_sample"],
+    "quality_min_rows": ["drift_min_rows"],
+    "quality_topk": ["drift_topk"],
+    "drift_threshold": ["drift_psi_threshold"],
+    "drift_window_s": ["drift_window"],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -674,6 +681,32 @@ class Config:
     # burn-rate alert threshold: budget consumed this many times faster
     # than steady-state fires the SLO alert (Google SRE workbook pairing)
     serve_slo_burn: float = 14.4
+    # write the .quality.json reference-profile sidecar next to the model
+    # on save_model (per-feature bin histograms + score/label histograms
+    # + holdout metric; docs/OBSERVABILITY.md "Data & model quality")
+    quality_profile: bool = True
+    # serving: per-BATCH sampling probability for drift accumulation
+    # (feature/score histograms vs the reference profile); 0 disables
+    # drift monitoring entirely, default is small so the binary-wire hot
+    # path pays ~nothing
+    quality_sample: float = 0.01
+    # serving: per-request sampling probability for the train-vs-serve
+    # shadow audit (background Booster.predict re-score, bitwise f64
+    # compare against the wire-returned values); 0 disables the audit
+    quality_audit_sample: float = 0.01
+    # minimum sampled rows in the fast window before the drift alert is
+    # allowed to fire (thin traffic must not page)
+    quality_min_rows: int = 200
+    # how many top-drifted features /drift and the drift/feature/<i>/*
+    # gauges report (bounds the per-feature metric cardinality)
+    quality_topk: int = 5
+    # PSI level at which the drift alert fires: the fast AND slow windows
+    # must both reach it (fires), the fast window alone clears it;
+    # 0.2 is the textbook "significant shift" level
+    drift_threshold: float = 0.2
+    # fast drift window in seconds (the slow window is 12x longer,
+    # mirroring the SLO burn-rate pairing)
+    drift_window_s: float = 60.0
 
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records
@@ -814,6 +847,26 @@ class Config:
         if self.serve_slo_burn <= 0:
             raise LightGBMError(
                 f"serve_slo_burn={self.serve_slo_burn} must be > 0")
+        if not 0.0 <= self.quality_sample <= 1.0:
+            raise LightGBMError(
+                f"quality_sample={self.quality_sample} must be a "
+                "probability in [0, 1]")
+        if not 0.0 <= self.quality_audit_sample <= 1.0:
+            raise LightGBMError(
+                f"quality_audit_sample={self.quality_audit_sample} must "
+                "be a probability in [0, 1]")
+        if self.quality_min_rows < 1:
+            raise LightGBMError(
+                f"quality_min_rows={self.quality_min_rows} must be >= 1")
+        if self.quality_topk < 1:
+            raise LightGBMError(
+                f"quality_topk={self.quality_topk} must be >= 1")
+        if self.drift_threshold <= 0:
+            raise LightGBMError(
+                f"drift_threshold={self.drift_threshold} must be > 0")
+        if self.drift_window_s <= 0:
+            raise LightGBMError(
+                f"drift_window_s={self.drift_window_s} must be > 0")
         # GOSS parameter conflicts (reference: Config::CheckParamConflict,
         # src/io/config.cpp — "cannot use bagging in GOSS" and the sampled
         # fractions must partition the data)

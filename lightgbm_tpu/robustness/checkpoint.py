@@ -77,6 +77,11 @@ _VOLATILE_PARAMS = frozenset({
     "serve_trace_sample", "serve_trace_tail", "serve_access_log",
     "serve_slo_availability", "serve_slo_p99_ms", "serve_slo_window_s",
     "serve_slo_burn",
+    # quality observability: the sidecar + drift monitor read the model,
+    # they never shape it
+    "quality_profile", "quality_sample", "quality_audit_sample",
+    "quality_min_rows", "quality_topk", "drift_threshold",
+    "drift_window_s",
 })
 
 

@@ -141,6 +141,10 @@ class MicroBatcher:
         self._submit_lock = threading.Lock()
         self._drain = True
         self._worker: Optional[threading.Thread] = None
+        # optional QualityMonitor (set by ServingApp): drift accumulation
+        # + shadow-audit capture on the dispatch path, both behind their
+        # own sampling draws — None keeps the hot path untouched
+        self.quality = None
         self.batches = 0
         self.served = 0
         self.rejected = 0
@@ -335,23 +339,37 @@ class MicroBatcher:
         with dispatch_span:
             if n == 1 and len(good) == 1:
                 # a lone singleton skips the device: native single-row walk
-                values = model.predict(good[0].rows,
-                                       raw_score=good[0].raw_score)
-                good[0].resolve(PredictResult(
-                    values, model.version, 1,
-                    t0 - good[0].t_enqueue))
+                # (raw_scores has the pre-bound n==1 path — this is the
+                # model.predict code path with submit-time validation)
+                raw = model.raw_scores(good[0].rows)
             else:
                 with (telemetry.span("serve/device", rows=n,
                                      trace_ids=sampled)
                       if sampled else _NULL_DISPATCH):
                     raw = model.raw_scores(X)
+            off = 0
+            for r in good:
+                m = r.rows.shape[0]
+                r.resolve(PredictResult(
+                    model.finish(raw[off:off + m], r.raw_score),
+                    model.version, n, t0 - r.t_enqueue))
+                off += m
+        q = self.quality
+        if q is not None:
+            # drift accumulation + shadow-audit capture; each call does
+            # its own sampling draw, and neither may ever break serving
+            try:
                 off = 0
                 for r in good:
                     m = r.rows.shape[0]
-                    r.resolve(PredictResult(
-                        model.finish(raw[off:off + m], r.raw_score),
-                        model.version, n, t0 - r.t_enqueue))
+                    q.offer_audit(model, r.rows, raw[off:off + m],
+                                  r.raw_score,
+                                  r.trace.trace_id if r.trace is not None
+                                  else None)
                     off += m
+                q.observe_batch(model, X, raw)
+            except Exception as e:   # noqa: BLE001
+                log_debug(f"serve quality hook failed: {e}")
         dt = time.perf_counter() - t0
         with self._submit_lock:
             self.batches += 1

@@ -232,8 +232,18 @@ def _replica_main(spec_path: str, rank: int) -> int:
         # (published in replica_<r>.json below) — replica-aware clients
         # (wire.FleetBinaryClient) discover and route around failures
         binary_port=(0 if int(spec.get("binary_port", -1)) >= 0 else -1),
-        binary_accept_threads=int(spec.get("binary_accept_threads", 2)))
+        binary_accept_threads=int(spec.get("binary_accept_threads", 2)),
+        quality_sample=float(spec.get("quality_sample", 0.01)),
+        quality_audit_sample=float(spec.get("quality_audit_sample", 0.01)),
+        drift_threshold=float(spec.get("drift_threshold", 0.2)),
+        drift_window_s=float(spec.get("drift_window_s", 60.0)),
+        quality_min_rows=int(spec.get("quality_min_rows", 200)),
+        quality_topk=int(spec.get("quality_topk", 5)))
     app.replica_rank = rank
+    # per-replica drift snapshot export (merged by `python -m
+    # lightgbm_tpu.telemetry.quality report <fleet_dir>`)
+    app.drift_export_path = os.path.join(fleet_dir,
+                                         f"drift_replica_{rank}.json")
     app.generation = int(pointer["generation"])
     app.seen_generation = app.generation
 
@@ -338,6 +348,10 @@ class ServingFleet:
                  slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
                  slo_window_s: float = 60.0, slo_burn: float = 14.4,
                  binary_port: int = -1, binary_accept_threads: int = 2,
+                 quality_sample: float = 0.01,
+                 quality_audit_sample: float = 0.01,
+                 drift_threshold: float = 0.2, drift_window_s: float = 60.0,
+                 quality_min_rows: int = 200, quality_topk: int = 5,
                  python: str = sys.executable):
         from .server import reuseport_available
 
@@ -414,6 +428,15 @@ class ServingFleet:
             "ephemeral_dir": self._own_dir,
             "binary_port": int(binary_port),
             "binary_accept_threads": int(binary_accept_threads),
+            # data/model quality knobs ride to every replica; the
+            # .quality.json sidecar itself travels with the model path,
+            # so promotion carries it without fleet help
+            "quality_sample": float(quality_sample),
+            "quality_audit_sample": float(quality_audit_sample),
+            "drift_threshold": float(drift_threshold),
+            "drift_window_s": float(drift_window_s),
+            "quality_min_rows": int(quality_min_rows),
+            "quality_topk": int(quality_topk),
             **self.slo_params,
         }
         self._spec_path = os.path.join(self.dir, "replica_spec.json")
@@ -769,7 +792,13 @@ def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
         slo_window_s=cfg.serve_slo_window_s,
         slo_burn=cfg.serve_slo_burn,
         binary_port=cfg.serve_binary_port,
-        binary_accept_threads=cfg.serve_binary_accept_threads)
+        binary_accept_threads=cfg.serve_binary_accept_threads,
+        quality_sample=cfg.quality_sample,
+        quality_audit_sample=cfg.quality_audit_sample,
+        drift_threshold=cfg.drift_threshold,
+        drift_window_s=cfg.drift_window_s,
+        quality_min_rows=cfg.quality_min_rows,
+        quality_topk=cfg.quality_topk)
 
 
 def run_fleet(params: Dict[str, Any]) -> int:

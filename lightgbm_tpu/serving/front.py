@@ -547,6 +547,34 @@ class FanoutFront:
                                    "replica": str(rank)}, snap))
         return render_parts(parts)
 
+    def drift_payload(self) -> Dict[str, Any]:
+        """Fleet-aggregate drift view: one ``/drift`` scrape per live
+        replica, merged like the report CLI (same cadence tradeoff as
+        ``/metrics/fleet`` — a view endpoint, not a hot path)."""
+        replicas: Dict[str, Any] = {}
+        any_alerting = False
+        available = False
+        audit_rows = audit_mismatches = 0
+        for rank, ep in sorted(self.fleet.endpoints().items()):
+            try:
+                st, snap, _ = http_json(ep["host"], ep["port"], "GET",
+                                        "/drift",
+                                        timeout=_READY_TIMEOUT_S)
+            except (OSError, http.client.HTTPException):
+                continue
+            if st != 200 or not isinstance(snap, dict):
+                continue
+            replicas[str(rank)] = snap
+            available = available or bool(snap.get("available"))
+            any_alerting = any_alerting or bool(snap.get("alerting"))
+            audit_rows += int(snap.get("audit", {}).get("rows", 0))
+            audit_mismatches += int(
+                snap.get("audit", {}).get("mismatches", 0))
+        return {"available": available, "any_alerting": any_alerting,
+                "audit": {"rows": audit_rows,
+                          "mismatches": audit_mismatches},
+                "replicas": replicas}
+
     def ready_payload(self) -> Tuple[int, Dict[str, Any]]:
         with self._lock:
             ready = dict(self._ready)
@@ -612,6 +640,8 @@ class _FrontHandler(BaseHTTPRequestHandler):
             self._send(*self.front.ready_payload())
         elif path == "/stats":
             self._send(200, self.front.describe())
+        elif path == "/drift":
+            self._send(200, self.front.drift_payload())
         elif path in ("/metrics", "/metrics/fleet"):
             from ..telemetry.prometheus import CONTENT_TYPE
             body = self.front.metrics_text(

@@ -65,6 +65,10 @@ class ServingModel:
         # + generic tail below == the Booster.predict n==1 path exactly)
         self._fast = SingleRowFastPredictor(self._trees, self.num_class,
                                             self.num_features)
+        # training-time quality reference profile (attached by the
+        # registry from the .quality.json sidecar; None when the sidecar
+        # is missing/corrupt/mismatched — drift reports available:false)
+        self.quality = None
         try:
             self._compiled: Optional[CompiledPredictor] = CompiledPredictor(
                 self._trees, self.num_class, self.num_features,
@@ -140,6 +144,7 @@ class ServingModel:
             "compiled": self._compiled is not None,
             "buckets": list(self._compiled.buckets) if self._compiled else [],
             "loaded_unix": self.loaded_unix,
+            "quality": self.quality is not None,
         }
 
 
@@ -205,6 +210,11 @@ class ModelRegistry:
                                  buckets=self._buckets)
             if self._warmup and model._compiled is not None:
                 model._compiled.warmup()
+            # quality sidecar rides the model path, so hot-reload and
+            # fleet promotion carry it for free; a bad sidecar degrades
+            # to quality=None, never a load failure
+            from ..telemetry.quality import QualityProfile
+            model.quality = QualityProfile.load_for_model(str(path), sha)
         except (OSError, UnicodeDecodeError) as e:
             # counters mutate under the lock: /reload handler threads and
             # an embedding caller can race here (lgbtlint LGB006)

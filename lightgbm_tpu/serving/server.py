@@ -122,8 +122,13 @@ class ServingApp:
                  trace_tail: int = 256, access_log: str = "",
                  slo_availability: float = 0.999, slo_p99_ms: float = 0.0,
                  slo_window_s: float = 60.0, slo_burn: float = 14.4,
-                 binary_port: int = -1, binary_accept_threads: int = 2):
+                 binary_port: int = -1, binary_accept_threads: int = 2,
+                 quality_sample: float = 0.01,
+                 quality_audit_sample: float = 0.01,
+                 drift_threshold: float = 0.2, drift_window_s: float = 60.0,
+                 quality_min_rows: int = 200, quality_topk: int = 5):
         from ..telemetry import AccessLog, TailRing
+        from ..telemetry.quality import QualityMonitor
         from .slo import SLOMonitor
 
         self.registry = ModelRegistry(model_path, max_batch=max_batch,
@@ -174,6 +179,20 @@ class ServingApp:
                               p99_target_ms=slo_p99_ms,
                               window_s=slo_window_s,
                               burn_threshold=slo_burn)
+        # data/model quality: drift monitor + shadow audit riding the
+        # batcher dispatch path; the sidecar profile follows the registry
+        # model (docs/OBSERVABILITY.md "Data & model quality")
+        self.quality = QualityMonitor(threshold=drift_threshold,
+                                      window_s=drift_window_s,
+                                      sample=quality_sample,
+                                      audit_sample=quality_audit_sample,
+                                      min_rows=quality_min_rows,
+                                      topk=quality_topk)
+        if self.quality.enabled:
+            self.batcher.quality = self.quality
+        # per-replica drift snapshot export for the fleet report CLI
+        # (set by serving.fleet's replica loop)
+        self.drift_export_path: str = ""
         # the SLO ticker runs on its own loop (not per-request) so an
         # alert also CLEARS while the replica is idle — e.g. when the
         # front stopped routing here because of the very burn that fired
@@ -200,6 +219,16 @@ class ServingApp:
     def _slo_loop(self) -> None:
         while not self._slo_stop.wait(1.0):
             self.slo.tick()
+            if self.quality.enabled:
+                try:
+                    self.quality.tick(model=self.registry.current())
+                    self.quality.audit_once()
+                    if self.drift_export_path:
+                        from ..telemetry.quality import write_snapshot
+                        write_snapshot(self.drift_export_path,
+                                       self.quality.snapshot())
+                except Exception as e:   # noqa: BLE001 — ticker survives
+                    log_debug(f"quality tick failed: {e}")
 
     def start(self) -> "ServingApp":
         """Non-blocking start (tests, embedding); ``run_server`` blocks."""
@@ -249,6 +278,11 @@ class ServingApp:
         extra: Dict[str, Any] = {"rows": obj.get("batched_rows")}
         if self.replica_rank is not None:
             extra["replica"] = self.replica_rank
+        # drift snapshot rides the access log only while the alert is
+        # active — healthy traffic logs stay lean
+        drift = self.quality.brief()
+        if drift is not None:
+            extra["drift"] = drift
         # replicas see single attempts (retries=0); the front stamps
         # real retry counts in ITS log
         note_outcome(ctx=ctx, status=status, latency_ms=latency_ms,
@@ -324,6 +358,11 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/stats":
             with telemetry.span("serve/stats"):
                 self._send(200, self._stats())
+        elif path == "/drift":
+            # data/model quality surface: alert state, top-k drifted
+            # features with PSI/JS, shadow-audit totals; available:false
+            # (never zeros) when the model has no quality sidecar
+            self._send(200, self.app.quality.snapshot())
         elif path == "/metrics":
             # Prometheus text exposition of the process registry;
             # ?format=json returns the raw snapshot (what the fleet
@@ -540,6 +579,14 @@ class _Handler(BaseHTTPRequestHandler):
             out["slo_alert"] = slo_state["alert"]
             reasons.append(f"slo burn: {slo_state['alert']} error budget "
                            f"burning >= {app.slo.burn_threshold:.1f}x")
+        if app.quality.alerting:
+            # drift is a quality degradation, not an outage: the replica
+            # keeps serving (stale != broken), the reason surfaces here
+            # and the refit pipeline keys off the drift/* gauges
+            out["drift_alert"] = True
+            reasons.append(f"data drift: PSI >= "
+                           f"{app.quality.threshold:g} vs training "
+                           "reference (see /drift)")
         if reasons:
             out["degraded"] = "; ".join(reasons)
         if b.heartbeat_path:
@@ -574,6 +621,11 @@ class _Handler(BaseHTTPRequestHandler):
             # the full rollup incl. roofline peaks rides telemetry_summary
             "cost": telemetry.cost_summary(),
             "slo": app.slo.state(),
+            "quality": {"available": app.quality.snapshot().get(
+                            "available", False),
+                        "alerting": app.quality.alerting,
+                        "sample": app.quality.sample,
+                        "audit_sample": app.quality.audit_sample},
             "trace_tail": app.tail.snapshot(last=20),
             "trace_sample": app.trace_sample,
             "binary": (app.binary.stats() if app.binary is not None
@@ -607,7 +659,13 @@ def serve_from_params(params: Dict[str, Any]) -> ServingApp:
         slo_window_s=cfg.serve_slo_window_s,
         slo_burn=cfg.serve_slo_burn,
         binary_port=cfg.serve_binary_port,
-        binary_accept_threads=cfg.serve_binary_accept_threads)
+        binary_accept_threads=cfg.serve_binary_accept_threads,
+        quality_sample=cfg.quality_sample,
+        quality_audit_sample=cfg.quality_audit_sample,
+        drift_threshold=cfg.drift_threshold,
+        drift_window_s=cfg.drift_window_s,
+        quality_min_rows=cfg.quality_min_rows,
+        quality_topk=cfg.quality_topk)
 
 
 def run_server(params: Dict[str, Any]) -> int:

@@ -32,6 +32,8 @@ from .costmodel import cost_summary, machine_balance
 from .metrics import (MetricsRegistry, device_memory_gb, global_registry,
                       host_rss_gb, memory_snapshot)
 from .prometheus import registry_text, render_parts, render_prometheus
+from .quality import (QualityMonitor, QualityProfile, js_divergence,
+                      psi, quality_sidecar_path)
 from .tracer import SpanTracer, global_tracer
 from .watchdog import (WatchEntry, get_recompile_threshold, host_sync_count,
                        launch_count, note_host_sync, note_launch,
@@ -53,6 +55,8 @@ __all__ = [
     "TraceContext", "TailRing", "AccessLog", "TRACE_HEADER",
     "new_trace_id", "request_span", "request_complete", "request_instant",
     "render_prometheus", "render_parts", "registry_text",
+    "QualityMonitor", "QualityProfile", "psi", "js_divergence",
+    "quality_sidecar_path",
 ]
 
 _trace_out: Optional[str] = None

@@ -120,7 +120,13 @@ class MetricsRegistry:
             total, vmin, vmax = h["count"], h["min"], h["max"]
         out: Dict[str, float] = {}
         for q in qs:
-            target = q * total
+            # nudge the rank target down by an epsilon: q*total lands
+            # EXACTLY on a cumulative-bucket boundary whenever the
+            # quantile value sits on a bucket bound (0.95*20 is
+            # 19.000000000000004 in binary), and without the nudge the
+            # walk would step past the bucket actually holding the value
+            # and report from the NEXT one
+            target = q * total - 1e-9
             cum = 0.0
             val = vmax
             for i, c in enumerate(buckets):

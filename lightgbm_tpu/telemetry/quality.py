@@ -1,0 +1,849 @@
+"""Data & model quality observability: drift monitoring + shadow audit.
+
+Three legs (docs/OBSERVABILITY.md "Data & model quality"):
+
+  * **Reference profile** — at train time, :class:`QualityProfile` captures
+    per-feature bin-count histograms straight from the binned matrix (the
+    boundaries are the ``BinMapper``'s, so train and serve share bin
+    semantics by construction), missing-bin rates, label and raw-score
+    histograms, and the final holdout metric.  It is serialized as a
+    ``<model>.quality.json`` sidecar written atomically next to the model,
+    sha256-linked to the model text the same way the robustness
+    ``.manifest.json`` is, and loaded by ``ModelRegistry`` on (re)load.
+    Because the binned matrix is chunk/rank-invariant (stream and
+    in-memory ingest produce bit-identical bins, test-gated), the profile
+    is too.
+
+  * **Drift monitor** — :class:`QualityMonitor` accumulates sampled
+    serving traffic into per-feature bin histograms (rows re-binned with
+    the profile's own mappers) and a score histogram, computes PSI and
+    Jensen–Shannon divergence per feature plus score drift and
+    missing-rate deltas against the reference, and runs an ``slo.py``-style
+    multi-window state machine: the alert FIRES when the fast AND slow
+    windows both exceed ``drift_threshold`` (with at least
+    ``quality_min_rows`` sampled rows in the fast window) and CLEARS when
+    the fast window alone recovers.  Missing or corrupt sidecars degrade
+    to ``available: false`` — never a zero a gate could misread.
+
+  * **Shadow audit** — a sampled ring of served rows is re-scored through
+    the genuine ``Booster.predict`` host path and compared **bitwise**
+    against the f64 values the wire returned (the serving exactness
+    contract, continuously verified in production).  Mismatches are
+    logged with trace id + model sha256.
+
+``python -m lightgbm_tpu.telemetry.quality report <fleet_dir>`` merges
+the per-replica drift snapshots a fleet exports into one report.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+from . import global_registry as telemetry
+
+QUALITY_SUFFIX = ".quality.json"
+PROFILE_VERSION = 1
+# fixed equal-width resolution for the label / raw-score histograms
+_SCORE_BINS = 32
+_SLOW_FACTOR = 12        # slow window spans 12x the fast window (SLO-style)
+_FAST_SUBWINDOWS = 4     # fast window = 4 sub-windows (25% granularity)
+_MAX_TIMELINE = 256
+_AUDIT_CAPACITY = 4096   # pending shadow-audit entries (bounded ring)
+_AUDIT_DRAIN = 64        # entries re-scored per audit_once() call
+_PSI_BUCKETS = 16        # coarse buckets for PSI/JS (noise control)
+
+
+# ---------------------------------------------------------------------------
+# drift math
+# ---------------------------------------------------------------------------
+
+def psi(ref_counts, obs_counts, eps: float = 1e-4) -> float:
+    """Population Stability Index between two count histograms over the
+    same bins: ``sum((q - p) * ln(q / p))`` with fractions floored at
+    ``eps`` (the classic guard against empty bins).  0 = identical;
+    >= 0.2 is the textbook "significant shift" threshold."""
+    r = np.asarray(ref_counts, dtype=np.float64)
+    o = np.asarray(obs_counts, dtype=np.float64)
+    rs, os_ = float(r.sum()), float(o.sum())
+    if rs <= 0.0 or os_ <= 0.0:
+        return 0.0
+    p = np.maximum(r / rs, eps)
+    q = np.maximum(o / os_, eps)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def _coarsen(ref: np.ndarray, obs: np.ndarray,
+             max_buckets: int = _PSI_BUCKETS):
+    """Sum contiguous bins so drift math sees at most ``max_buckets``
+    buckets.  Fine feature histograms (up to 255 bins) make PSI explode
+    from sampling noise alone — every empty observed bin contributes
+    ``~p*ln(p/eps)`` — which is why textbook PSI uses ~10 coarse buckets.
+    Ref and obs are coarsened with the SAME edges, so identical
+    distributions still score 0."""
+    r = np.asarray(ref, dtype=np.float64)
+    o = np.asarray(obs, dtype=np.float64)
+    n = r.shape[0]
+    if n <= max_buckets:
+        return r, o
+    edges = np.linspace(0, n, max_buckets + 1).astype(np.int64)[:-1]
+    return np.add.reduceat(r, edges), np.add.reduceat(o, edges)
+
+
+def js_divergence(ref_counts, obs_counts) -> float:
+    """Jensen–Shannon divergence (base 2, so bounded in [0, 1]) between
+    two count histograms over the same bins.  Symmetric and finite even
+    for disjoint support — the stable companion to PSI."""
+    r = np.asarray(ref_counts, dtype=np.float64)
+    o = np.asarray(obs_counts, dtype=np.float64)
+    rs, os_ = float(r.sum()), float(o.sum())
+    if rs <= 0.0 or os_ <= 0.0:
+        return 0.0
+    p, q = r / rs, o / os_
+    m = 0.5 * (p + q)
+
+    def _kl(a: np.ndarray) -> float:
+        mask = a > 0.0
+        return float(np.sum(a[mask] * np.log2(a[mask] / m[mask])))
+
+    return 0.5 * _kl(p) + 0.5 * _kl(q)
+
+
+# ---------------------------------------------------------------------------
+# reference profile
+# ---------------------------------------------------------------------------
+
+def quality_sidecar_path(model_path: str) -> str:
+    """The quality sidecar path for a model file."""
+    return str(model_path) + QUALITY_SUFFIX
+
+
+def _binned_feature_counts(binned) -> List[np.ndarray]:
+    """Per-feature original-bin count histograms reconstructed from the
+    packed (EFB-bundled) group matrix.  Bundled groups reserve local 0
+    for the shared default bin; each feature's non-default bins occupy a
+    contiguous local segment with the default bin squeezed out
+    (``local = b - 1 if b > default_bin else b``), so the default-bin
+    count is recovered as ``num_data - sum(segment)``."""
+    n = int(binned.num_data)
+    mappers = binned.bin_mappers
+    per_feature: Dict[int, np.ndarray] = {}
+    for gi, feats in enumerate(binned.group_features):
+        col = np.asarray(binned.bins[:n, gi])
+        gc = np.bincount(col, minlength=int(binned.group_bin_counts[gi]))
+        if len(feats) == 1:
+            f = feats[0]
+            nb = int(mappers[f].num_bins)
+            c = np.zeros(nb, dtype=np.int64)
+            upto = min(nb, gc.shape[0])
+            c[:upto] = gc[:upto]
+            per_feature[f] = c
+        else:
+            in_group = 1
+            for f in feats:
+                m = mappers[f]
+                nb = int(m.num_bins)
+                c = np.zeros(nb, dtype=np.int64)
+                seg = gc[in_group:in_group + nb - 1].astype(np.int64)
+                local = np.arange(seg.shape[0])
+                orig = np.where(local < m.default_bin, local, local + 1)
+                c[orig] = seg
+                c[int(m.default_bin)] = n - int(seg.sum())
+                per_feature[f] = c
+                in_group += nb - 1
+    out: List[np.ndarray] = []
+    for f in range(int(binned.num_features)):
+        if f in per_feature:
+            out.append(per_feature[f])
+        else:
+            # trivial feature (single bin): every row in the default bin
+            m = mappers[f]
+            nb = max(int(m.num_bins), 1)
+            c = np.zeros(nb, dtype=np.int64)
+            c[min(int(m.default_bin), nb - 1)] = n
+            out.append(c)
+    return out
+
+
+def _value_histogram(values: np.ndarray, bins: int = _SCORE_BINS
+                     ) -> Dict[str, list]:
+    """Fixed equal-width histogram with edges stored alongside the counts
+    so serve-time values bin identically (out-of-range values clamp into
+    the end bins)."""
+    v = np.asarray(values, dtype=np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        lo, hi = 0.0, 1.0
+    else:
+        lo, hi = float(v.min()), float(v.max())
+        if hi <= lo:
+            hi = lo + 1.0
+    edges = np.linspace(lo, hi, bins + 1)
+    idx = np.clip(np.searchsorted(edges[1:-1], v), 0, bins - 1)
+    counts = np.bincount(idx, minlength=bins)
+    return {"edges": [float(e) for e in edges],
+            "counts": [int(c) for c in counts]}
+
+
+class QualityProfile:
+    """The training-time reference distribution a server drifts against."""
+
+    def __init__(self, data: Dict[str, Any]) -> None:
+        self.data = data
+        self._mappers: Optional[list] = None
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def model_sha256(self) -> str:
+        return self.data.get("model_sha256", "")
+
+    @property
+    def num_features(self) -> int:
+        return int(self.data.get("num_features", 0))
+
+    @property
+    def num_data(self) -> int:
+        return int(self.data.get("num_data", 0))
+
+    def feature_counts(self, f: int) -> np.ndarray:
+        return np.asarray(self.data["features"][f]["counts"],
+                          dtype=np.float64)
+
+    def missing_rate(self, f: int) -> float:
+        return float(self.data["features"][f]["missing_rate"])
+
+    def missing_bin(self, f: int) -> int:
+        return int(self.data["features"][f]["missing_bin"])
+
+    @property
+    def score_hist(self) -> Dict[str, list]:
+        return self.data["score_hist"]
+
+    def mappers(self) -> list:
+        """Reconstruct the per-feature :class:`BinMapper` objects — the
+        exact transform training used, so serve rows bin identically."""
+        if self._mappers is None:
+            from ..binning import BinMapper
+            ms = []
+            for fd in self.data["features"]:
+                ms.append(BinMapper(
+                    upper_bounds=np.asarray(fd["upper_bounds"],
+                                            dtype=np.float64),
+                    bin_type=int(fd["bin_type"]),
+                    missing_type=int(fd["missing_type"]),
+                    categories=np.asarray(fd["categories"],
+                                          dtype=np.int64),
+                    num_bins=int(fd["num_bins"]),
+                    default_bin=int(fd["default_bin"]),
+                    most_freq_bin=int(fd["most_freq_bin"]),
+                    min_val=float(fd["min_val"]),
+                    max_val=float(fd["max_val"])))
+            self._mappers = ms
+        return self._mappers
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_booster(cls, booster, model_text: str) -> "QualityProfile":
+        """Build the reference profile from a trained booster's binned
+        training matrix + engine scores.  ``model_text`` is the exact
+        string being written to disk — its sha256 links sidecar to model
+        (manifest-style poisoning detection)."""
+        from ..binning import MISSING_NAN, MISSING_ZERO
+        binned = booster.train_set.binned
+        n = int(binned.num_data)
+        counts = _binned_feature_counts(binned)
+        features = []
+        for f, m in enumerate(binned.bin_mappers):
+            nb = int(m.num_bins)
+            if m.missing_type == MISSING_NAN:
+                miss_bin = nb - 1
+            elif m.missing_type == MISSING_ZERO:
+                miss_bin = int(m.default_bin)
+            else:
+                miss_bin = -1
+            c = counts[f]
+            miss_rate = (float(c[miss_bin]) / n
+                         if miss_bin >= 0 and n else 0.0)
+            features.append({
+                "counts": [int(x) for x in c],
+                "missing_bin": miss_bin,
+                "missing_rate": miss_rate,
+                "upper_bounds": [float(x) for x in m.upper_bounds],
+                "bin_type": int(m.bin_type),
+                "missing_type": int(m.missing_type),
+                "categories": [int(x) for x in m.categories],
+                "num_bins": nb,
+                "default_bin": int(m.default_bin),
+                "most_freq_bin": int(m.most_freq_bin),
+                "min_val": float(m.min_val),
+                "max_val": float(m.max_val),
+            })
+        raw = np.asarray(booster._engine._unpad_score(),
+                         dtype=np.float64).ravel()
+        label = booster.train_set.get_label()
+        metric: Dict[str, float] = {}
+        for ds_name, ms in (booster.best_score or {}).items():
+            for mname, val in ms.items():
+                metric[f"{ds_name}:{mname}"] = float(val)
+        data = {
+            "version": PROFILE_VERSION,
+            "model_sha256": hashlib.sha256(
+                model_text.encode("utf-8")).hexdigest(),
+            "created_unix": time.time(),
+            "num_data": n,
+            "num_features": int(binned.num_features),
+            "features": features,
+            "score_hist": _value_histogram(raw),
+            "label_hist": (_value_histogram(np.asarray(label,
+                                                       dtype=np.float64))
+                           if label is not None else None),
+            "holdout_metric": metric,
+        }
+        return cls(data)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, model_path: str) -> str:
+        """Atomically write the sidecar next to ``model_path``."""
+        from ..robustness.checkpoint import atomic_write_text
+        path = quality_sidecar_path(model_path)
+        atomic_write_text(path, json.dumps(self.data) + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "QualityProfile":
+        with open(path) as fh:
+            data = json.load(fh)
+        if not isinstance(data, dict) or "features" not in data \
+                or "score_hist" not in data:
+            raise ValueError(f"malformed quality sidecar: {path}")
+        return cls(data)
+
+    @classmethod
+    def load_for_model(cls, model_path: str,
+                       sha256: str) -> Optional["QualityProfile"]:
+        """Best-effort sidecar load for a served model: ``None`` (with a
+        warning) on a missing, corrupt, or sha-mismatched sidecar —
+        serving must never depend on the sidecar being healthy."""
+        path = quality_sidecar_path(model_path)
+        if not os.path.exists(path):
+            return None
+        try:
+            prof = cls.load(path)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            log_warning(f"quality: ignoring corrupt sidecar {path}: {exc}")
+            return None
+        if prof.model_sha256 != sha256:
+            log_warning(
+                f"quality: sidecar {path} is linked to model sha256 "
+                f"{prof.model_sha256[:12]}.. but the model file hashes to "
+                f"{sha256[:12]}.. — ignoring (poisoned or stale sidecar)")
+            return None
+        return prof
+
+
+# ---------------------------------------------------------------------------
+# serving-time monitor
+# ---------------------------------------------------------------------------
+
+class _Window:
+    """One sub-window of sampled-traffic accumulation."""
+    __slots__ = ("idx", "rows", "counts", "score")
+
+    def __init__(self, idx: int, num_features: int, max_bins: int,
+                 score_bins: int) -> None:
+        self.idx = idx
+        self.rows = 0
+        self.counts = np.zeros((num_features, max_bins), dtype=np.int64)
+        self.score = np.zeros(score_bins, dtype=np.int64)
+
+
+class QualityMonitor:
+    """Multi-window drift monitor + shadow-audit ring for one server.
+
+    ``observe_batch`` / ``offer_audit`` sit on the micro-batcher dispatch
+    path behind per-batch (resp. per-request) sampling draws, so the
+    un-sampled hot path pays one RNG call.  ``tick`` (the server's 1 Hz
+    maintenance loop) runs the drift state machine and publishes gauges;
+    ``audit_once`` drains the audit ring through ``Booster.predict``."""
+
+    def __init__(self, *, threshold: float = 0.2, window_s: float = 60.0,
+                 sample: float = 0.01, audit_sample: float = 0.01,
+                 min_rows: int = 200, topk: int = 5,
+                 clock=time.monotonic, slow_factor: int = _SLOW_FACTOR,
+                 audit_capacity: int = _AUDIT_CAPACITY) -> None:
+        self.threshold = float(threshold)
+        self.window_s = max(float(window_s), 1e-3)
+        self.sample = float(sample)
+        self.audit_sample = float(audit_sample)
+        self.min_rows = int(min_rows)
+        self.topk = int(topk)
+        self._clock = clock
+        self._slow_factor = max(int(slow_factor), 1)
+        self._span = self.window_s / _FAST_SUBWINDOWS
+        self._slow_n = _FAST_SUBWINDOWS * self._slow_factor
+        self._lock = threading.Lock()
+        self._rng = random.Random(0x7EACE ^ os.getpid())
+        # reference state (swapped on model change)
+        self._sha: Optional[str] = None
+        self._profile: Optional[QualityProfile] = None
+        self._mappers: list = []
+        self._num_bins: List[int] = []
+        self._max_bins = 0
+        self._score_inner: Optional[np.ndarray] = None
+        self._score_bins = _SCORE_BINS
+        # accumulators
+        self._windows: List[_Window] = []
+        self._sampled_rows = 0
+        # audit ring (list guarded by _lock; bounded)
+        self._audit: List[tuple] = []
+        self._audit_capacity = int(audit_capacity)
+        self._audit_rows = 0
+        self._audit_mismatches = 0
+        self._audit_dropped = 0
+        # alert state machine
+        self.alerting = False
+        self.fired = 0
+        self.cleared = 0
+        self._timeline: List[Dict[str, Any]] = []
+        self._last: Dict[str, Any] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0 or self.audit_sample > 0.0
+
+    # -- model tracking ----------------------------------------------------
+    def sync_model(self, model) -> bool:
+        """Track the serving model: on a sha change, adopt its sidecar
+        profile (possibly ``None``) and reset the accumulators + alert.
+        Returns True when a reference profile is available."""
+        sha = getattr(model, "sha256", None)
+        if sha == self._sha:
+            return self._profile is not None
+        profile = getattr(model, "quality", None)
+        with self._lock:
+            if sha == self._sha:            # lost the race; state is set
+                return self._profile is not None
+            self._sha = sha
+            self._profile = profile
+            self._windows = []
+            self._sampled_rows = 0
+            if self.alerting:
+                self.alerting = False
+                self.cleared += 1
+            self._last = {}
+            self._timeline.append({"t": self._clock(), "kind": "model",
+                                   "sha256": sha,
+                                   "profile": profile is not None})
+            del self._timeline[:-_MAX_TIMELINE]
+            if profile is not None:
+                self._mappers = profile.mappers()
+                self._num_bins = [int(m.num_bins) for m in self._mappers]
+                self._max_bins = max(self._num_bins + [1])
+                edges = np.asarray(profile.score_hist["edges"],
+                                   dtype=np.float64)
+                self._score_inner = edges[1:-1]
+                self._score_bins = len(profile.score_hist["counts"])
+            else:
+                self._mappers = []
+                self._num_bins = []
+                self._max_bins = 0
+                self._score_inner = None
+        return profile is not None
+
+    # -- accumulation (batcher worker thread) ------------------------------
+    def observe_batch(self, model, X, raw) -> None:
+        """Accumulate one dispatched batch into the drift histograms.
+        One sampling draw per BATCH keeps the hot-path cost negligible."""
+        if self.sample <= 0.0 or not self.sync_model(model):
+            return
+        if self._rng.random() >= self.sample:
+            return
+        Xa = np.asarray(X, dtype=np.float64)
+        if Xa.ndim != 2 or Xa.shape[1] != len(self._mappers):
+            return
+        n = Xa.shape[0]
+        scores = np.asarray(raw, dtype=np.float64).ravel()
+        idx = int(self._clock() // self._span)
+        with self._lock:
+            w = self._window_locked(idx)
+            for f, m in enumerate(self._mappers):
+                nb = self._num_bins[f]
+                b = np.asarray(m.transform(Xa[:, f]), dtype=np.int64)
+                w.counts[f, :nb] += np.bincount(
+                    np.clip(b, 0, nb - 1), minlength=nb)
+            if self._score_inner is not None and scores.size:
+                si = np.clip(np.searchsorted(self._score_inner, scores),
+                             0, self._score_bins - 1)
+                w.score += np.bincount(si, minlength=self._score_bins)
+            w.rows += n
+            self._sampled_rows += n
+        telemetry.inc("quality/sampled_rows", n)
+
+    def _window_locked(self, idx: int) -> _Window:
+        if self._windows and self._windows[-1].idx == idx:
+            return self._windows[-1]
+        w = _Window(idx, len(self._mappers), self._max_bins,
+                    self._score_bins)
+        self._windows.append(w)
+        del self._windows[:-(self._slow_n + 1)]
+        return w
+
+    # -- shadow audit ------------------------------------------------------
+    def offer_audit(self, model, rows, raw_slice, raw_score: bool,
+                    trace_id: Optional[str]) -> None:
+        """Maybe enqueue one served request for background re-scoring.
+        ``raw_slice`` is the request's slice of the dispatched raw-score
+        batch; the served values are recovered with the model's own
+        ``finish`` (bit-identical — same function, same input).  Runs
+        even without a reference profile: the exactness contract does
+        not depend on the sidecar."""
+        if self.audit_sample <= 0.0 \
+                or self._rng.random() >= self.audit_sample:
+            return
+        values = np.asarray(model.finish(raw_slice, raw_score),
+                            dtype=np.float64)
+        entry = (np.array(rows, dtype=np.float64, copy=True),
+                 np.array(values, dtype=np.float64, copy=True),
+                 bool(raw_score), trace_id, model)
+        with self._lock:
+            if len(self._audit) >= self._audit_capacity:
+                self._audit_dropped += 1
+                return
+            self._audit.append(entry)
+
+    def audit_once(self, max_entries: int = _AUDIT_DRAIN) -> int:
+        """Drain up to ``max_entries`` pending audits through the host
+        ``Booster.predict`` path and compare bitwise (f64) against what
+        the wire returned.  Returns the number of rows audited.
+
+        Entries are grouped per (model, raw_score) and re-scored in ONE
+        concatenated predict call: the host tree walk is per-row, so
+        batch composition cannot change any row's f64 sum, and one call
+        instead of ~64 keeps the 1 Hz drain off the serving threads'
+        GIL budget."""
+        with self._lock:
+            drained = self._audit[:max_entries]
+            del self._audit[:max_entries]
+        if not drained:
+            return 0
+        groups: Dict[tuple, List[tuple]] = {}
+        for entry in drained:
+            groups.setdefault((id(entry[4]), entry[2]), []).append(entry)
+        rows_done = 0
+        for entries in groups.values():
+            model, raw_score = entries[0][4], entries[0][2]
+            rows_cat = (entries[0][0] if len(entries) == 1 else
+                        np.concatenate([e[0] for e in entries], axis=0))
+            try:
+                expect = np.asarray(
+                    model._booster.predict(rows_cat, raw_score=raw_score),
+                    dtype=np.float64)
+            except Exception as exc:        # audit must never kill serving
+                log_warning(f"quality: shadow audit re-score failed: {exc}")
+                continue
+            off = 0
+            for rows, values, _, trace_id, _ in entries:
+                m = rows.shape[0]
+                sl = expect[off:off + m]
+                off += m
+                rows_done += m
+                if sl.ravel().tobytes() != values.ravel().tobytes():
+                    with self._lock:
+                        self._audit_mismatches += 1
+                    log_warning(
+                        "quality: shadow audit BITWISE MISMATCH "
+                        f"trace={trace_id} "
+                        f"model_sha256={model.sha256[:12]}.. "
+                        f"rows={m} raw_score={raw_score} — served "
+                        "values diverge from Booster.predict")
+        if rows_done:
+            with self._lock:
+                self._audit_rows += rows_done
+        return rows_done
+
+    # -- drift computation + state machine ---------------------------------
+    def _aggregate_locked(self, now_idx: int, n_windows: int):
+        ws = [w for w in self._windows if w.idx > now_idx - n_windows]
+        if not ws:
+            return 0, None, None
+        rows = sum(w.rows for w in ws)
+        counts = ws[0].counts.copy()
+        score = ws[0].score.copy()
+        for w in ws[1:]:
+            counts += w.counts
+            score += w.score
+        return rows, counts, score
+
+    def compute(self) -> Dict[str, Any]:
+        """Current drift statistics vs the reference (both windows)."""
+        with self._lock:
+            profile = self._profile
+            if profile is None:
+                return {"available": False}
+            now_idx = int(self._clock() // self._span)
+            del self._windows[: max(
+                0, len(self._windows) - (self._slow_n + 1))]
+            f_rows, f_counts, f_score = self._aggregate_locked(
+                now_idx, _FAST_SUBWINDOWS)
+            s_rows, s_counts, s_score = self._aggregate_locked(
+                now_idx, self._slow_n)
+        nf = profile.num_features
+        feats = []
+        max_fast = max_slow = nan_delta_max = 0.0
+        for f in range(nf):
+            ref = profile.feature_counts(f)
+            pf = ps = jd = 0.0
+            if f_counts is not None:
+                rc, oc = _coarsen(ref, f_counts[f, :len(ref)])
+                pf = psi(rc, oc)
+            if s_counts is not None:
+                rc, oc = _coarsen(ref, s_counts[f, :len(ref)])
+                ps = psi(rc, oc)
+                jd = js_divergence(rc, oc)
+            nd = 0.0
+            mb = profile.missing_bin(f)
+            if mb >= 0 and s_counts is not None and s_rows:
+                nd = abs(float(s_counts[f, mb]) / s_rows
+                         - profile.missing_rate(f))
+            nan_delta_max = max(nan_delta_max, nd)
+            max_fast, max_slow = max(max_fast, pf), max(max_slow, ps)
+            feats.append({"feature": f, "psi_fast": round(pf, 6),
+                          "psi_slow": round(ps, 6), "js": round(jd, 6),
+                          "nan_delta": round(nd, 6)})
+        ref_score = np.asarray(profile.score_hist["counts"],
+                               dtype=np.float64)
+        sc_fast = sc_slow = 0.0
+        if f_score is not None:
+            sc_fast = psi(*_coarsen(ref_score, f_score))
+        if s_score is not None:
+            sc_slow = psi(*_coarsen(ref_score, s_score))
+        feats.sort(key=lambda d: -d["psi_fast"])
+        return {
+            "available": True,
+            "fast_rows": f_rows, "slow_rows": s_rows,
+            "max_psi_fast": round(max_fast, 6),
+            "max_psi_slow": round(max_slow, 6),
+            "score_psi_fast": round(sc_fast, 6),
+            "score_psi_slow": round(sc_slow, 6),
+            "nan_delta_max": round(nan_delta_max, 6),
+            "drift_fast": round(max(max_fast, sc_fast), 6),
+            "drift_slow": round(max(max_slow, sc_slow), 6),
+            "top_features": feats[:self.topk],
+        }
+
+    def tick(self, model=None) -> Dict[str, Any]:
+        """Run one maintenance step: recompute drift, advance the alert
+        state machine, publish gauges.  Mirrors ``SLOMonitor.tick`` —
+        fire on fast AND slow, clear on fast alone."""
+        if model is not None:
+            self.sync_model(model)
+        d = self.compute()
+        telemetry.gauge("drift/available", 1.0 if d["available"] else 0.0)
+        if not d["available"]:
+            with self._lock:
+                self._last = d
+                alerting = self.alerting
+            # deliberately do NOT publish drift/* values: a 0.0 here
+            # would read as "no drift" when the truth is "cannot tell"
+            telemetry.gauge("drift/alert", 1.0 if alerting else 0.0)
+            return d
+        enough = d["fast_rows"] >= self.min_rows
+        over_fast = d["drift_fast"] >= self.threshold
+        over_slow = d["drift_slow"] >= self.threshold
+        fired = cleared = False
+        with self._lock:
+            self._last = d
+            if not self.alerting and enough and over_fast and over_slow:
+                self.alerting = fired = True
+                self.fired += 1
+                self._timeline.append({
+                    "t": self._clock(), "kind": "fire",
+                    "drift_fast": d["drift_fast"],
+                    "drift_slow": d["drift_slow"],
+                    "top": [f["feature"] for f in d["top_features"]]})
+                del self._timeline[:-_MAX_TIMELINE]
+            elif self.alerting and not over_fast:
+                self.alerting = False
+                cleared = True
+                self.cleared += 1
+                self._timeline.append({
+                    "t": self._clock(), "kind": "clear",
+                    "drift_fast": d["drift_fast"]})
+                del self._timeline[:-_MAX_TIMELINE]
+        if fired:
+            top = ", ".join(
+                f"f{f['feature']}(psi={f['psi_fast']:.3f})"
+                for f in d["top_features"][:3])
+            log_warning(
+                f"quality: DRIFT alert FIRED — fast={d['drift_fast']:.3f} "
+                f"slow={d['drift_slow']:.3f} >= {self.threshold} over "
+                f"{d['fast_rows']} sampled rows; top features: {top}")
+        elif cleared:
+            log_info(f"quality: drift alert cleared "
+                     f"(fast={d['drift_fast']:.3f} < {self.threshold})")
+        telemetry.gauge("drift/max_psi_fast", d["max_psi_fast"])
+        telemetry.gauge("drift/max_psi_slow", d["max_psi_slow"])
+        telemetry.gauge("drift/score_psi_fast", d["score_psi_fast"])
+        telemetry.gauge("drift/score_psi_slow", d["score_psi_slow"])
+        telemetry.gauge("drift/nan_delta_max", d["nan_delta_max"])
+        telemetry.gauge("drift/alert", 1.0 if self.alerting else 0.0)
+        for fd in d["top_features"]:
+            f = fd["feature"]
+            # bounded by quality_topk (config), never by traffic
+            telemetry.gauge(f"drift/feature/{f}/psi", fd["psi_fast"])
+            telemetry.gauge(f"drift/feature/{f}/js", fd["js"])
+        with self._lock:
+            audit = {"rows": self._audit_rows,
+                     "mismatches": self._audit_mismatches,
+                     "pending": len(self._audit),
+                     "dropped": self._audit_dropped}
+        for k, v in audit.items():
+            telemetry.gauge(f"quality/audit/{k}", float(v))
+        return d
+
+    # -- introspection -----------------------------------------------------
+    def brief(self) -> Optional[Dict[str, Any]]:
+        """Compact drift snapshot for the structured access log — only
+        non-None while the alert is active, so healthy traffic logs stay
+        lean."""
+        if not self.alerting:
+            return None
+        d = self._last or {}
+        return {"alert": True,
+                "drift_fast": d.get("drift_fast"),
+                "drift_slow": d.get("drift_slow")}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full ``/drift`` payload (and the per-replica export)."""
+        d = self._last or self.compute()
+        with self._lock:
+            profile = self._profile
+            out: Dict[str, Any] = {
+                "available": bool(d.get("available")),
+                "model_sha256": self._sha,
+                "alerting": self.alerting,
+                "fired": self.fired,
+                "cleared": self.cleared,
+                "threshold": self.threshold,
+                "window_s": self.window_s,
+                "slow_factor": self._slow_factor,
+                "sample": self.sample,
+                "audit_sample": self.audit_sample,
+                "min_rows": self.min_rows,
+                "sampled_rows": self._sampled_rows,
+                "audit": {"rows": self._audit_rows,
+                          "mismatches": self._audit_mismatches,
+                          "pending": len(self._audit),
+                          "dropped": self._audit_dropped},
+                "timeline": list(self._timeline[-32:]),
+            }
+        if out["available"]:
+            out["drift"] = {k: d[k] for k in (
+                "fast_rows", "slow_rows", "max_psi_fast", "max_psi_slow",
+                "score_psi_fast", "score_psi_slow", "nan_delta_max",
+                "drift_fast", "drift_slow")}
+            out["top_features"] = d.get("top_features", [])
+            if profile is not None:
+                out["profile"] = {
+                    "created_unix": profile.data.get("created_unix"),
+                    "num_data": profile.num_data,
+                    "num_features": profile.num_features,
+                    "holdout_metric": profile.data.get("holdout_metric",
+                                                       {}),
+                }
+        else:
+            out["reason"] = ("no quality sidecar for model "
+                             f"{(self._sha or 'unknown')[:12]}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fleet report CLI
+# ---------------------------------------------------------------------------
+
+def write_snapshot(path: str, snap: Dict[str, Any]) -> None:
+    """Atomically export one replica's drift snapshot for the report CLI."""
+    from ..robustness.checkpoint import atomic_write_text
+    atomic_write_text(path, json.dumps(snap) + "\n")
+
+
+def merge_reports(fleet_dir: str) -> Dict[str, Any]:
+    """Merge ``drift_replica_<r>.json`` exports under ``fleet_dir`` into
+    one fleet-level drift report."""
+    replicas: Dict[str, Any] = {}
+    feature_psi: Dict[int, float] = {}
+    audit_rows = audit_mismatches = 0
+    any_alerting = False
+    available = False
+    for path in sorted(glob.glob(
+            os.path.join(fleet_dir, "drift_replica_*.json"))):
+        rank = os.path.basename(path)[len("drift_replica_"):-len(".json")]
+        try:
+            with open(path) as fh:
+                snap = json.load(fh)
+        except (OSError, ValueError) as exc:
+            replicas[rank] = {"error": str(exc)}
+            continue
+        replicas[rank] = {
+            "available": snap.get("available", False),
+            "alerting": snap.get("alerting", False),
+            "fired": snap.get("fired", 0),
+            "cleared": snap.get("cleared", 0),
+            "sampled_rows": snap.get("sampled_rows", 0),
+            "drift": snap.get("drift"),
+            "audit": snap.get("audit", {}),
+            "model_sha256": snap.get("model_sha256"),
+        }
+        available = available or bool(snap.get("available"))
+        any_alerting = any_alerting or bool(snap.get("alerting"))
+        audit_rows += int(snap.get("audit", {}).get("rows", 0))
+        audit_mismatches += int(snap.get("audit", {}).get("mismatches", 0))
+        for fd in snap.get("top_features", []) or []:
+            f = int(fd["feature"])
+            feature_psi[f] = max(feature_psi.get(f, 0.0),
+                                 float(fd.get("psi_fast", 0.0)))
+    top = sorted(feature_psi.items(), key=lambda kv: -kv[1])[:10]
+    return {
+        "fleet_dir": fleet_dir,
+        "replicas": replicas,
+        "num_replicas": len(replicas),
+        "available": available,
+        "any_alerting": any_alerting,
+        "audit": {"rows": audit_rows, "mismatches": audit_mismatches},
+        "top_features": [{"feature": f, "max_psi": round(v, 6)}
+                         for f, v in top],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.telemetry.quality",
+        description="Data/model quality drift tooling")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report",
+                         help="merge per-replica drift snapshots from a "
+                              "fleet dir into one drift report")
+    rep.add_argument("fleet_dir")
+    ns = ap.parse_args(argv)
+    if ns.cmd == "report":
+        out = merge_reports(ns.fleet_dir)
+        print(json.dumps(out, indent=2, sort_keys=True))
+        if not out["replicas"]:
+            print(f"NOTICE: no drift_replica_*.json under {ns.fleet_dir}",
+                  file=__import__("sys").stderr)
+            return 1
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,26 @@ python -m pytest tests/test_serving.py tests/test_wire.py -x -q -m 'not slow'
 # poisoned-candidate fleet-wide reload (docs/SERVING.md fleet section)
 echo "=== stage: serving fleet fast tier ==="
 python -m pytest tests/test_fleet.py -x -q -m 'not slow'
+# data/model quality fast tier: the train-time quality sidecar (binned
+# feature profile + score histogram), the PSI/JS drift monitor's
+# fire/clear state machine, the bitwise train-vs-serve shadow audit, and
+# the /drift + fleet-report surfaces (docs/OBSERVABILITY.md "Data &
+# model quality") — a lying drift monitor poisons every rollout decision
+echo "=== stage: data/model quality fast tier ==="
+python -m pytest tests/test_quality.py -x -q -m 'not slow'
+# drift bench smoke: reduced rows + short alternating QPS windows —
+# gates the full behavior arm (alert FIRES under a +6-sigma covariate
+# shift, CLEARS on recovery, shadow audit is 0-mismatch over >= 500
+# rows) and sanity-checks the quality-on/off QPS ratio at a loosened
+# 10% tolerance (the strict 3% gate needs the full-size windows and
+# lives with the committed artifact); BENCH_DRIFT_SMOKE=1
+# never clobbers the committed BENCH_DRIFT.json artifact (the
+# BENCH_GOSS lesson)
+echo "=== stage: drift bench smoke (BENCH_DRIFT=1) ==="
+BENCH_DRIFT=1 \
+BENCH_DRIFT_SMOKE=1 \
+BENCH_HISTORY=0 \
+    python bench.py
 # distributed fast tier on a 4-device CPU mesh: the reduce-scatter comms
 # path (psum vs reduce_scatter bit-identity, comms-bytes counters,
 # straggler split) runs on every CPU verify at a second device count —

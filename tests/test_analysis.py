@@ -364,6 +364,22 @@ def test_lgb009_cost_family_allowed(tmp_path):
     assert [(f.rule, f.line) for f in found] == [("LGB009", 5)]
 
 
+def test_lgb009_drift_and_quality_families_allowed(tmp_path):
+    # drift/feature/<i>/<stat> is bounded by quality_topk (config) and
+    # quality/audit/<stat> by a fixed stat set — sanctioned skeletons
+    src = ("from lightgbm_tpu import telemetry\n"
+           "def publish(f, stat, v):\n"
+           "    telemetry.gauge(f'drift/feature/{f}/psi', v)\n"      # ok
+           "    telemetry.gauge(f'drift/feature/{f}/js', v)\n"       # ok
+           "    telemetry.gauge(f'quality/audit/{stat}', v)\n"       # ok
+           "    telemetry.gauge('drift/max_psi_fast', v)\n"          # literal
+           "    telemetry.gauge(f'drift/{f}/psi', v)\n"              # line 7
+           "    telemetry.inc(f'quality/{stat}/rows', v)\n")         # line 8
+    found = run_snippet(tmp_path, src, MetricNameRule())
+    assert [(f.rule, f.line) for f in found] == [
+        ("LGB009", 7), ("LGB009", 8)]
+
+
 def test_lgb010_watched_jit_without_name_trips(tmp_path):
     src = ("import functools\n"
            "from lightgbm_tpu.telemetry.watchdog import watched_jit\n"

@@ -252,6 +252,47 @@ def test_prometheus_multi_part_single_type(telemetry):
                    '{replica="0",role="replica"}'] == 9.0
 
 
+def test_prometheus_multi_part_histogram_relabeling(telemetry):
+    """Fleet aggregate (/metrics/fleet): per-replica histogram snapshots
+    merge under exactly ONE ``# TYPE`` line, each replica keeping its own
+    CUMULATIVE ``le`` ladder inside ``replica="<r>"`` label space."""
+    def hist(buckets, total):
+        return {"count": total, "sum_s": 0.5, "mean_s": 0.1, "min_s": 0.01,
+                "max_s": 0.2, "bounds": [0.01, 0.1], "buckets": buckets}
+    snap_a = {"counters": {}, "gauges": {},
+              "histograms": {"serve/latency_s": hist([2, 1, 0], 3)}}
+    snap_b = {"counters": {}, "gauges": {},
+              "histograms": {"serve/latency_s": hist([1, 0, 4], 5)}}
+    text = render_parts([({"role": "replica", "replica": "0"}, snap_a),
+                         ({"role": "replica", "replica": "1"}, snap_b)])
+    assert text.count("# TYPE lgbtpu_serve_latency_s histogram") == 1
+    assert text.count("# TYPE") == 1
+    types, samples = _parse_prom(text)
+    # replica 0: cumulative 2 -> 3, +Inf == _count == 3
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="0.01",replica="0",role="replica"}'] == 2
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="0.1",replica="0",role="replica"}'] == 3
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="+Inf",replica="0",role="replica"}'] == \
+        samples['lgbtpu_serve_latency_s_count'
+                '{replica="0",role="replica"}'] == 3
+    # replica 1: its own independent ladder, 1 -> 1, +Inf == 5
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="0.01",replica="1",role="replica"}'] == 1
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="0.1",replica="1",role="replica"}'] == 1
+    assert samples['lgbtpu_serve_latency_s_bucket'
+                   '{le="+Inf",replica="1",role="replica"}'] == 5
+    # a fleet/replica/<r>/-named histogram relabels the same way
+    snap_c = {"counters": {}, "gauges": {},
+              "histograms": {"fleet/replica/7/lat_s": hist([1, 1, 0], 2)}}
+    types, samples = _parse_prom(render_parts([({}, snap_c)]))
+    assert types == {"lgbtpu_fleet_replica_lat_s": "histogram"}
+    assert samples['lgbtpu_fleet_replica_lat_s_bucket'
+                   '{le="0.1",replica="7"}'] == 2
+
+
 def test_prometheus_handles_legacy_snapshot_without_buckets():
     # a pre-anchor snapshot (no bounds/buckets) must not crash the
     # exporter — the histogram is simply omitted

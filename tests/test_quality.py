@@ -1,0 +1,558 @@
+"""Data & model quality observability (docs/OBSERVABILITY.md).
+
+The quality contract under test:
+
+  * the training-time :class:`QualityProfile` sidecar reconstructs the
+    EXACT per-feature bin histograms of the binned matrix (EFB bundles
+    unpacked, default bins recovered) and is chunk/rank-invariant —
+    streamed and in-memory ingest write byte-identical profiles;
+  * sidecar lifecycle degrades, never lies: a missing, corrupt, or
+    sha-mismatched ``.quality.json`` loads as ``None`` (``available:
+    false`` downstream) and never affects model loading or serving;
+  * the drift monitor's multi-window state machine FIRES only when the
+    fast AND slow windows both exceed the threshold, CLEARS on the fast
+    window alone, and stays silent on in-distribution traffic;
+  * the shadow audit re-scores served rows through the genuine
+    ``Booster.predict`` host path and agrees BITWISE with what the wire
+    returned;
+  * ``/drift`` + ``/ready`` + ``/stats`` surface the state over HTTP,
+    and the fleet report CLI merges per-replica snapshots.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import ModelRegistry, ServingApp
+from lightgbm_tpu.telemetry.quality import (QUALITY_SUFFIX, QualityMonitor,
+                                            QualityProfile, _coarsen,
+                                            js_divergence, main,
+                                            merge_reports, psi,
+                                            quality_sidecar_path)
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5, "seed": 3}
+
+
+def _make_data(seed=7, n=800, F=6):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, F)
+    X[:, 4] = rs.randint(0, 9, n)
+    X[rs.rand(n) < 0.15, 0] = np.nan
+    y = ((X[:, 1] > 0) ^ (X[:, 4] == 3)).astype(np.float64)
+    return X, y
+
+
+def _train_to_file(path, seed=3, rounds=8):
+    X, y = _make_data()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[4])
+    Xv, yv = _make_data(seed=seed + 100, n=200)
+    va = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = lgb.train({**PARAMS, "seed": seed}, ds, num_boost_round=rounds,
+                    valid_sets=[va], valid_names=["holdout"])
+    bst.save_model(str(path))
+    return X, bst
+
+
+@pytest.fixture(scope="module")
+def profiled(tmp_path_factory):
+    """(model_path, X, booster) with a .quality.json sidecar on disk."""
+    td = tmp_path_factory.mktemp("quality")
+    mp = td / "model.txt"
+    X, bst = _train_to_file(mp)
+    return str(mp), X, bst
+
+
+# ---------------------------------------------------------------------------
+# drift math
+# ---------------------------------------------------------------------------
+
+def test_psi_identity_and_shift():
+    assert psi([10, 20, 30], [10, 20, 30]) == 0.0
+    assert psi([100, 0, 0], [0, 0, 100]) > 1.0
+    # scale invariance: fractions, not counts
+    assert psi([1, 2, 3], [10, 20, 30]) == pytest.approx(0.0, abs=1e-12)
+    # degenerate inputs report "no signal", not an exception
+    assert psi([0, 0], [5, 5]) == 0.0
+    assert psi([], []) == 0.0
+
+
+def test_js_divergence_bounds():
+    assert js_divergence([5, 5], [5, 5]) == 0.0
+    # disjoint support saturates at exactly 1 bit (base-2)
+    assert js_divergence([10, 0], [0, 10]) == pytest.approx(1.0)
+    d = js_divergence([30, 10], [10, 30])
+    assert 0.0 < d < 1.0
+    assert js_divergence([0], [0]) == 0.0
+
+
+def test_coarsen_preserves_identity_and_mass():
+    ref = np.arange(255, dtype=np.float64)
+    rc, oc = _coarsen(ref, ref.copy())
+    assert rc.shape[0] <= 16
+    assert rc.sum() == ref.sum()
+    assert psi(rc, oc) == 0.0
+    # short histograms pass through untouched
+    rc, oc = _coarsen(np.ones(8), np.ones(8))
+    assert rc.shape == (8,)
+
+
+def test_coarsen_controls_sampling_noise():
+    """The reason coarsening exists: a 255-bin histogram sampled at a few
+    hundred rows shows huge PSI from empty-bin flooring alone."""
+    rs = np.random.RandomState(0)
+    ref = np.bincount(rs.randint(0, 255, 100_000), minlength=255)
+    obs = np.bincount(rs.randint(0, 255, 300), minlength=255)
+    assert psi(ref, obs) > 1.0                    # fine bins: pure noise
+    assert psi(*_coarsen(ref, obs)) < 0.2         # coarse: under threshold
+
+
+# ---------------------------------------------------------------------------
+# reference profile + sidecar lifecycle
+# ---------------------------------------------------------------------------
+
+def test_sidecar_written_and_linked(profiled):
+    mp, X, bst = profiled
+    sp = quality_sidecar_path(mp)
+    assert sp == mp + QUALITY_SUFFIX and os.path.exists(sp)
+    prof = QualityProfile.load(sp)
+    assert prof.num_features == X.shape[1]
+    assert prof.num_data == X.shape[0]
+    import hashlib
+    want = hashlib.sha256(
+        open(mp, "rb").read().decode("utf-8").encode("utf-8")).hexdigest()
+    assert prof.model_sha256 == want
+    # holdout metric captured from the final evaluation
+    assert prof.data["holdout_metric"]
+
+
+def test_profile_counts_match_direct_binning(profiled):
+    """EFB unpacking is exact: the profile's per-feature histograms equal
+    re-binning the raw matrix through the profile's own mappers."""
+    mp, X, bst = profiled
+    prof = QualityProfile.load(quality_sidecar_path(mp))
+    mappers = prof.mappers()
+    for f, m in enumerate(mappers):
+        nb = int(m.num_bins)
+        want = np.bincount(
+            np.asarray(m.transform(X[:, f]), dtype=np.int64),
+            minlength=nb)
+        got = prof.feature_counts(f)
+        assert np.array_equal(got, want), f"feature {f}"
+        assert int(got.sum()) == X.shape[0]
+
+
+def test_profile_missing_rates(profiled):
+    mp, X, _ = profiled
+    prof = QualityProfile.load(quality_sidecar_path(mp))
+    # feature 0 carries ~15% injected NaN; its missing bin agrees
+    want = float(np.isnan(X[:, 0]).mean())
+    assert prof.missing_rate(0) == pytest.approx(want)
+    assert prof.missing_rate(1) == 0.0
+
+
+def test_sidecar_degrades_never_lies(profiled, tmp_path):
+    mp, X, _ = profiled
+    import shutil
+    mc = str(tmp_path / "m.txt")
+    shutil.copy(mp, mc)
+    sc = quality_sidecar_path(mc)
+
+    # missing sidecar -> None, model loads and predicts
+    model = ModelRegistry(mc, warmup=False).current()
+    assert model.quality is None
+    assert model.predict(X[:3]).shape == (3,)
+
+    # corrupt sidecar -> None (not an exception)
+    with open(sc, "w") as f:
+        f.write("{definitely not json")
+    model = ModelRegistry(mc, warmup=False).current()
+    assert model.quality is None
+
+    # poisoned sidecar (valid JSON, wrong model sha) -> None
+    shutil.copy(quality_sidecar_path(mp), sc)
+    prof = json.load(open(sc))
+    prof["model_sha256"] = "0" * 64
+    json.dump(prof, open(sc, "w"))
+    model = ModelRegistry(mc, warmup=False).current()
+    assert model.quality is None
+
+    # healthy sidecar -> loaded and linked
+    shutil.copy(quality_sidecar_path(mp), sc)
+    model = ModelRegistry(mc, warmup=False).current()
+    assert model.quality is not None
+    assert model.quality.model_sha256 == model.sha256
+
+
+def test_quality_profile_param_disables_sidecar(tmp_path):
+    X, y = _make_data(n=300)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({**PARAMS, "quality_profile": False}, ds,
+                    num_boost_round=3)
+    mp = str(tmp_path / "noprof.txt")
+    bst.save_model(mp)
+    assert not os.path.exists(quality_sidecar_path(mp))
+
+
+def test_profile_chunk_invariant_stream_vs_inmem(tmp_path):
+    """The acceptance bar: streamed and in-memory ingest of the same CSV
+    write byte-identical profiles (modulo the wall-clock stamp)."""
+    rs = np.random.RandomState(5)
+    X = np.round(rs.randn(2000, 5), 2)
+    X[rs.rand(2000, 5) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(float)
+    csv = str(tmp_path / "t.csv")
+    with open(csv, "w") as f:
+        for i in range(len(X)):
+            f.write(f"{y[i]:.0f}," + ",".join(
+                "" if np.isnan(v) else "%.17g" % v for v in X[i]) + "\n")
+    p = {**PARAMS, "bin_construct_sample_cnt": 50000,
+         "ingest_sketch_size": 65536}
+    sidecars = {}
+    for mode, chunk in (("inmem", None), ("stream", 700), ("stream", 333)):
+        os.environ["LGBTPU_INGEST"] = mode
+        if chunk:
+            os.environ["LGBTPU_INGEST_CHUNK"] = str(chunk)
+        try:
+            bst = lgb.train(p, lgb.Dataset(csv, params=p),
+                            num_boost_round=4)
+        finally:
+            os.environ.pop("LGBTPU_INGEST", None)
+            os.environ.pop("LGBTPU_INGEST_CHUNK", None)
+        mp = str(tmp_path / f"m_{mode}_{chunk}.txt")
+        bst.save_model(mp)
+        prof = json.load(open(quality_sidecar_path(mp)))
+        prof.pop("created_unix")
+        sidecars[(mode, chunk)] = json.dumps(prof, sort_keys=True)
+    assert sidecars[("inmem", None)] == sidecars[("stream", 700)]
+    assert sidecars[("stream", 700)] == sidecars[("stream", 333)]
+
+
+# ---------------------------------------------------------------------------
+# drift monitor state machine
+# ---------------------------------------------------------------------------
+
+def _monitor(model, **kw):
+    clock = [0.0]
+    kw.setdefault("threshold", 0.2)
+    kw.setdefault("window_s", 8.0)
+    kw.setdefault("sample", 1.0)
+    kw.setdefault("audit_sample", 0.0)
+    kw.setdefault("min_rows", 200)
+    mon = QualityMonitor(clock=lambda: clock[0], **kw)
+    mon.sync_model(model)
+    return mon, clock
+
+
+def _drive(mon, clock, model, make_batch, steps):
+    for _ in range(steps):
+        clock[0] += 1.0
+        Xb = make_batch()
+        mon.observe_batch(model, Xb, model.raw_scores(Xb))
+        mon.tick(model=model)
+
+
+def test_monitor_fire_and_clear(profiled):
+    mp, X, _ = profiled
+    model = ModelRegistry(mp, warmup=False).current()
+    mon, clock = _monitor(model)
+    rs = np.random.RandomState(1)
+
+    def base():
+        # match the TRAINING distribution, missing rate included — a
+        # vanished NaN stream is itself drift the monitor would flag
+        Xb = rs.randn(50, 6)
+        Xb[:, 4] = rs.randint(0, 9, 50)
+        Xb[rs.rand(50) < 0.15, 0] = np.nan
+        return Xb
+
+    # in-distribution traffic: never fires
+    _drive(mon, clock, model, base, 120)
+    snap = mon.snapshot()
+    assert snap["available"] and mon.fired == 0 and not mon.alerting
+    assert snap["drift"]["drift_fast"] < mon.threshold
+
+    # covariate shift: fires once fast AND slow windows are both over
+    _drive(mon, clock, model, lambda: base() + 5.0, 120)
+    assert mon.alerting and mon.fired == 1
+    snap = mon.snapshot()
+    assert snap["drift"]["drift_fast"] >= mon.threshold
+    assert snap["top_features"], "top-k drifted features surface"
+    assert any(e["kind"] == "fire" for e in snap["timeline"])
+
+    # recovery clears on the fast window alone (slow still elevated)
+    _drive(mon, clock, model, base, 12)
+    assert not mon.alerting and mon.cleared == 1
+    assert mon.snapshot()["drift"]["drift_slow"] >= mon.threshold
+
+
+def test_monitor_slow_window_gates_transients(profiled):
+    """A short spike fills the fast window but not the slow one: no
+    alert — the two-window AND is the flap guard."""
+    mp, X, _ = profiled
+    model = ModelRegistry(mp, warmup=False).current()
+    mon, clock = _monitor(model)
+    rs = np.random.RandomState(2)
+
+    def base():
+        Xb = rs.randn(50, 6)
+        Xb[:, 4] = rs.randint(0, 9, 50)
+        Xb[rs.rand(50) < 0.15, 0] = np.nan
+        return Xb
+
+    # long clean history dominates the slow window...
+    _drive(mon, clock, model, base, 90)
+    assert mon.fired == 0
+    # ...then a 3-step spike saturates the fast window only
+    _drive(mon, clock, model, lambda: base() + 9.0, 3)
+    assert mon.snapshot()["drift"]["drift_fast"] >= mon.threshold
+    assert mon.fired == 0 and not mon.alerting
+
+
+def test_monitor_without_profile_reports_unavailable(profiled, tmp_path):
+    mp, X, _ = profiled
+    import shutil
+    mc = str(tmp_path / "bare.txt")
+    shutil.copy(mp, mc)
+    model = ModelRegistry(mc, warmup=False).current()   # no sidecar
+    assert model.quality is None
+    mon, clock = _monitor(model)
+    Xb = X[:50]
+    mon.observe_batch(model, Xb, model.raw_scores(Xb))
+    d = mon.tick(model=model)
+    assert d == {"available": False}
+    snap = mon.snapshot()
+    assert snap["available"] is False
+    assert "drift" not in snap           # no misreadable zeros
+    assert "no quality sidecar" in snap["reason"]
+
+
+def test_monitor_model_swap_resets(profiled, tmp_path):
+    mp, X, _ = profiled
+    model_a = ModelRegistry(mp, warmup=False).current()
+    mon, clock = _monitor(model_a)
+    rs = np.random.RandomState(3)
+    _drive(mon, clock, model_a,
+           lambda: rs.randn(60, 6) + 7.0, 120)
+    assert mon.alerting
+    mb = tmp_path / "model_b.txt"
+    _train_to_file(mb, seed=11)
+    model_b = ModelRegistry(str(mb), warmup=False).current()
+    mon.sync_model(model_b)
+    # new model: alert cleared, accumulators reset, profile adopted
+    assert not mon.alerting and mon.cleared == 1
+    snap = mon.snapshot()
+    assert snap["available"] and snap["sampled_rows"] == 0
+    assert snap["model_sha256"] == model_b.sha256
+    assert any(e["kind"] == "model" for e in snap["timeline"])
+
+
+# ---------------------------------------------------------------------------
+# shadow audit
+# ---------------------------------------------------------------------------
+
+def test_shadow_audit_bitwise_agreement(profiled):
+    mp, X, _ = profiled
+    model = ModelRegistry(mp, warmup=False).current()
+    mon = QualityMonitor(sample=0.0, audit_sample=1.0)
+    for off in range(0, 200, 25):
+        rows = X[off:off + 25]
+        raw = model.raw_scores(rows)
+        mon.offer_audit(model, rows, raw, False, f"t-{off}")
+    n = mon.audit_once(max_entries=1000)
+    assert n == 200
+    snap = mon.snapshot()
+    assert snap["audit"]["rows"] == 200
+    assert snap["audit"]["mismatches"] == 0
+    assert snap["audit"]["pending"] == 0
+
+
+def test_shadow_audit_detects_tampering(profiled):
+    mp, X, _ = profiled
+    model = ModelRegistry(mp, warmup=False).current()
+    mon = QualityMonitor(sample=0.0, audit_sample=1.0)
+    rows = X[:10]
+    raw = np.asarray(model.raw_scores(rows), dtype=np.float64).copy()
+    raw[0] += 1e-9            # one ULP-scale lie on the wire
+    mon.offer_audit(model, rows, raw, True, "t-x")
+    mon.audit_once()
+    assert mon.snapshot()["audit"]["mismatches"] == 1
+
+
+def test_shadow_audit_ring_is_bounded(profiled):
+    mp, X, _ = profiled
+    model = ModelRegistry(mp, warmup=False).current()
+    mon = QualityMonitor(sample=0.0, audit_sample=1.0, audit_capacity=3)
+    raw = model.raw_scores(X[:2])
+    for _ in range(5):
+        mon.offer_audit(model, X[:2], raw, False, None)
+    snap = mon.snapshot()
+    assert snap["audit"]["pending"] == 3
+    assert snap["audit"]["dropped"] == 2
+
+
+# ---------------------------------------------------------------------------
+# serving surface: /drift, /ready, /stats, access log, fleet report
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def telemetry():
+    from lightgbm_tpu import telemetry as tel
+    tel.reset()
+    tel.configure(enabled=True)
+    yield tel
+    tel.disable()
+    tel.reset()
+    tel.configure(enabled=False, metrics_out="", trace_out="")
+
+
+def test_server_quality_surface(profiled, telemetry):
+    from tests.test_serving import _get, _post
+    mp, X, _ = profiled
+    app = ServingApp(mp, port=0, max_batch=32, max_delay_ms=1.0,
+                     quality_sample=1.0, quality_audit_sample=1.0,
+                     quality_min_rows=100).start()
+    try:
+        host, port = app.host, app.port
+        for off in range(0, 300, 30):
+            st, obj = _post(host, port, "/predict",
+                            {"rows": X[off:off + 30].tolist()})
+            assert st == 200
+        app.quality.tick(model=app.registry.current())
+        audited = app.quality.audit_once(max_entries=1000)
+        assert audited > 0, "batcher hook feeds the audit ring"
+
+        st, drift = _get(host, port, "/drift")
+        assert st == 200
+        assert drift["available"] is True
+        assert drift["sampled_rows"] >= 300
+        assert drift["audit"]["rows"] == audited
+        assert drift["audit"]["mismatches"] == 0
+        assert drift["model_sha256"] == app.registry.current().sha256
+
+        # /stats carries the compact quality block
+        st, stats = _get(host, port, "/stats")
+        assert stats["quality"]["available"] is True
+        assert stats["quality"]["alerting"] is False
+
+        # /ready: a drift alert surfaces as a degraded reason but does
+        # NOT flip readiness (drift is a quality problem, not an outage)
+        st, ready = _get(host, port, "/ready")
+        assert st == 200 and "drift_alert" not in ready
+        app.quality.alerting = True
+        try:
+            st, ready = _get(host, port, "/ready")
+            assert st == 200 and ready["ready"] is True
+            assert ready["drift_alert"] is True
+            assert "data drift" in ready["degraded"]
+        finally:
+            app.quality.alerting = False
+
+        # prometheus gauges flow through the existing /metrics endpoint
+        st, _ = _post(host, port, "/predict", {"rows": X[:2].tolist()})
+        conn = __import__("http.client", fromlist=["x"]).HTTPConnection(
+            host, port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        assert "drift_available 1" in text.replace(".0", "")
+        assert "quality_audit_rows" in text
+    finally:
+        app.shutdown()
+
+
+def test_hot_reload_carries_sidecar(profiled, tmp_path):
+    """/reload to a new model adopts ITS sidecar (and resets the
+    monitor); reloading a model without one degrades to available:false
+    while serving continues."""
+    from tests.test_serving import _get, _post
+    mp, X, _ = profiled
+    mb = str(tmp_path / "model_b.txt")
+    _train_to_file(mb, seed=11)
+    bare = str(tmp_path / "bare.txt")
+    _train_to_file(bare, seed=23)         # a third model...
+    os.remove(quality_sidecar_path(bare))   # ...without its sidecar
+    app = ServingApp(mp, port=0, max_batch=16, max_delay_ms=1.0,
+                     quality_sample=1.0).start()
+    try:
+        host, port = app.host, app.port
+        sha_a = app.registry.current().sha256
+        app.quality.tick(model=app.registry.current())
+        st, d = _get(host, port, "/drift")
+        assert d["available"] and d["model_sha256"] == sha_a
+
+        st, obj = _post(host, port, "/reload", {"path": mb})
+        assert st == 200
+        app.quality.tick(model=app.registry.current())
+        st, d = _get(host, port, "/drift")
+        assert d["available"] is True
+        assert d["model_sha256"] == app.registry.current().sha256 != sha_a
+        assert d["sampled_rows"] == 0     # accumulators reset on swap
+
+        st, obj = _post(host, port, "/reload", {"path": bare})
+        assert st == 200
+        app.quality.tick(model=app.registry.current())
+        st, d = _get(host, port, "/drift")
+        assert d["available"] is False and "reason" in d
+        st, obj = _post(host, port, "/predict", {"rows": X[:2].tolist()})
+        assert st == 200                  # no sidecar != not serving
+    finally:
+        app.shutdown()
+
+
+def test_promotion_carries_sidecar(profiled, tmp_path):
+    """The promotion pointer hands replicas a model PATH; the registry
+    load of that path picks the sidecar up with no fleet involvement."""
+    from lightgbm_tpu.serving.fleet import promote_pointer, read_pointer
+    mp, X, _ = profiled
+    d = str(tmp_path)
+    promote_pointer(d, mp)
+    target = read_pointer(d)["path"]
+    model = ModelRegistry(target, warmup=False).current()
+    assert model.quality is not None
+    assert model.quality.model_sha256 == model.sha256
+    # a poisoned sidecar on the promoted path: replica still loads+serves
+    sc = quality_sidecar_path(target)
+    prof = json.load(open(sc))
+    prof["model_sha256"] = "f" * 64
+    json.dump(prof, open(sc, "w"))
+    try:
+        model = ModelRegistry(target, warmup=False).current()
+        assert model.quality is None
+        assert model.predict(X[:2]).shape == (2,)
+    finally:
+        json.dump({**prof, "model_sha256": model.sha256}, open(sc, "w"))
+
+
+def test_fleet_report_cli_merges_replicas(tmp_path, capsys):
+    fleet_dir = str(tmp_path)
+    for rank, (alerting, rows) in enumerate([(False, 100), (True, 50)]):
+        snap = {"available": True, "alerting": alerting,
+                "model_sha256": "ab" * 32,
+                "audit": {"rows": rows, "mismatches": rank, "pending": 0,
+                          "dropped": 0},
+                "top_features": [{"feature": 2, "psi_fast": 0.5 + rank}],
+                "sampled_rows": rows}
+        with open(os.path.join(fleet_dir,
+                               f"drift_replica_{rank}.json"), "w") as f:
+            json.dump(snap, f)
+    rep = merge_reports(fleet_dir)
+    assert rep["available"] and rep["any_alerting"]
+    assert rep["replicas"]["0"]["alerting"] is False
+    assert rep["replicas"]["1"]["alerting"] is True
+    assert rep["audit"]["rows"] == 150
+    assert rep["audit"]["mismatches"] == 1
+    # per-feature max across replicas, not a sum
+    assert rep["top_features"][0] == {"feature": 2, "max_psi": 1.5}
+
+    assert main(["report", fleet_dir]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["audit"]["rows"] == 150
+    # empty dir: NOTICE + nonzero, so a cron can tell "no data" apart
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert main(["report", empty]) == 1

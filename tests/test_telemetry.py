@@ -185,6 +185,28 @@ def test_metrics_registry_instruments(telemetry):
     assert h["mean_s"] == pytest.approx(0.101)
 
 
+def test_quantiles_value_on_bucket_bound(telemetry):
+    """A quantile landing EXACTLY on a cumulative-bucket boundary must
+    report from the bucket holding the value, not the next one.  With 19
+    of 20 samples at the 2.0 bound, ``0.95 * 20`` is 19.000000000000004
+    in binary — an unguarded walk steps past bucket 2.0 and interpolates
+    inside (2.0, 4.0]."""
+    for _ in range(19):
+        tel.observe("qb", 2.0, bounds=(1.0, 2.0, 4.0))
+    tel.observe("qb", 5.0, bounds=(1.0, 2.0, 4.0))
+    q = tel.quantiles("qb", qs=(0.5, 0.95, 0.99))
+    assert q["p50"] == 2.0          # clamped up to the observed min
+    assert q["p95"] == 2.0          # ON the bound, not past it
+    assert q["p99"] == pytest.approx(4.8)   # inside the last bucket
+
+
+def test_quantiles_single_bucket_degenerate(telemetry):
+    tel.observe("q1", 0.5, bounds=(1.0,))
+    q = tel.quantiles("q1", qs=(0.5, 0.99))
+    # every quantile clamps into [min, max] of the observations
+    assert q["p50"] == 0.5 and q["p99"] == 0.5
+
+
 # ---------------------------------------------------------------------------
 # per-iteration training records
 # ---------------------------------------------------------------------------
