@@ -179,6 +179,9 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "stochastic_rounding": [],
     # --- TPU-specific knobs (new in this framework) ---
     "hist_backend": [],          # auto | segsum | onehot | pallas | stream
+                                 # | scatter
+    "hist_packed_width": ["histogram_packed_width"],  # 32 | 16 | 8
+    "route_fusion": ["goss_route_fusion"],  # auto | on | off
     "hist_precision": [],        # auto | mixed (two-pass bf16, ~f32) | single
     "max_splits_per_round": [],  # batched leaf-wise: leaves split per device round
     "multiclass_batched": ["batched_multiclass"],
@@ -514,6 +517,23 @@ class Config:
 
     # --- TPU-native knobs ---
     hist_backend: str = "auto"
+    # packed quantized-gradient histogram width (bits per grad/hess field
+    # on the mesh wire): 32 = exact int32 lanes (default); 16 packs each
+    # (grad, hess) pair into ONE int32 lane — HALF the psum/psum_scatter
+    # bytes per round; 8 packs the pair into one int16 lane — a QUARTER.
+    # Requires use_quantized_grad with the stream backend; widths < 32
+    # requantize with a shared power-of-two shift per round (documented-ulp,
+    # parallel/comms.pack_gh_wire) and only change the WIRE — single-device
+    # histograms stay exact int32. LGBTPU_HIST_PACKED_WIDTH overrides for
+    # A/B experiments.
+    hist_packed_width: int = 32
+    # GOSS/bagging route fusion (docs/PERF.md "histogram-formulation
+    # floor"): auto = under row compaction on the stream backend, skip the
+    # per-round route-only FULL-data pass and replay every round's stored
+    # route table over the full rows in ONE fused kernel launch after
+    # growth (bit-identical — the replay applies the exact same table
+    # steps); on/off force. LGBTPU_ROUTE_FUSION=1/0 overrides for A/B.
+    route_fusion: str = "auto"
     hist_precision: str = "auto"   # auto = single on the TPU stream
                                    # backend (reference GPU default,
                                    # gpu_use_dp=false); mixed = ~f32

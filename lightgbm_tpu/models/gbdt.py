@@ -38,6 +38,11 @@ from ..utils.log import LightGBMError, log_info, log_warning
 from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
 
+# accepted hist_backend values (docs/PERF.md "histogram-formulation floor"):
+# three A/B-able formulations — one-hot/segsum contractions, the fused
+# stream kernel, and the scatter-add tile — plus the pallas direct kernel
+HIST_BACKENDS = ("auto", "segsum", "onehot", "pallas", "stream", "scatter")
+
 # span name -> per-iteration record key for the telemetry phase splits
 _PHASE_KEYS = {
     "GBDT::Boosting": "boosting_s",
@@ -500,7 +505,8 @@ class GBDT:
                 "'pad'")
         gp = self._grow_params
         eligible = (mode != "off"
-                    and gp.hist_backend in ("stream", "segsum", "onehot")
+                    and gp.hist_backend in ("stream", "segsum", "onehot",
+                                        "scatter")
                     and (self.mesh is None or self._mesh_stream
                          or self._voting or self._feature_mode))
         if not eligible and not _tel_tracer.enabled:
@@ -637,15 +643,46 @@ class GBDT:
         # iteration, different per-round figure
         k = k_all
         kb = k if (k > 1 and self._use_batched_multiclass()) else 1
+        # packed wire (hist_packed_width 16/8): the quantized grad/hess
+        # pair rides ONE int32/int16 lane — half/quarter bytes; K=1 grow
+        # programs only (the batched-multiclass wire stays exact int32;
+        # the per-class scan reduces K packed single-class blocks)
+        pw = gp.hist_packed_width if gp.int_hist and kb == 1 else 32
         per_round = hist_comms_bytes_per_round(
             S, self.dd.num_groups, self.dd.max_bins, d, gp.hist_comms,
-            cdtype, num_class=kb)
+            cdtype, num_class=kb, packed_width=pw)
         self._comms_model_cache = {
             "mode": gp.hist_comms, "dtype": cdtype,
             "devices": d, "per_round_bytes": per_round,
+            "packed_width": pw,
             "hist_block_bytes": per_round,
             "per_iter_bytes": per_round * rounds2 * (k // kb)}
         return self._comms_model_cache
+
+    def _route_only_passes_per_tree(self) -> int:
+        """Full-data route-only passes one grown tree costs (telemetry
+        counter hist/route_only_passes).  Only the compacted stream path
+        routes the full row set separately from its histogram pass;
+        GOSS+stream fusion folds ALL of a tree's per-round passes into ONE
+        replay launch — the counter's drop is the fusion A/B signal.  The
+        predicate mirrors the grower's fusion eligibility gate
+        (ops/grow.py); tests/test_hist_backends.py pins the two against
+        each other."""
+        gp = self._grow_params
+        if gp.hist_backend != "stream" or self._last_compact_rows <= 0:
+            return 0
+        L = gp.num_leaves
+        S = min(gp.max_splits_per_round, max(L - 1, 1))
+        batched_mc = (self.num_tree_per_iteration > 1
+                      and self._use_batched_multiclass())
+        fused = (gp.route_fusion and S >= 64 and gp.max_depth <= 0
+                 and gp.plain_growth and not gp.has_categorical
+                 and L <= 256 and not batched_mc
+                 and self._parse_forced_splits() is None
+                 and self._cegb_lazy is None)
+        if fused:
+            return 1
+        return -(-(L - 1) // max(S, 1)) + 1
 
     # ------------------------------------------------------------------
     def _mesh_shards_rows_only(self) -> bool:
@@ -662,10 +699,25 @@ class GBDT:
         kernel runs per-device inside shard_map with a histogram psum (the
         reference's per-worker fast path + ReduceScatter,
         data_parallel_tree_learner.cpp:285-299); feature-sharded meshes use
-        the contraction backends, which GSPMD partitions automatically."""
-        b = self.config.hist_backend
+        the contraction backends, which GSPMD partitions automatically.
+
+        ``LGBTPU_HIST_BACKEND`` overrides the param (A/B experiments across
+        the histogram formulations, docs/PERF.md) and passes through the
+        same validation/mesh gates as the param itself."""
+        import os as _os
+        b = (_os.environ.get("LGBTPU_HIST_BACKEND", "")
+             or self.config.hist_backend)
+        if b not in HIST_BACKENDS:
+            raise LightGBMError(
+                f"unknown hist_backend={b!r}; one of {HIST_BACKENDS}")
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if self.mesh is not None:
+            if b == "scatter":
+                raise LightGBMError(
+                    "hist_backend=scatter is single-device only (the "
+                    "scatter tile is one unsharded VMEM block); use "
+                    "hist_backend=stream or the contraction backends "
+                    "under a mesh")
             if self._voting_planned:
                 # the PV-Tree shard_map learner ignores the hist backend;
                 # avoid packing a stream layout it would never read
@@ -702,10 +754,11 @@ class GBDT:
             return "double" if backend in ("segsum", "onehot") \
                 and jax.default_backend() == "cpu" \
                 and not self._voting_planned else "single"
-        if p == "double" and backend in ("stream", "pallas"):
+        if p == "double" and backend in ("stream", "pallas", "scatter"):
             raise LightGBMError(
                 "hist_precision=double requires hist_backend=segsum or "
-                "onehot (the TPU stream/pallas kernels are f32/int8)")
+                "onehot (the TPU stream/pallas/scatter kernels are "
+                "f32/int8)")
         if p == "double" and self._voting_planned:
             raise LightGBMError(
                 "hist_precision=double is not supported with "
@@ -791,6 +844,32 @@ class GBDT:
             return None
         return tuple((int(b), int(g)) for b, g in buckets)
 
+    def _resolved_packed_width(self) -> int:
+        """Packed-wire width for the quantized histogram collective
+        (hist_packed_width; ``LGBTPU_HIST_PACKED_WIDTH`` A/B override).
+        Pass-through to the grower, which engages packing only where it
+        changes anything: the int-hist stream path under a mesh."""
+        import os as _os
+        env = _os.environ.get("LGBTPU_HIST_PACKED_WIDTH", "")
+        w = int(env) if env else self.config.hist_packed_width
+        if w not in (32, 16, 8):
+            raise LightGBMError(
+                f"LGBTPU_HIST_PACKED_WIDTH={w!r} is not one of 32, 16, 8")
+        return w
+
+    def _resolved_route_fusion(self) -> bool:
+        """GOSS+stream fusion switch (route_fusion; ``LGBTPU_ROUTE_FUSION``
+        =1/0 A/B override).  auto resolves ON — the replay is bit-identical
+        to the per-round route-only passes and the grower gates itself off
+        wherever fusion does not apply (no compaction, categorical trees,
+        CEGB lazy costs, forced splits, depth limits, leaf budgets past the
+        table buffer's VMEM bound)."""
+        import os as _os
+        env = _os.environ.get("LGBTPU_ROUTE_FUSION", "")
+        if env:
+            return env not in ("0", "off", "false")
+        return str(self.config.route_fusion).lower() in ("auto", "on")
+
     def _make_grow_params(self) -> GrowParams:
         c = self.config
         gp = GrowParams(
@@ -840,6 +919,8 @@ class GBDT:
                               c.cegb_penalty_feature_lazy)) > 0)),
             cegb_tradeoff=c.cegb_tradeoff,
             cegb_penalty_split=c.cegb_penalty_split,
+            hist_packed_width=self._resolved_packed_width(),
+            route_fusion=self._resolved_route_fusion(),
         )
         mode, cdtype = self._resolve_hist_comms(gp)
         # double-buffered scatter (parallel/comms.reduce_hist): bitwise
@@ -1022,6 +1103,36 @@ class GBDT:
             raise LightGBMError(
                 f"hist_precision={c.hist_precision!r} is not one of "
                 "'auto', 'single', 'mixed', 'double'")
+        if c.hist_backend not in HIST_BACKENDS:
+            raise LightGBMError(
+                f"unknown hist_backend={c.hist_backend!r}; one of "
+                f"{HIST_BACKENDS}")
+        if c.hist_backend == "scatter" and c.tree_learner == "feature":
+            raise LightGBMError(
+                "hist_backend=scatter is not supported with "
+                "tree_learner=feature (the scatter tile is one unsharded "
+                "VMEM block; group sharding cannot slice it) — use "
+                "hist_backend=segsum or onehot")
+        if c.hist_packed_width not in (32, 16, 8):
+            raise LightGBMError(
+                f"hist_packed_width={c.hist_packed_width!r} is not one of "
+                "32, 16, 8")
+        if c.hist_packed_width != 32:
+            if not c.use_quantized_grad:
+                raise LightGBMError(
+                    "hist_packed_width=16/8 packs the QUANTIZED int32 "
+                    "grad/hess wire and needs use_quantized_grad=True "
+                    "(the f32 histograms have no integer wire to pack)")
+            if c.linear_tree:
+                raise LightGBMError(
+                    "hist_packed_width=16/8 is not supported with "
+                    "linear_tree (leaf regressions feed on exact "
+                    "histogram sums; the requantized wire is "
+                    "documented-ulp, not exact)")
+        if str(c.route_fusion).lower() not in ("auto", "on", "off"):
+            raise LightGBMError(
+                f"route_fusion={c.route_fusion!r} is not one of 'auto', "
+                "'on', 'off'")
 
         def _nonempty(v):
             return v is not None and len(np.atleast_1d(v)) > 0
@@ -1530,7 +1641,8 @@ class GBDT:
                 "'pad'")
         gp = self._grow_params
         eligible = (cmode in ("auto", "pad")
-                    and gp.hist_backend in ("stream", "segsum", "onehot")
+                    and gp.hist_backend in ("stream", "segsum", "onehot",
+                                        "scatter")
                     and (self.mesh is None or self._mesh_stream
                          or self._voting or self._feature_mode))
         if not eligible:
@@ -1838,6 +1950,16 @@ class GBDT:
             rec["compact_rows"] = self._last_compact_rows
             _tel_registry.gauge("train/sampled_rows",
                                 self._last_sampled_rows)
+        # ---- histogram formulation (docs/PERF.md floor A/B) ----
+        gp = self._grow_params
+        rec["hist_backend"] = gp.hist_backend
+        if gp.int_hist and gp.hist_packed_width != 32 \
+                and self.mesh is not None:
+            rec["hist_packed_width"] = gp.hist_packed_width
+        n_route = self._route_only_passes_per_tree() * k
+        rec["route_only_passes"] = n_route
+        if n_route:
+            _tel_registry.inc("hist/route_only_passes", n_route)
         # ---- comms: analytic histogram payload + measured barrier wait ----
         cm = self._comms_model()
         if cm is not None:

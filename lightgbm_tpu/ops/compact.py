@@ -69,8 +69,8 @@ def check_compact_supported(hist_backend: str, mesh) -> None:
     """Eligibility guard shared by grow_tree and grow_tree_k (the engine
     pre-screens the same conditions; this catches direct callers)."""
     if hist_backend == "pallas":
-        raise ValueError("row compaction supports the stream/segsum/onehot "
-                         "histogram backends only")
+        raise ValueError("row compaction supports the stream/segsum/onehot/"
+                         "scatter histogram backends only")
     if mesh is not None and hist_backend != "stream":
         raise ValueError("row compaction under a mesh requires "
                          "hist_backend=stream (per-shard partition)")

@@ -98,6 +98,19 @@ class GrowParams(NamedTuple):
     # of independent psum_scatter chunks along the slot/class axis so the
     # collective overlaps compute — bitwise identical to 1
     hist_comms_chunks: int = 1
+    # packed-wire quantized histograms (docs/PERF.md "histogram-formulation
+    # floor"): 16 re-quantizes the int32 grad/hess pair per round into
+    # (int15, uint16) digits packed into ONE int32 lane, halving collective
+    # bytes; 8 packs (int7, uint8) into int16 — a quarter.  The kernel
+    # accumulation stays exact int32; only the WIRE is requantized (pow2
+    # scales, documented-ulp).  32 = off.  No-op without a mesh.
+    hist_packed_width: int = 32
+    # GOSS+stream fusion (resolved by the engine from Config.route_fusion):
+    # skip the per-round full-data route-only pass and replay the stored
+    # round tables over all rows in ONE fused launch after growth —
+    # bit-identical leaf ids, bins stream from HBM once per tree instead of
+    # once per round
+    route_fusion: bool = False
 
     @property
     def plain_growth(self) -> bool:
@@ -178,6 +191,11 @@ class _GrowState(NamedTuple):
     num_leaves_cur: jax.Array   # () i32
     progressed: jax.Array       # () bool
     col_mask: jax.Array         # (F,) bool feature sampling mask for this tree
+    # GOSS+stream fusion table buffer ((rounds_buf * NUM_TAB, L) f32; (1, 1)
+    # dummy when fusion is off): round r's route tables land at rows
+    # [r*NUM_TAB, (r+1)*NUM_TAB) and are replayed over ALL rows in ONE
+    # fused launch after growth (pallas.stream_kernel.route_replay)
+    tabs_buf: jax.Array
 
 
 def intermediate_monotone_bounds(anc_left, anc_right, node_mono, leaf_out,
@@ -471,6 +489,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         check_compact_supported(params.hist_backend,
                                 None if use_fp else mesh)
     bins_packed = None
+    fuse, R_buf = False, 1   # GOSS+stream fusion (resolved in the stream block)
     Bpad = -(-Bmax // 8) * 8
     # reduce_scatter comms (docs/DISTRIBUTED.md): the histogram block is
     # Reduce-Scattered over the feature-group axis instead of psum'd whole,
@@ -525,9 +544,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                                       params.hist_backend, S, Bmax, hdt)
         fp_bin = make_sharded_bin_gather(mesh, feature_axis, fp_plan.gs)
     if use_stream:
-        from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
-                                            route_and_hist,
-                                            stream_block_rows)
+        from ..pallas.stream_kernel import (NUM_TAB, build_route_tables,
+                                            pack_bins_T, route_and_hist,
+                                            route_replay, stream_block_rows)
         T_rows = stream_block_rows(Bmax, G, params.int_hist,
                                    bin_buckets=params.bin_buckets)
         if packed is None:
@@ -565,12 +584,44 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                 mesh=mesh, row_axis=row_axis)
         n_pad_h = bins_T_h.shape[1]
 
+        # ---- GOSS+stream fusion (docs/PERF.md "histogram-formulation
+        # floor"): only the COMPACTED path runs a per-round full-data
+        # route-only pass, and fusion removes it — each round's route
+        # tables are stashed in a buffer and replayed over ALL rows in ONE
+        # launch after growth (bins stream from HBM once per tree, not once
+        # per round; bit-identical by _route_step sharing).  Gated off for
+        # features that read every row's CURRENT leaf id mid-growth (CEGB
+        # lazy costs), categorical trees (bitset overlays are not in the
+        # round tables), forced splits / depth limits (non-sprint
+        # schedules), and leaf budgets whose table buffer would not stay
+        # VMEM-resident.
+        fuse = (params.route_fusion and use_compact and not forced
+                and S >= 64 and params.max_depth <= 0
+                and params.plain_growth and not use_lazy
+                and not params.has_categorical and L <= 256)
+        # round bound: 7 budget-64 prefix rounds + <= L-1 splitting rounds
+        # + one zero-split round + the sprint (round_idx increments once
+        # per body)
+        R_buf = L + 10 if fuse else 1
+
         if mesh is not None:
             # data-parallel stream path: per-device kernel + histogram psum —
             # the reference's per-worker histogram construction followed by
             # ReduceScatter (data_parallel_tree_learner.cpp:285-299)
             from jax.sharding import PartitionSpec as P
             from ..parallel.mesh import shard_map_rows
+
+            # packed-wire quantized histograms (hist_packed_width 16 / 8):
+            # the kernel's exact int32 grad/hess pair is re-quantized per
+            # round (pow2 scales, cross-device agreed) and packed into ONE
+            # int32 / int16 lane at the collective seam — half / quarter
+            # the wire bytes, carry-free summation by cap construction,
+            # exact unpack on the far side (documented-ulp overall)
+            use_packed = use_int and params.hist_packed_width < 32
+            if use_packed:
+                from ..parallel.comms import pack_gh_wire, unpack_gh_wire
+                packed_w = params.hist_packed_width
+                D_rows = mesh.shape[row_axis]
 
             def _rh(bT, lid_row, wT, tb, bi, num_slots, with_hist=True):
                 def _local(bT, lid_row, wT, tb, bi):
@@ -581,7 +632,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         with_hist=with_hist,
                         bin_buckets=params.bin_buckets)
                     if with_hist:
-                        if use_rs:
+                        if use_packed:
+                            pw, pscales = pack_gh_wire(h, row_axis, packed_w,
+                                                       D_rows)
+                            if use_rs:
+                                pw = reduce_hist(
+                                    pw, row_axis, 1, plan, "f32",
+                                    chunks=params.hist_comms_chunks)
+                            else:
+                                with jax.named_scope("hist_psum_packed"):
+                                    pw = jax.lax.psum(pw, row_axis)
+                            h = unpack_gh_wire(pw, pscales, packed_w)
+                        elif use_rs:
                             h = reduce_hist(h, row_axis, 1, plan,
                                             params.hist_comms_dtype,
                                             chunks=params.hist_comms_chunks)
@@ -746,6 +808,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
         num_leaves_cur=jnp.asarray(1, i32),
         progressed=jnp.asarray(True),
         col_mask=col_mask,
+        tabs_buf=(jnp.zeros((R_buf * NUM_TAB, L), f32) if fuse
+                  else jnp.zeros((1, 1), f32)),
     )
 
     def cond(st: _GrowState):
@@ -914,7 +978,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
                         bits_l.T, S, with_hist=with_hist)
                 if use_int and with_hist:
                     hist_small = hist_small.astype(f32) * hscale
-                if use_compact:
+                if use_compact and fuse:
+                    # GOSS+stream fusion: stash this round's tables — the
+                    # full-data route-only pass is REPLAYED in one fused
+                    # launch after growth, so every-row leaf ids stay stale
+                    # until then (nothing reads them mid-growth under the
+                    # fusion eligibility gate)
+                    st2 = st2._replace(
+                        tabs_buf=jax.lax.dynamic_update_slice(
+                            st.tabs_buf, tabs, (st.round_idx * NUM_TAB, 0)))
+                    new_leaf_id = st.leaf_id
+                    new_leaf_c = new_leaf_row.reshape(-1)
+                elif use_compact:
                     # full-data ROUTE-ONLY pass (no one-hot contraction, no
                     # VMEM histogram block): every row's leaf id stays
                     # current for the score update / renew / CEGB paths
@@ -1452,6 +1527,31 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
             cond(state), make_body(S_f, with_hist=False), lambda s: s, state)
     else:
         final = jax.lax.while_loop(cond, make_body(S), state)
+
+    if fuse:
+        # ---- fused full-data route REPLAY (GOSS+stream fusion) ----
+        # one launch re-routes EVERY row through the stored round tables:
+        # bins stream from HBM once per tree instead of once per route-only
+        # round, and the replay trip count is the tree's actual round count
+        # (unused buffer rows are exact no-op steps and never execute)
+        with jax.named_scope("route_replay"):
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                from ..parallel.mesh import shard_map_rows
+                _rep = shard_map_rows(
+                    lambda bT, tb, nr: route_replay(
+                        bT, tb, nr, L, block_rows=T_rows,
+                        rounds_buf=R_buf)[None],
+                    mesh,
+                    (P(None, row_axis), P(None, None), P()),
+                    P(None, row_axis))
+                replayed = _rep(bins_T, final.tabs_buf,
+                                final.round_idx)[0]
+            else:
+                replayed = route_replay(bins_T, final.tabs_buf,
+                                        final.round_idx, L,
+                                        block_rows=T_rows, rounds_buf=R_buf)
+        final = final._replace(leaf_id=replayed)
 
     if use_output:
         # constrained/smoothed outputs were fixed at split time (reference:

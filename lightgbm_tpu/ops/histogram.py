@@ -16,6 +16,9 @@ Backends:
   * ``segsum``  — jax.ops.segment_sum scatter (correct everywhere; fast on CPU).
   * ``onehot``  — blocked one-hot matmul (MXU path, pure XLA).
   * ``pallas``  — fused Pallas TPU kernel (see pallas/hist_kernel.py).
+  * ``scatter`` — Pallas scatter-add into a VMEM-resident tile, no one-hot
+    (pallas/scatter_hist_kernel.py; VMEM-gated with one-hot fallback —
+    the cuda_histogram_constructor formulation).
 """
 from __future__ import annotations
 
@@ -64,6 +67,17 @@ def build_histograms(bins: jax.Array, slot: jax.Array, grad: jax.Array,
         from ..pallas.hist_kernel import build_histograms_sorted
         return build_histograms_sorted(bins, slot, grad, hess, cnt, num_slots,
                                        max_group_bins, bins_packed=bins_packed)
+    if backend == "scatter":
+        from ..pallas.scatter_hist_kernel import (build_histograms_scatter,
+                                                  scatter_hist_fits)
+        if scatter_hist_fits(num_slots, bins.shape[1], max_group_bins):
+            return build_histograms_scatter(bins, slot, grad, hess, cnt,
+                                            num_slots, max_group_bins)
+        # VMEM gate refused the scatter tile: automatic one-hot fallback
+        # (same histogram from the contraction formulation —
+        # tests/test_hist_backends.py asserts the identity)
+        return _hist_onehot(bins, slot, grad, hess, cnt, num_slots,
+                            max_group_bins, block_rows, dtype, acc_dtype)
     raise ValueError(f"unknown hist backend {backend!r}")
 
 
@@ -176,6 +190,18 @@ def build_histograms_k(bins: jax.Array, slot: jax.Array, grad: jax.Array,
                                     num_slots, max_group_bins,
                                     bins_packed=bins_packed)
             for k in range(num_class)])
+    if backend == "scatter":
+        from ..pallas.scatter_hist_kernel import (build_histograms_scatter_k,
+                                                  scatter_hist_fits)
+        if scatter_hist_fits(num_slots, bins.shape[1], max_group_bins,
+                             num_class):
+            return build_histograms_scatter_k(bins, slot, grad, hess, cnt,
+                                              num_class, num_slots,
+                                              max_group_bins)
+        # VMEM gate refused the widened scatter tile: one-hot fallback
+        return _hist_onehot_k(bins, slot, grad, hess, cnt, num_class,
+                              num_slots, max_group_bins, block_rows, dtype,
+                              acc_dtype)
     raise ValueError(f"unknown hist backend {backend!r}")
 
 

@@ -90,6 +90,67 @@ def _digits(v):
     return (v & 127).astype(jnp.float32), (v >> 7).astype(jnp.float32)
 
 
+def _route_step(iv, bins_ref, bins32, GW, T, u8_layout):
+    """Shared single-table routing math: decode one (NUM_TAB, T) block of
+    gathered table values into each row's routing decision.
+
+    Used by BOTH the per-round fused kernel (_route_hist_kernel) and the
+    fused route-replay kernel (_route_replay_kernel), so the two can never
+    drift — the replay's bit-identity to the per-round route-only passes
+    rests on this sharing.
+
+    Returns (chosen_i, newid, fb, go_left_i, slot_l1, slot_r1, slot_k1)
+    with go_left_i the NUMERIC decision (threshold + NaN/missing-zero
+    default direction); the caller overlays the categorical bit where it
+    has the bitset operand."""
+    i32 = jnp.int32
+    chosen_i = iv[T_CHOSEN:T_CHOSEN + 1, :]
+    newid = iv[T_NEWID_LO:T_NEWID_LO + 1, :] + (iv[T_NEWID_HI:T_NEWID_HI + 1, :] << 7)
+    wordi = iv[T_WORD_LO:T_WORD_LO + 1, :] + (iv[T_WORD_HI:T_WORD_HI + 1, :] << 7)
+    shift = iv[T_SHIFT:T_SHIFT + 1, :]
+    span = iv[T_SPAN:T_SPAN + 1, :]
+    defbin = iv[T_DEFBIN:T_DEFBIN + 1, :]
+    bundled_i = iv[T_BUNDLED:T_BUNDLED + 1, :]
+    has_nan_i = iv[T_HASNAN:T_HASNAN + 1, :]
+    nanbin = iv[T_NANBIN:T_NANBIN + 1, :]
+    nbins = iv[T_NBINS:T_NBINS + 1, :]
+    thr = iv[T_THR:T_THR + 1, :]
+    defleft_i = iv[T_DEFLEFT:T_DEFLEFT + 1, :]
+
+    # select the split feature's group-local bin for every row
+    if u8_layout:
+        # unpacked (G_pad, T) int8 storage: same HBM bytes as the packed
+        # 4-per-word form (28 B/row either way at G=28) but no per-group
+        # shift/mask unpack work in the kernel
+        grpi = wordi * 4 + jax.lax.shift_right_logical(shift, 3)
+        gp_iota = jax.lax.broadcasted_iota(i32, bins32.shape, 0)
+        gb = jnp.sum(jnp.where(gp_iota == grpi, bins32, 0), axis=0,
+                     keepdims=True)                      # (1, T)
+    else:
+        # packed: select the split feature's group word, then its byte
+        words = bins_ref[...]                            # (GW, T) i32
+        gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
+        word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
+                       keepdims=True)                    # (1, T)
+        gb = jax.lax.shift_right_logical(word, shift) & 0xFF
+
+    # feature-local bin for EFB bundles (ops/grow.py feature_local_bin)
+    ls = gb - span
+    ge_def = jnp.where(ls >= defbin, 1, 0)
+    fb_b = jnp.where((ls >= 0) & (ls < nbins - 1), ls + ge_def, defbin)
+    fb = jnp.where(bundled_i > 0, fb_b, gb)
+
+    has_mz_i = iv[T_HASMZ:T_HASMZ + 1, :]
+    mzbin = iv[T_MZBIN:T_MZBIN + 1, :]
+    is_nan_i = has_nan_i * jnp.where(fb == nanbin, 1, 0)
+    is_mz_i = has_mz_i * jnp.where(fb == mzbin, 1, 0)
+    le_thr = jnp.where(fb <= thr, 1, 0)
+    go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
+    return (chosen_i, newid, fb, go_left_i,
+            iv[T_SLOT_L:T_SLOT_L + 1, :], iv[T_SLOT_R:T_SLOT_R + 1, :],
+            iv[T_SLOT_KEEP:T_SLOT_KEEP + 1, :])
+
+
 def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                        newleaf_ref, *outs, T, G, B, S, L, GW,
                        has_cat: bool, two_pass: bool = True,
@@ -157,54 +218,13 @@ def _route_hist_kernel(bins_ref, leaf_ref, w_ref, tabs_ref, bits_ref,
                 preferred_element_type=f32)                  # (NUM_TAB, T)
         # flags stay i32 (0/1) throughout — Mosaic cannot handle i1 vectors
         # as select OPERANDS (i8<->i1 truncation); predicates are fresh
-        # comparisons
+        # comparisons.  The per-table routing math is the shared
+        # _route_step (also the replay kernel's step — never drifts).
         iv = vals.astype(i32)
-        chosen_i = iv[T_CHOSEN:T_CHOSEN + 1, :]
-        newid = iv[T_NEWID_LO:T_NEWID_LO + 1, :] + (iv[T_NEWID_HI:T_NEWID_HI + 1, :] << 7)
-        wordi = iv[T_WORD_LO:T_WORD_LO + 1, :] + (iv[T_WORD_HI:T_WORD_HI + 1, :] << 7)
-        shift = iv[T_SHIFT:T_SHIFT + 1, :]
-        span = iv[T_SPAN:T_SPAN + 1, :]
-        defbin = iv[T_DEFBIN:T_DEFBIN + 1, :]
-        bundled_i = iv[T_BUNDLED:T_BUNDLED + 1, :]
-        has_nan_i = iv[T_HASNAN:T_HASNAN + 1, :]
-        nanbin = iv[T_NANBIN:T_NANBIN + 1, :]
-        nbins = iv[T_NBINS:T_NBINS + 1, :]
-        thr = iv[T_THR:T_THR + 1, :]
-        defleft_i = iv[T_DEFLEFT:T_DEFLEFT + 1, :]
+        (chosen_i, newid, fb, go_left_i,
+         slot_l1, slot_r1, slot_k1) = _route_step(iv, bins_ref, bins32,
+                                                  GW, T, u8_layout)
         is_cat_i = iv[T_ISCAT:T_ISCAT + 1, :]
-        slot_l1 = iv[T_SLOT_L:T_SLOT_L + 1, :]
-        slot_r1 = iv[T_SLOT_R:T_SLOT_R + 1, :]
-        slot_k1 = iv[T_SLOT_KEEP:T_SLOT_KEEP + 1, :]
-
-        # select the split feature's group-local bin for every row
-        if u8_layout:
-            # unpacked (G_pad, T) int8 storage: same HBM bytes as the packed
-            # 4-per-word form (28 B/row either way at G=28) but no per-group
-            # shift/mask unpack work in the kernel
-            grpi = wordi * 4 + jax.lax.shift_right_logical(shift, 3)
-            gp_iota = jax.lax.broadcasted_iota(i32, bins32.shape, 0)
-            gb = jnp.sum(jnp.where(gp_iota == grpi, bins32, 0), axis=0,
-                         keepdims=True)                      # (1, T)
-        else:
-            # packed: select the split feature's group word, then its byte
-            words = bins_ref[...]                            # (GW, T) i32
-            gw_iota = jax.lax.broadcasted_iota(i32, (GW, T), 0)
-            word = jnp.sum(jnp.where(gw_iota == wordi, words, 0), axis=0,
-                           keepdims=True)                    # (1, T)
-            gb = jax.lax.shift_right_logical(word, shift) & 0xFF
-
-        # feature-local bin for EFB bundles (ops/grow.py feature_local_bin)
-        ls = gb - span
-        ge_def = jnp.where(ls >= defbin, 1, 0)
-        fb_b = jnp.where((ls >= 0) & (ls < nbins - 1), ls + ge_def, defbin)
-        fb = jnp.where(bundled_i > 0, fb_b, gb)
-
-        has_mz_i = iv[T_HASMZ:T_HASMZ + 1, :]
-        mzbin = iv[T_MZBIN:T_MZBIN + 1, :]
-        is_nan_i = has_nan_i * jnp.where(fb == nanbin, 1, 0)
-        is_mz_i = has_mz_i * jnp.where(fb == mzbin, 1, 0)
-        le_thr = jnp.where(fb <= thr, 1, 0)
-        go_left_i = jnp.where(is_nan_i + is_mz_i > 0, defleft_i, le_thr)
         if has_cat:
             # per-row categorical bit: (Bmax, L) @ (L, T) one-hot, pick fb
             if leaf_oh is None:
@@ -611,6 +631,84 @@ def route_and_hist(bins_T: jax.Array, leaf_id: jax.Array, w_T: jax.Array,
     if K == 1:
         hist4 = hist4[0]
     return new_leaf, hist4, _cnt_out(cnt)
+
+
+def _route_replay_kernel(nr_ref, bins_ref, tabs_ref, newleaf_ref, *,
+                         T: int, L: int, GW: int, u8_layout: bool,
+                         f32_dots: bool):
+    """Fused full-data route REPLAY (GOSS+stream fusion, docs/PERF.md):
+    starting from leaf 0, apply every stored round table in sequence to
+    this row block in ONE kernel launch — bins stream from HBM ONCE per
+    tree instead of once per round.  The trip count is the tree's ACTUAL
+    round count (scalar-prefetched), so replay compute matches the sum of
+    the per-round route-only passes it replaces; the table buffer's unused
+    zero rows are exact no-op steps (chosen=0 keeps every lid) and are
+    never executed.  Routing math is the shared _route_step — bit-identical
+    to the per-round passes by construction."""
+    i32, f32 = jnp.int32, jnp.float32
+    bf16 = f32 if f32_dots else jnp.bfloat16
+    l_iota = jax.lax.broadcasted_iota(i32, (L, T), 0)
+    bins32 = bins_ref[...].astype(i32) if u8_layout else None
+    n_rounds = nr_ref[0]
+
+    def step(r, lid):
+        tab = tabs_ref[pl.ds(r * NUM_TAB, NUM_TAB), :]       # (NUM_TAB, L)
+        leaf_oh = (l_iota == lid).astype(bf16)
+        vals = jax.lax.dot_general(
+            tab, leaf_oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                      # (NUM_TAB, T)
+        iv = vals.astype(i32)
+        chosen_i, newid, _, go_left_i, _, _, _ = _route_step(
+            iv, bins_ref, bins32, GW, T, u8_layout)
+        return jnp.where(chosen_i * (1 - go_left_i) > 0, newid, lid)
+
+    lid0 = jnp.zeros((1, T), i32)
+    newleaf_ref[0:1, :] = jax.lax.fori_loop(0, n_rounds, step, lid0)
+
+
+@functools.partial(watched_jit, name="route_replay", warn_after=0,
+                   static_argnames=("num_leaves", "block_rows",
+                                    "rounds_buf"))
+def route_replay(bins_T: jax.Array, tabs_buf: jax.Array, n_rounds: jax.Array,
+                 num_leaves: int, block_rows: int = 1024,
+                 rounds_buf: int = 0) -> jax.Array:
+    """Replay the stored per-round route tables over ALL rows.
+
+    bins_T: (GW_pad, N_pad) i32 / (G_pad, N_pad) i8 from pack_bins_T.
+    tabs_buf: (rounds_buf * NUM_TAB, L) f32 — round r's build_route_tables
+    block at rows [r*NUM_TAB, (r+1)*NUM_TAB); untouched rounds are zeros.
+    n_rounds: () i32 — dynamic replay trip count (the grown tree's actual
+    round count; scalar-prefetched into the kernel's fori_loop bound).
+
+    Returns the final (N_pad,) i32 leaf id of every row — bit-identical to
+    the chain of per-round route-only route_and_hist passes it fuses
+    (categorical splits are not supported; the grow layer gates fusion off
+    when the tree may contain one)."""
+    GW, n_pad = bins_T.shape
+    T = block_rows
+    NB = n_pad // T
+    L = num_leaves
+    if rounds_buf <= 0:
+        rounds_buf = tabs_buf.shape[0] // NUM_TAB
+    u8_layout = bins_T.dtype == jnp.int8
+    out = pl.pallas_call(
+        functools.partial(_route_replay_kernel, T=T, L=L, GW=GW,
+                          u8_layout=u8_layout, f32_dots=_interp()),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(NB,),
+            in_specs=[
+                pl.BlockSpec((GW, T), lambda b, nr: (0, b)),
+                pl.BlockSpec((rounds_buf * NUM_TAB, L),
+                             lambda b, nr: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, T), lambda b, nr: (0, b)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=_interp(),
+    )(jnp.asarray(n_rounds, jnp.int32).reshape(1), bins_T, tabs_buf)
+    return out.reshape(-1)
 
 
 def _leaf_gather_kernel(lid_ref, val_ref, out_ref, *, T, L):

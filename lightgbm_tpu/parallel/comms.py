@@ -199,6 +199,75 @@ def reduce_hist(h: jax.Array, axis: str, g_dim: int, plan: ShardPlan,
     return jnp.sum(contrib, axis=g_dim)
 
 
+def pack_gh_wire(h: jax.Array, axis: str, width: int, d: int):
+    """Quantize-and-pack an int32 (…, 2) grad/hess histogram block into ONE
+    integer lane per pair for the cross-device collective (hist_packed_width;
+    reference contract: gradient_discretizer.cpp keeps quality with 16-bit
+    packed accumulators on the wire).
+
+    Called INSIDE shard_map on each device's exact int32 partial sums.
+    width=16 packs the pair into one int32 lane (grad in the signed high 16
+    bits, hess in the unsigned low 16) — HALF the wire bytes of the two-lane
+    int32 block; width=8 packs into one int16 lane (8+8) — a QUARTER.
+
+    Requantization is a shared power-of-two right shift chosen from the
+    cross-device abs-max (`pmax`) so that d device partials sum without
+    overflowing their field, and the hess field's sum stays < 2**hbits —
+    carry-free into the signed grad field above it (hessian grid sums are
+    non-negative for every supported objective).  A pow2 shift of integers
+    with round-half-away is deterministic regardless of stochastic_rounding
+    upstream, and is exact (shift 0) whenever the block magnitudes fit the
+    field — the documented-ulp contract of the packed widths.
+
+    Returns (packed, scales) with scales=(s_g, s_h) f32 pow2 factors the
+    matching :func:`unpack_gh_wire` multiplies back after the collective."""
+    g = h[..., 0]
+    hh = h[..., 1]
+    gbits, hbits = (15, 16) if width == 16 else (7, 8)
+    # -8 margin: the f32 log2 bound below may round the int32 max down
+    cap_g = (2 ** gbits - 8) // d
+    cap_h = (2 ** hbits - 8) // d
+    mg = jnp.max(jnp.abs(g)).astype(jnp.float32)
+    mh = jnp.max(hh).astype(jnp.float32)
+    if axis is not None:
+        mg = jax.lax.pmax(mg, axis)
+        mh = jax.lax.pmax(mh, axis)
+
+    def _shift(m, cap):
+        sh = jnp.ceil(jnp.log2(jnp.maximum(m, 1.0) / cap))
+        return jnp.maximum(sh, 0.0).astype(jnp.int32)
+
+    def _rshift_round(v, sh):
+        half = jnp.where(sh > 0, (1 << jnp.maximum(sh - 1, 0)), 0)
+        q = (jnp.abs(v) + half) >> sh
+        return jnp.sign(v) * q
+
+    sh_g, sh_h = _shift(mg, cap_g), _shift(mh, cap_h)
+    gq = _rshift_round(g, sh_g)
+    hq = _rshift_round(hh, sh_h)
+    if width == 16:
+        packed = gq * 65536 + hq
+    else:
+        packed = (gq * 256 + hq).astype(jnp.int16)
+    scales = jnp.stack([jnp.exp2(sh_g.astype(jnp.float32)),
+                        jnp.exp2(sh_h.astype(jnp.float32))])
+    return packed, scales
+
+
+def unpack_gh_wire(packed: jax.Array, scales: jax.Array,
+                   width: int) -> jax.Array:
+    """Inverse of :func:`pack_gh_wire` AFTER the summing collective: split
+    the carry-free fields back out (floored mod keeps the low field
+    non-negative; the high field's floor division is exact) and multiply the
+    pow2 scales back, returning the usual f32 (…, 2) grid-valued block."""
+    base = 65536 if width == 16 else 256
+    p = packed.astype(jnp.int32)
+    hq = jnp.mod(p, base)
+    gq = (p - hq) // base
+    return jnp.stack([gq.astype(jnp.float32) * scales[0],
+                      hq.astype(jnp.float32) * scales[1]], axis=-1)
+
+
 def make_sharded_finder(mesh, axis: str, plan: ShardPlan, scan_kw: dict):
     """shard_map-wrapped shard-local split finder.
 
@@ -392,7 +461,8 @@ def voting_bytes_per_round(num_slots: int, num_features: int, top_k2: int,
 
 def hist_comms_bytes_per_round(num_slots: int, num_groups: int, bmax: int,
                                d: int, mode: str, dtype: str = "f32",
-                               num_class: int = 1) -> int:
+                               num_class: int = 1,
+                               packed_width: int = 32) -> int:
     """Analytic per-device histogram payload DELIVERED per growth round.
 
     Convention (docs/DISTRIBUTED.md): bytes of reduced histogram payload a
@@ -402,12 +472,21 @@ def hist_comms_bytes_per_round(num_slots: int, num_groups: int, bmax: int,
     G/D group slice (plus the all_gathered best-split records, counted
     too).  bf16_pair halves the per-element wire width of the slice.
     Distinct from link-level ring traffic, which the mode also cuts
-    (all-reduce moves ~2x a reduce-scatter)."""
+    (all-reduce moves ~2x a reduce-scatter).
+
+    ``packed_width`` (hist_packed_width under use_quantized_grad +
+    stream): 16 packs each (grad, hess) int pair into ONE int32 lane (4
+    bytes per pair instead of 8 — half), 8 packs the pair into ONE int16
+    lane (2 bytes per pair — quarter).  The two scale scalars ride the
+    best-split record exchange; their bytes are noise and not counted."""
+    per_elem = {32: 4, 16: 2, 8: 1}[packed_width]
     if mode == "psum":
-        return num_class * num_slots * num_groups * bmax * 2 * 4
+        return num_class * num_slots * num_groups * bmax * 2 * per_elem
     gs = -(-num_groups // d)
     elems_slice = num_class * num_slots * gs * bmax * 2
     width = 2 if dtype == "bf16_pair" else 4
+    if packed_width != 32:
+        width = per_elem
     # + per-shard best records: 7 fields x 4 bytes from each of d shards
     record_bytes = d * num_class * num_slots * 7 * 4
     return elems_slice * width + record_bytes

@@ -133,6 +133,19 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     entries["grow_tree_feature"] = _measure_feature_grow(w)
     if entries["grow_tree_feature"].get("available") is False:
         unavailable.append("grow_tree_feature")
+    # histogram-floor backends (PR "break the histogram floor"): the
+    # scatter-add grow program (single device) and the packed-int16-wire
+    # quantized grow program (4-device CPU mesh) — each in a subprocess
+    # for the same jax-init reasons as the feature entry
+    entries["grow_tree_scatter"] = _measure_backend_grow(
+        w, {"hist_backend": "scatter", "hist_precision": "single"}, 0)
+    if entries["grow_tree_scatter"].get("available") is False:
+        unavailable.append("grow_tree_scatter")
+    entries["grow_tree_packed16"] = _measure_backend_grow(
+        w, {"hist_backend": "stream", "tree_learner": "data",
+            "use_quantized_grad": True, "hist_packed_width": 16}, 4)
+    if entries["grow_tree_packed16"].get("available") is False:
+        unavailable.append("grow_tree_packed16")
     import jax
     return {
         "workload": w,
@@ -178,6 +191,74 @@ rec = costmodel.cost_records().get("grow_tree",
                                     "error": "no grow_tree cost record"})
 print("FEATURE_COST " + json.dumps(rec))
 """
+
+
+_BACKEND_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+n_dev = int(sys.argv[3])
+if n_dev > 0:
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % n_dev)
+os.environ["LGBTPU_FUSE_ITER"] = "0"
+os.environ.pop("LGBTPU_COST", None)
+for k in ("LGBTPU_HIST_BACKEND", "LGBTPU_HIST_PACKED_WIDTH",
+          "LGBTPU_ROUTE_FUSION", "LGBTPU_HIST_COMMS"):
+    os.environ.pop(k, None)
+sys.path.insert(0, sys.argv[1])
+w = json.loads(sys.argv[2])
+extra = json.loads(sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+    clear_backends()
+except Exception:
+    pass
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import costmodel
+from lightgbm_tpu.telemetry.profile import _synthetic_data
+X, y = _synthetic_data(int(w["rows"]), int(w["features"]), int(w["seed"]))
+params = {"objective": "binary", "num_leaves": int(w["num_leaves"]),
+          "max_bin": int(w["max_bin"]), "learning_rate": 0.1,
+          "verbosity": -1, "telemetry": True, "telemetry_cost": "full"}
+params.update(extra)
+bst = lgb.train(params, lgb.Dataset(X, label=y),
+                num_boost_round=int(w["iters"]))
+assert bst.engine._grow_params.hist_backend == extra["hist_backend"]
+rec = costmodel.cost_records().get("grow_tree",
+                                   {"available": False,
+                                    "error": "no grow_tree cost record"})
+print("BACKEND_COST " + json.dumps(rec))
+"""
+
+
+def _measure_backend_grow(w, extra, n_dev):
+    """Cost record of a hist-backend grow program variant on the fixed
+    workload (subprocess; n_dev > 0 forces a CPU virtual mesh).  Failure
+    -> unavailable, never zero."""
+    import subprocess
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LGBTPU_FUSE_ITER")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _BACKEND_CHILD, ROOT, json.dumps(w),
+             str(n_dev), json.dumps(extra)],
+            capture_output=True, text=True, timeout=600, env=env)
+    except subprocess.TimeoutExpired:
+        return {"available": False, "error": "backend-grow child timed out"}
+    for line in r.stdout.splitlines():
+        if line.startswith("BACKEND_COST "):
+            rec = json.loads(line[len("BACKEND_COST "):])
+            if rec.get("available"):
+                return {k: rec[k] for k in
+                        ("flops", "bytes_accessed", "peak_hbm_bytes",
+                         "intensity", "verdict") if k in rec}
+            return {"available": False, "error": rec.get("error", "?")}
+    tail = (r.stdout + r.stderr)[-500:].replace("\n", " | ")
+    return {"available": False,
+            "error": f"backend-grow child failed (rc={r.returncode}): "
+                     f"{tail}"}
 
 
 def _measure_feature_grow(w):

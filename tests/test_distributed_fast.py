@@ -62,6 +62,7 @@ def test_reduce_scatter_bit_identical_binary():
 
 
 @needs_mesh
+@pytest.mark.slow
 def test_reduce_scatter_bit_identical_multiclass_batched():
     """Lockstep K-class growth (grow_tree_k) on the mesh: the widened
     (K, S, G, B, 2) block reduce-scatters over its group axis and the
@@ -84,6 +85,7 @@ def test_reduce_scatter_bit_identical_bagging():
 
 
 @needs_mesh
+@pytest.mark.slow
 def test_reduce_scatter_pipeline_chunks_bit_identical():
     """Double-buffered scatter (hist_comms_pipeline, default 2 under
     reduce_scatter): chunking the psum_scatter along the slot axis rides

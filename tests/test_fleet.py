@@ -362,6 +362,7 @@ def test_promote_pointer_generations(served, tmp_path):
 # the real fleet: restart-with-backoff + fleet-wide reload (subprocesses)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_fleet_restart_reload_and_poisoned_candidate(served, tmp_path):
     pa, pb, X, ref_a, ref_b = served
     oracle = {}

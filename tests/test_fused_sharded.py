@@ -111,6 +111,7 @@ def test_fused_identity_bagging(mesh_dev):
 
 @needs_mesh
 @pytest.mark.parametrize("mesh_dev", MESHES)
+@pytest.mark.slow
 def test_fused_identity_goss_compacted(mesh_dev):
     """GOSS draws its mask IN-TRACE from the iteration's gradients (same
     key as the eager path) and compacts rows at the analytic capacity —
@@ -192,6 +193,7 @@ def test_fused_single_launch_per_iteration():
 
 @needs_mesh
 @pytest.mark.parametrize("sampling", ["plain", "goss"])
+@pytest.mark.slow
 def test_checkpoint_resume_from_sharded_state(tmp_path, sampling):
     """A snapshot taken mid-run from the device-sharded state must resume
     BIT-IDENTICALLY — same discipline as the single-chip resume suite,

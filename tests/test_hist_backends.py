@@ -1,0 +1,585 @@
+"""Histogram-formulation floor A/B: backend identity matrix + fusion/packing.
+
+Three candidate formulations ride behind ``hist_backend`` / env
+``LGBTPU_HIST_BACKEND`` (docs/PERF.md "histogram-formulation floor"):
+
+  * ``scatter`` — Pallas scatter-add into a VMEM tile (no one-hot operand).
+    Bitwise-identical to ``segsum`` at the op level AND as trained models
+    once ``hist_precision=single`` is pinned (segsum/onehot auto-resolve
+    double on CPU; scatter is single-only).  VMEM-gated with an automatic
+    one-hot fallback.
+  * ``hist_packed_width`` 16/8 — the quantized grad/hess pair rides one
+    int32/int16 wire lane through the mesh collective, halving/quartering
+    psum_scatter bytes.  Kernel arithmetic stays exact int32; only the
+    collective seam packs.  w16 is drift-free at test scale; w8 is the
+    documented-ulp opt-in.
+  * ``route_fusion`` — GOSS+stream fusion: per-round full-data route-only
+    passes are replaced by ONE post-growth replay launch
+    (pallas/stream_kernel.route_replay), bit-identical by construction
+    (the replay kernel shares _route_step with the fused route+hist
+    kernel).  hist/route_only_passes telemetry is the A/B signal.
+
+GOSS warmup gotcha baked into every sampled test here: sampling starts
+after ceil(1/learning_rate) iterations (sample_strategy._is_warmup), so
+fusion/compaction only engages with learning_rate=0.5 and >=4 rounds.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.telemetry as tel
+from lightgbm_tpu.ops.histogram import build_histograms
+from lightgbm_tpu.pallas.scatter_hist_kernel import scatter_hist_fits
+from lightgbm_tpu.utils.log import LightGBMError
+
+from conftest import (make_synthetic_binary, make_synthetic_multiclass,
+                      make_synthetic_regression)
+
+N_DEV = len(jax.devices())
+needs_mesh = pytest.mark.skipif(N_DEV < 4, reason="needs a >=4-device mesh")
+
+
+def _strip_params(model_str: str) -> str:
+    """Model text minus the parameters block (backend knobs differ by
+    design; every tree byte must still match)."""
+    return model_str.split("\nparameters:")[0]
+
+
+def _datasets():
+    """Identity-matrix layouts: numeric+NaN, categorical, EFB-bundled."""
+    rs = np.random.RandomState(7)
+    out = []
+
+    X, y = make_synthetic_binary(n=1500, f=8)
+    X = X.copy()
+    X[::13, 2] = np.nan                       # MissingType::NaN routing
+    out.append(("binary_nan", {"objective": "binary"},
+                dict(data=X, label=y), {}))
+
+    Xr, yr = make_synthetic_regression(n=1200, f=8, seed=7)
+    Xr = Xr.copy()
+    Xr[:, 3] = rs.randint(0, 6, len(Xr))      # categorical column
+    out.append(("reg_cat", {"objective": "regression"},
+                dict(data=Xr, label=yr), {"categorical_feature": [3]}))
+
+    # sparse one-hot-ish block -> EFB bundles several features per group
+    Xs = np.zeros((1000, 12))
+    Xs[:, :4] = rs.randn(1000, 4)
+    hot = rs.randint(4, 12, 1000)
+    Xs[np.arange(1000), hot] = 1.0
+    ys = Xs[:, 0] + 2.0 * (hot == 5) - (hot == 9) + 0.05 * rs.randn(1000)
+    out.append(("reg_efb", {"objective": "regression"},
+                dict(data=Xs, label=ys), {}))
+    return out
+
+
+def _train(params, data_kw, ds_kw, backend, rounds=6, **extra):
+    # max_bin=63 keeps Bmax under the scatter VMEM gate (128) so the
+    # scatter kernel actually runs instead of its one-hot fallback
+    p = dict(params, num_leaves=15, verbosity=-1, min_data_in_leaf=5,
+             max_bin=63, hist_backend=backend, hist_precision="single",
+             **extra)
+    ds = lgb.Dataset(data_kw["data"], label=data_kw["label"],
+                     weight=data_kw.get("weight"), **ds_kw)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+# ---------------------------------------------------------------------------
+# op-level identity + VMEM gate
+# ---------------------------------------------------------------------------
+
+def _op_inputs(n=4096, g=4, bmax=32, s=8, seed=0):
+    rs = np.random.RandomState(seed)
+    bins = jnp.asarray(rs.randint(0, bmax, size=(n, g)), jnp.uint8)
+    slot = jnp.asarray(rs.randint(-1, s, size=(n,)), jnp.int32)
+    grad = jnp.asarray(rs.randn(n), jnp.float32)
+    hess = jnp.asarray(rs.rand(n) + 0.1, jnp.float32)
+    cnt = jnp.asarray((rs.rand(n) > 0.1), jnp.float32)
+    return bins, slot, grad, hess, cnt, s, bmax
+
+
+def test_scatter_op_bitwise_vs_segsum():
+    bins, slot, grad, hess, cnt, s, bmax = _op_inputs()
+    assert scatter_hist_fits(s, bins.shape[1], bmax)
+    h_sc = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="scatter")
+    h_ss = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="segsum")
+    # same row-major accumulation order as segment_sum -> byte equality
+    assert np.array_equal(np.asarray(h_sc), np.asarray(h_ss))
+    # one-hot reassociates the sum: allclose, not byte-equal
+    h_oh = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="onehot")
+    np.testing.assert_allclose(np.asarray(h_sc), np.asarray(h_oh),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_scatter_vmem_gate_falls_back_to_onehot():
+    # bmax > 128 refuses the scatter tile -> automatic one-hot fallback
+    bins, slot, grad, hess, cnt, s, _ = _op_inputs(bmax=32)
+    bmax = 200
+    assert not scatter_hist_fits(s, bins.shape[1], bmax)
+    h_sc = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="scatter")
+    h_oh = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="onehot")
+    assert np.array_equal(np.asarray(h_sc), np.asarray(h_oh))
+    # and the fallback is still a correct histogram
+    h_ss = build_histograms(bins, slot, grad, hess, cnt, s, bmax,
+                            backend="segsum")
+    np.testing.assert_allclose(np.asarray(h_sc), np.asarray(h_ss),
+                               rtol=1e-5, atol=1e-5)
+    # group-count gate (G > 64) closes too
+    assert not scatter_hist_fits(s, 65, 32)
+
+
+# ---------------------------------------------------------------------------
+# trained-model identity matrix (CPU fast tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,params,data_kw,ds_kw", _datasets())
+def test_scatter_model_bitwise_vs_segsum(name, params, data_kw, ds_kw):
+    """scatter grows the SAME trees as segsum byte-for-byte once
+    hist_precision=single is pinned (the default auto resolves double for
+    segsum on CPU but scatter is single-only — that A/B would compare
+    precisions, not formulations)."""
+    a = _train(params, data_kw, ds_kw, "segsum")
+    b = _train(params, data_kw, ds_kw, "scatter")
+    # the scatter tile must actually fit (else this compares the one-hot
+    # fallback, not the formulation under test)
+    dd = b.engine.dd
+    assert scatter_hist_fits(14, dd.num_groups, dd.max_bins)
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+@pytest.mark.slow
+def test_scatter_multiclass_and_bagging_identity():
+    X, y = make_synthetic_multiclass(n=1200, f=8, k=3)
+    mc = {"objective": "multiclass", "num_class": 3}
+    a = _train(mc, dict(data=X, label=y), {}, "segsum")
+    b = _train(mc, dict(data=X, label=y), {}, "scatter")
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+    Xb, yb = make_synthetic_binary(n=1500, f=8)
+    bag = {"objective": "binary", "bagging_fraction": 0.6,
+           "bagging_freq": 1, "bagging_seed": 3}
+    a = _train(bag, dict(data=Xb, label=yb), {}, "segsum")
+    b = _train(bag, dict(data=Xb, label=yb), {}, "scatter")
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+def test_scatter_goss_identity():
+    X, y = make_synthetic_binary(n=2000, f=8)
+    goss = {"objective": "binary", "data_sample_strategy": "goss",
+            "top_rate": 0.2, "other_rate": 0.2, "learning_rate": 0.5}
+    a = _train(goss, dict(data=X, label=y), {}, "segsum", rounds=6)
+    b = _train(goss, dict(data=X, label=y), {}, "scatter", rounds=6)
+    assert b.engine._last_compact_rows > 0   # sampling actually engaged
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_identity_per_backend(tmp_path):
+    """Straight-through vs save_model+init_model continuation must agree
+    under every CPU backend (text round-trip requantizes leaf values, so
+    allclose rather than byte equality — test_continued.py's contract)."""
+    X, y = make_synthetic_binary(n=1200, f=8)
+    Xv = X[:200]
+    for backend in ("segsum", "onehot", "scatter", "stream"):
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 5, "max_bin": 63,
+                  "hist_backend": backend, "hist_precision": "single"}
+        ds = lgb.Dataset(X, label=y)
+        full = lgb.train(params, ds, num_boost_round=8)
+        half = lgb.train(params, ds, num_boost_round=4)
+        path = str(tmp_path / f"ckpt_{backend}.txt")
+        half.save_model(path)
+        resumed = lgb.train(params, lgb.Dataset(X, label=y),
+                            num_boost_round=4, init_model=path)
+        np.testing.assert_allclose(resumed.predict(Xv), full.predict(Xv),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"backend={backend}")
+
+
+# ---------------------------------------------------------------------------
+# engine-first validation + env overrides
+# ---------------------------------------------------------------------------
+
+def _tiny():
+    X, y = make_synthetic_binary(n=400, f=4)
+    return lgb.Dataset(X, label=y)
+
+
+def _expect_error(params, match):
+    with pytest.raises(LightGBMError, match=match):
+        lgb.train(dict(params, verbosity=-1, num_leaves=7), _tiny(),
+                  num_boost_round=1)
+
+
+def test_invalid_backend_rejected_before_training():
+    _expect_error({"objective": "binary", "hist_backend": "vector"},
+                  "hist_backend")
+
+
+def test_scatter_rejects_feature_parallel():
+    _expect_error({"objective": "binary", "hist_backend": "scatter",
+                   "tree_learner": "feature"}, "single-device")
+
+
+def test_scatter_rejects_double_precision():
+    _expect_error({"objective": "binary", "hist_backend": "scatter",
+                   "hist_precision": "double"}, "double")
+
+
+def test_packed_width_validation():
+    _expect_error({"objective": "binary", "hist_packed_width": 12},
+                  "hist_packed_width")
+    _expect_error({"objective": "binary", "hist_packed_width": 16},
+                  "use_quantized_grad")
+    _expect_error({"objective": "regression", "hist_packed_width": 16,
+                   "use_quantized_grad": True, "linear_tree": True},
+                  "linear")
+
+
+def test_route_fusion_validation():
+    _expect_error({"objective": "binary", "route_fusion": "maybe"},
+                  "route_fusion")
+
+
+def test_env_override_hist_backend(monkeypatch):
+    monkeypatch.setenv("LGBTPU_HIST_BACKEND", "scatter")
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, _tiny(), num_boost_round=1)
+    assert bst.engine._grow_params.hist_backend == "scatter"
+    monkeypatch.setenv("LGBTPU_HIST_BACKEND", "vector")
+    with pytest.raises(LightGBMError, match="hist_backend"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "num_leaves": 7}, _tiny(), num_boost_round=1)
+
+
+def test_env_override_packed_width(monkeypatch):
+    monkeypatch.setenv("LGBTPU_HIST_PACKED_WIDTH", "16")
+    X, y = make_synthetic_binary(n=400, f=4)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                     "use_quantized_grad": True},
+                    lgb.Dataset(X, label=y), num_boost_round=1)
+    assert bst.engine._grow_params.hist_packed_width == 16
+
+
+# ---------------------------------------------------------------------------
+# GOSS+stream fusion (single device)
+# ---------------------------------------------------------------------------
+
+_FUSION_PARAMS = {
+    "objective": "binary", "num_leaves": 127, "verbosity": -1,
+    "min_data_in_leaf": 5, "hist_backend": "stream",
+    "data_sample_strategy": "goss", "top_rate": 0.1, "other_rate": 0.1,
+    "learning_rate": 0.5, "max_splits_per_round": 64,
+}
+
+
+def _train_fusion(X, y, fusion, rounds=6, **extra):
+    p = dict(_FUSION_PARAMS, route_fusion=fusion, **extra)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def test_route_fusion_bitwise_identity():
+    """Fusion on vs off grows byte-identical models: the replay kernel
+    shares _route_step with the fused route+hist kernel, and unused
+    zero-table buffer rows are exact no-op steps."""
+    X, y = make_synthetic_binary(n=4096, f=10)
+    a = _train_fusion(X, y, "off")
+    b = _train_fusion(X, y, "on")
+    assert a.engine._last_compact_rows > 0   # GOSS past warmup
+    assert b.engine._route_only_passes_per_tree() == 1       # fused
+    assert a.engine._route_only_passes_per_tree() > 1        # per-round
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+@pytest.mark.slow
+def test_route_fusion_gate_respects_categoricals():
+    # categorical trees carry bitset overlays the round tables don't
+    # encode -> the fusion gate must fall back to per-round routing
+    rs = np.random.RandomState(3)
+    X, y = make_synthetic_binary(n=4096, f=10)
+    X = X.copy()
+    X[:, 1] = rs.randint(0, 12, len(X))
+    p = dict(_FUSION_PARAMS, route_fusion="on")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, categorical_feature=[1]),
+                    num_boost_round=6)
+    assert bst.engine._grow_params.has_categorical
+    assert bst.engine._route_only_passes_per_tree() > 1
+
+
+def test_route_only_passes_telemetry():
+    tel.reset()
+    tel.configure(enabled=True)
+    try:
+        X, y = make_synthetic_binary(n=4096, f=10)
+        bst = _train_fusion(X, y, "off", telemetry=True)
+        snap = tel.global_registry.snapshot()
+        assert snap["counters"]["hist/route_only_passes"] > 0
+        iters = [r for r in tel.global_registry.records
+                 if r.get("event") == "iteration"]
+        assert iters and all(r["hist_backend"] == "stream" for r in iters)
+        # post-warmup iterations route per round; fused run drops to 1/tree
+        per_tree = bst.engine._route_only_passes_per_tree()
+        assert per_tree > 1
+        assert any(r["route_only_passes"] == per_tree for r in iters)
+    finally:
+        tel.disable()
+        tel.reset()
+        tel.configure(enabled=False, metrics_out="", trace_out="")
+
+
+# ---------------------------------------------------------------------------
+# mesh tier: packed wire widths + fused replay under shard_map
+# ---------------------------------------------------------------------------
+
+def _train_mesh(params, X, y, rounds=6):
+    p = dict(params, verbosity=-1, min_data_in_leaf=5,
+             tree_learner="data", hist_backend="stream")
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+_PACK_BASE = {"objective": "binary", "num_leaves": 31,
+              "use_quantized_grad": True, "num_grad_quant_bins": 16}
+
+
+@needs_mesh
+@pytest.mark.slow
+@pytest.mark.parametrize("comms", ["psum", "reduce_scatter"])
+def test_packed16_mesh_identity_and_bytes(comms):
+    """int16 packed wire halves the per-round collective payload and (at
+    this scale/quant config) stays byte-identical to the exact int32 wire
+    under BOTH hist_comms modes; int8 quarters the bytes (documented-ulp
+    — structural sanity only)."""
+    X, y = make_synthetic_binary(n=4096, f=10)
+    models, bytes_ = {}, {}
+    for w in (32, 16, 8):
+        p = dict(_PACK_BASE, hist_comms=comms, hist_packed_width=w)
+        bst = _train_mesh(p, X, y)
+        cm = bst.engine._comms_model()
+        assert cm["packed_width"] == w
+        models[w], bytes_[w] = bst, cm["per_round_bytes"]
+    # only the histogram payload packs; reduce_scatter also all_gathers
+    # fixed-size best-split records (d * S * 7 fields * 4 bytes) that
+    # ride outside the packed wire
+    gp = models[32].engine._grow_params
+    S = min(gp.max_splits_per_round, gp.num_leaves - 1)
+    cm32 = models[32].engine._comms_model()
+    rec = 0 if comms == "psum" else cm32["devices"] * S * 7 * 4
+    assert (bytes_[16] - rec) * 2 == bytes_[32] - rec
+    assert (bytes_[8] - rec) * 4 == bytes_[32] - rec
+    assert _strip_params(models[16].model_to_string()) == \
+        _strip_params(models[32].model_to_string())
+    # w8 saturates the 8-bit lane at this quant config: different trees by
+    # design, but still a usable model
+    pred8 = models[8].predict(X[:256])
+    assert np.all(np.isfinite(pred8))
+
+
+def test_packed_width_single_device_noop():
+    # no mesh -> no collective seam: packed widths must be a strict no-op
+    X, y = make_synthetic_binary(n=1500, f=8)
+    p = dict(_PACK_BASE, verbosity=-1, min_data_in_leaf=5)
+    a = lgb.train(dict(p, hist_packed_width=32), lgb.Dataset(X, label=y),
+                  num_boost_round=5)
+    b = lgb.train(dict(p, hist_packed_width=16), lgb.Dataset(X, label=y),
+                  num_boost_round=5)
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_route_fusion_mesh_identity():
+    # per-shard compaction needs enough local rows to beat the block
+    # quantum: 32768 rows -> 4096/shard on the 8-device CPU mesh
+    X, y = make_synthetic_binary(n=32768, f=10)
+    p_off = dict(_FUSION_PARAMS, route_fusion="off", tree_learner="data")
+    p_on = dict(_FUSION_PARAMS, route_fusion="on", tree_learner="data")
+    a = lgb.train(p_off, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train(p_on, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert b.engine._last_compact_rows > 0
+    assert _strip_params(a.model_to_string()) == \
+        _strip_params(b.model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# unit tier: wire-packing algebra, the comms byte model, and the scatter
+# VMEM gate — pure math, no training, so they stay in the fast tier even
+# on a throttled box
+# ---------------------------------------------------------------------------
+
+from lightgbm_tpu.parallel.comms import (hist_comms_bytes_per_round,
+                                         pack_gh_wire, unpack_gh_wire)
+
+
+def _gh_block(rng, g_lo, g_hi, h_hi, shape=(4, 6, 8)):
+    g = rng.integers(g_lo, g_hi, size=shape).astype(np.int32)
+    h = rng.integers(0, h_hi, size=shape).astype(np.int32)
+    return jnp.stack([jnp.asarray(g), jnp.asarray(h)], axis=-1)
+
+
+def test_pack_roundtrip_exact_w16():
+    # magnitudes under cap -> shift 0 -> bit-exact roundtrip
+    h = _gh_block(np.random.default_rng(0), -2000, 2000, 1000)
+    packed, scales = pack_gh_wire(h, None, 16, d=4)
+    out = unpack_gh_wire(packed, scales, 16)
+    assert np.array_equal(np.asarray(scales), [1.0, 1.0])
+    assert np.array_equal(np.asarray(out), np.asarray(h, dtype=np.float32))
+
+
+def test_pack_roundtrip_exact_w8():
+    h = _gh_block(np.random.default_rng(1), -20, 20, 25)
+    packed, scales = pack_gh_wire(h, None, 8, d=4)
+    out = unpack_gh_wire(packed, scales, 8)
+    assert np.array_equal(np.asarray(scales), [1.0, 1.0])
+    assert np.array_equal(np.asarray(out), np.asarray(h, dtype=np.float32))
+
+
+def test_pack_wire_dtypes():
+    h = _gh_block(np.random.default_rng(2), -5, 5, 5)
+    assert pack_gh_wire(h, None, 16, d=4)[0].dtype == jnp.int32
+    assert pack_gh_wire(h, None, 8, d=4)[0].dtype == jnp.int16
+
+
+@pytest.mark.parametrize("width", [16, 8])
+def test_pack_requantized_error_bounded_by_half_scale(width):
+    # magnitudes over cap -> pow2 shift with round-half-away: each field's
+    # error is at most scale/2 (the documented-ulp contract)
+    rng = np.random.default_rng(3)
+    h = _gh_block(rng, -10 ** 6, 10 ** 6, 10 ** 6)
+    packed, scales = pack_gh_wire(h, None, width, d=4)
+    s = np.asarray(scales)
+    assert s[0] > 1.0 and s[1] > 1.0  # really requantized
+    assert float(np.log2(s[0])) % 1 == 0.0  # pow2 shift
+    out = np.asarray(unpack_gh_wire(packed, scales, width))
+    ref = np.asarray(h, dtype=np.float32)
+    assert np.max(np.abs(out[..., 0] - ref[..., 0])) <= s[0] / 2
+    assert np.max(np.abs(out[..., 1] - ref[..., 1])) <= s[1] / 2
+
+
+@pytest.mark.parametrize("width", [16, 8])
+def test_pack_sum_linearity_carry_free(width):
+    # the collective sums PACKED lanes: with shift 0 on every shard the
+    # unpacked sum must equal the sum of the unpacked shards exactly —
+    # the hess field never carries into the grad field above it
+    rng = np.random.default_rng(4)
+    d = 4
+    lim = (2000, 1000) if width == 16 else (20, 25)
+    blocks = [_gh_block(rng, -lim[0], lim[0], lim[1]) for _ in range(d)]
+    packed = []
+    for b in blocks:
+        p, scales = pack_gh_wire(b, None, width, d=d)
+        assert np.array_equal(np.asarray(scales), [1.0, 1.0])
+        packed.append(np.asarray(p, dtype=np.int32))
+    summed = jnp.asarray(sum(packed))
+    out = np.asarray(unpack_gh_wire(summed, scales, width))
+    ref = np.asarray(sum(np.asarray(b, dtype=np.int64) for b in blocks),
+                     dtype=np.float32)
+    assert np.array_equal(out, ref)
+
+
+def test_bytes_model_psum_halves_and_quarters():
+    kw = dict(num_slots=64, num_groups=28, bmax=63, d=4, mode="psum")
+    b32 = hist_comms_bytes_per_round(**kw, packed_width=32)
+    assert b32 == 64 * 28 * 63 * 2 * 4
+    assert hist_comms_bytes_per_round(**kw, packed_width=16) * 2 == b32
+    assert hist_comms_bytes_per_round(**kw, packed_width=8) * 4 == b32
+
+
+def test_bytes_model_psum_d_invariant_and_class_scaling():
+    kw = dict(num_slots=32, num_groups=8, bmax=32, mode="psum")
+    assert hist_comms_bytes_per_round(**kw, d=2) == \
+        hist_comms_bytes_per_round(**kw, d=8)
+    assert hist_comms_bytes_per_round(**kw, d=4, num_class=3) == \
+        3 * hist_comms_bytes_per_round(**kw, d=4)
+
+
+def test_bytes_model_reduce_scatter_packs_block_not_records():
+    kw = dict(num_slots=64, num_groups=32, bmax=63, d=4,
+              mode="reduce_scatter")
+    rec = 4 * 64 * 7 * 4  # d shards x 7-field f32 best records
+    b32 = hist_comms_bytes_per_round(**kw, packed_width=32)
+    b16 = hist_comms_bytes_per_round(**kw, packed_width=16)
+    b8 = hist_comms_bytes_per_round(**kw, packed_width=8)
+    assert (b16 - rec) * 2 == b32 - rec
+    assert (b8 - rec) * 4 == b32 - rec
+    # bf16_pair also halves the slice, and only the slice
+    bf = hist_comms_bytes_per_round(**kw, dtype="bf16_pair")
+    assert (bf - rec) * 2 == b32 - rec
+
+
+def test_scatter_fits_bin_and_group_caps():
+    assert scatter_hist_fits(14, 4, 128)
+    assert not scatter_hist_fits(14, 4, 129)   # > one 128-lane tile
+    assert scatter_hist_fits(14, 64, 32)
+    assert not scatter_hist_fits(14, 65, 32)   # static unroll cap
+
+
+def test_scatter_fits_vmem_budget_boundary():
+    # tile = S * G * B * cp * 4 with cp=4 (binary): S*64*128*16 bytes
+    # crosses the 12 MB budget exactly between S=96 and S=97
+    assert scatter_hist_fits(96, 64, 128)
+    assert not scatter_hist_fits(97, 64, 128)
+
+
+def test_scatter_fits_multiclass_widens_channels():
+    # num_class=3 -> 9 channels pad to 12: budget shrinks 3x vs binary
+    # (S=32 x 3 classes lands EXACTLY on the 12 MB budget and still fits)
+    assert scatter_hist_fits(32, 64, 128, num_class=3)
+    assert scatter_hist_fits(33, 64, 128)
+    assert not scatter_hist_fits(33, 64, 128, num_class=3)
+
+
+def test_unpack_floored_mod_keeps_low_field():
+    # the low (hess) field is non-negative by construction; floored
+    # mod/div must recover it even under a negative packed lane
+    packed = jnp.asarray([[-3 * 65536 + 7, 5 * 65536 + 9]], dtype=jnp.int32)
+    out = np.asarray(unpack_gh_wire(packed, jnp.asarray([1.0, 1.0]), 16))
+    assert np.array_equal(out[..., 0], [[-3.0, 5.0]])
+    assert np.array_equal(out[..., 1], [[7.0, 9.0]])
+
+
+def test_pack_shift_is_exact_pow2_of_overflow():
+    # one element at 4x the field cap -> shift exactly 2 -> scale 4.0
+    d = 1
+    cap = (2 ** 15 - 8) // d
+    h = jnp.asarray([[4 * cap, 0]], dtype=jnp.int32)[None]
+    _, scales = pack_gh_wire(h, None, 16, d=d)
+    assert float(scales[0]) == 4.0
+
+
+def test_bytes_model_rs_pads_groups_to_d():
+    # G=30 over d=4 -> 8-group slices, same as G=32
+    kw = dict(num_slots=16, bmax=32, d=4, mode="reduce_scatter")
+    assert hist_comms_bytes_per_round(num_groups=30, **kw) == \
+        hist_comms_bytes_per_round(num_groups=32, **kw)
+
+
+def test_bytes_model_packed_width_overrides_bf16_pair():
+    # a packed wire IS the narrow dtype: bf16_pair cannot narrow it again
+    kw = dict(num_slots=16, num_groups=8, bmax=32, d=4,
+              mode="reduce_scatter", packed_width=16)
+    assert hist_comms_bytes_per_round(dtype="bf16_pair", **kw) == \
+        hist_comms_bytes_per_round(dtype="f32", **kw)
+
+
+def test_scatter_block_rows_shrinks_with_classes():
+    from lightgbm_tpu.pallas.scatter_hist_kernel import scatter_block_rows
+    assert scatter_block_rows(28) == 8192
+    assert scatter_block_rows(28, num_class=4) == 2048
+    # floor: never below one 1024-row grid step
+    assert scatter_block_rows(28, num_class=64) == 1024

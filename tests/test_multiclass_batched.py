@@ -60,6 +60,7 @@ def test_batched_bit_identical_to_scan(objective, monkeypatch):
 
 
 @pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.slow
 def test_batched_matches_scan_stream_backend(quantized, monkeypatch):
     """Stream backend A/B (pallas kernel in interpret mode on CPU): the
     widened kernel contracts (m_rows, 2*S*K) columns where the scan path
@@ -81,6 +82,7 @@ def test_batched_matches_scan_stream_backend(quantized, monkeypatch):
         np.testing.assert_allclose(va, vb, rtol=1e-4, atol=5e-6)
 
 
+@pytest.mark.slow
 def test_batched_matches_scan_stream_bucketed(monkeypatch):
     """Bucketed one-hot M-axis + K channels: low-cardinality features give
     the stream kernel a bucketed layout, whose per-run unflatten gains a

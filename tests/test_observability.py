@@ -575,6 +575,7 @@ def test_server_unsampled_requests_emit_no_spans(tmp_path, telemetry):
 # the real fleet: one trace across two processes + /metrics everywhere
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_fleet_trace_propagation_metrics_and_merge(tmp_path, telemetry):
     """End to end: a request that fails over from a killed replica onto
     its sibling carries ONE trace id through the front's retry; the

@@ -54,3 +54,34 @@ def test_quantized_bins_and_rounding_params():
         b = lgb.train({**BASE, "use_quantized_grad": True, **extra},
                       lgb.Dataset(X, label=y), num_boost_round=10)
         assert _auc(y, b.predict(X)) > 0.75
+
+
+# ---------------------------------------------------------------------------
+# packed histogram wire widths (hist_packed_width; PR "histogram floor")
+# ---------------------------------------------------------------------------
+
+def test_packed_width_requires_quantized():
+    import pytest as _pytest
+    from lightgbm_tpu.utils.log import LightGBMError
+    X, y = _data(seed=11)
+    with _pytest.raises(LightGBMError, match="use_quantized_grad"):
+        lgb.train({**BASE, "hist_packed_width": 16},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+def test_packed_widths_train_and_stay_accurate():
+    """hist_packed_width only changes the MESH collective wire; on a single
+    device it must be a byte-level no-op, and every width must keep the
+    quantized model usable (mesh wire identity: test_hist_backends.py)."""
+    X, y = _data(seed=12)
+    ref = None
+    for w in (32, 16, 8):
+        b = lgb.train({**BASE, "use_quantized_grad": True,
+                       "num_grad_quant_bins": 16, "hist_packed_width": w},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+        assert _auc(y, b.predict(X)) > 0.8
+        s = b.model_to_string().split("\nparameters:")[0]
+        if ref is None:
+            ref = s
+        else:
+            assert s == ref, f"width {w} changed a single-device model"

@@ -52,6 +52,7 @@ def test_resume_bit_identity_binary(tmp_path):
     assert resumed.model_to_string() == full.model_to_string()
 
 
+@pytest.mark.slow
 def test_resume_bit_identity_multiclass_batched(tmp_path):
     X, y = make_synthetic_multiclass(n=1500, k=4)
     M = tmp_path / "mc.txt"

@@ -68,12 +68,14 @@ def _assert_compacted_equal(params, X, y, rounds=8, **ds_kw):
 # compacted == dense-mask bit-identity (the tentpole A/B)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_binary_stream():
     X, y = make_synthetic_binary(n=4000)
     _assert_compacted_equal(dict(GOSS, objective="binary",
                                  hist_backend="stream"), X, y)
 
 
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_nan_bins():
     X, y = make_synthetic_binary(n=4000)
     X = X.copy()
@@ -82,6 +84,7 @@ def test_goss_compacted_bit_identical_nan_bins():
                                  hist_backend="stream"), X, y)
 
 
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_categorical():
     rs = np.random.RandomState(3)
     X, y = make_synthetic_binary(n=4000)
@@ -92,6 +95,7 @@ def test_goss_compacted_bit_identical_categorical():
                             categorical_feature=[4])
 
 
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_multiclass_batched():
     """The widened K-class lockstep program compacts once per iteration
     (the mask row is shared across classes) and must stay byte-equal."""
@@ -108,6 +112,7 @@ def test_bagging_compacted_bit_identical_stream():
                                  hist_backend="stream"), X, y)
 
 
+@pytest.mark.slow
 def test_pad_mode_unaligned_row_count():
     """n=4500 Dataset-pads to 4608 — NOT a multiple of the stream kernel
     block (1024): pad mode must round its full-row capacity up to the
@@ -118,6 +123,7 @@ def test_pad_mode_unaligned_row_count():
                                  hist_backend="stream"), X, y)
 
 
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_segsum():
     """Contraction/segsum backend (the CPU default): per-tree partition
     plan + O(sampled) histogram builds, same byte-equality contract."""
@@ -128,6 +134,7 @@ def test_goss_compacted_bit_identical_segsum():
 
 @needs_mesh
 @pytest.mark.parametrize("comms", ["psum", "reduce_scatter"])
+@pytest.mark.slow
 def test_goss_compacted_bit_identical_mesh_4dev(comms, monkeypatch):
     """4-way data-parallel mesh: every device stable-partitions its OWN
     row shard to the same static capacity (the capacity covers the
@@ -146,6 +153,7 @@ def test_goss_compacted_bit_identical_mesh_4dev(comms, monkeypatch):
 
 
 @needs_mesh
+@pytest.mark.slow
 def test_bagging_compacted_bit_identical_mesh_4dev(monkeypatch):
     monkeypatch.setenv("LGBTPU_BLOCK_ROWS", "256")
     X, y = make_synthetic_binary(n=4000)
@@ -204,6 +212,7 @@ def test_env_override_forces_mode():
 # checkpoint/resume + rollback: sampling RNG position is the iteration
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_resume_bit_identity_goss_compacted(tmp_path):
     """Resume mid-run with GOSS sampling + compaction active: the
     strategy's RNG stream position is derived from the iteration counter

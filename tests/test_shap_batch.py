@@ -1,6 +1,7 @@
 """Vectorised TreeSHAP equals the scalar reference implementation and is
 additive (reference: src/io/tree.cpp TreeSHAP; Lundberg exact algorithm)."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.shap import _tree_shap, predict_contrib
@@ -19,6 +20,7 @@ def _model(seed=3, n=400):
     return bst, X
 
 
+@pytest.mark.slow
 def test_batch_shap_matches_scalar():
     bst, X = _model()
     trees = bst._all_trees()

@@ -59,6 +59,7 @@ def _data(n=6000, f=20, seed=17):
 
 @needs_mesh
 @pytest.mark.parametrize("layout", ["nan", "categorical", "efb"])
+@pytest.mark.slow
 def test_voting_layout_matrix(layout):
     """Every training layout trains UNDER voting (no fallback) with legal
     structure and the documented quality tolerance vs serial (PV-Tree
@@ -97,6 +98,7 @@ def test_voting_layout_matrix(layout):
 
 
 @needs_mesh
+@pytest.mark.slow
 def test_voting_multiclass_lockstep():
     """K class trees grow inside ONE jitted per-class scan under voting
     (the _grow_classes path) — legal structure, sane accuracy, and the
@@ -118,6 +120,7 @@ def test_voting_multiclass_lockstep():
 
 @needs_mesh
 @pytest.mark.parametrize("sampling", ["bagging", "goss"])
+@pytest.mark.slow
 def test_voting_compaction_bit_identical(sampling):
     """GOSS/bagging row compaction under voting: every shard stable-
     partitions its OWN rows, the truncated tail carries exact-zero
@@ -154,6 +157,7 @@ def test_voting_compaction_bit_identical(sampling):
 # ---------------------------------------------------------------------------
 
 @needs_mesh
+@pytest.mark.slow
 def test_voting_fused_identity_and_dispatch():
     """Voting rides the fused one-launch iteration by default: round-1
     tree byte-equal to the unfused pipeline, <= 1 launch and 0 host
@@ -212,6 +216,7 @@ def test_voting_comms_elected_columns():
 # ---------------------------------------------------------------------------
 
 @needs_mesh
+@pytest.mark.slow
 def test_voting_checkpoint_resume(tmp_path):
     """A mid-run snapshot resumes BYTE-identically under voting (the
     restored score + iteration-keyed draws reproduce every later vote)."""
