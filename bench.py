@@ -2428,6 +2428,430 @@ def run_fleet_bench():
     return ok
 
 
+def run_pipeline_bench():
+    """BENCH_TASK=pipeline: the closed-loop freshness CHAOS gate
+    (docs/ROBUSTNESS.md "Closed-loop freshness").
+
+    One in-process serving fleet stays up for the whole run while the
+    ``task=pipeline`` CLI drives train -> TPU-native refit -> validation
+    gate -> atomic promotion -> observe against it, and the chaos matrix
+    attacks every stage:
+
+      * ARM1 clean loop: ONE CLI invocation trains the base model,
+        refits on fresh data, passes the gate, promotes; every replica
+        converges on the candidate sha and the train-vs-serve drift
+        stamp is 0.0 (bitwise);
+      * ARM2 poison_refit: NaN refit leaf values die at the nan_guard;
+      * ARM3 truncated candidate: a half-written candidate file dies at
+        the gate's corruption check;
+      * ARM4 kill_refit: the pipeline process SIGKILL-exits between
+        gate-pass and pointer write (subprocess arm, exit 137);
+      * ARM5 torn_pointer: the promote.json write is torn mid-write;
+        replicas treat it as unreadable and a clean rerun recovers at
+        the next generation;
+      * ARM6 post-promotion burn: covariate-shifted traffic fires the
+        replicas' drift alert inside the observation window and the
+        watcher rolls the fleet back to the prior generation with no
+        operator in the loop.
+
+    Under EVERY fault the fleet's 200 responses stay bitwise equal to
+    ``Booster.predict`` of the model whose sha256 the response claims —
+    zero mis-versioned responses, zero non-503 errors.  Writes
+    BENCH_PIPELINE.json on a passing non-smoke run and appends to
+    BENCH_HISTORY.jsonl; BENCH_PIPELINE_SMOKE=1 shrinks every arm and
+    never touches the committed artifact."""
+    import subprocess
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import cli, telemetry
+    from lightgbm_tpu.pipeline import (_http, _replica_endpoints,
+                                       run_pipeline)
+    from lightgbm_tpu.serving import ServingFleet
+    from lightgbm_tpu.serving.fleet import (generation_history, read_pointer,
+                                            validate_candidate)
+    from lightgbm_tpu.serving.front import http_json
+
+    smoke = os.environ.get("BENCH_PIPELINE_SMOKE", "") == "1"
+    rows = int(os.environ.get("BENCH_PIPELINE_ROWS",
+                              4_000 if smoke else 20_000))
+    iters = int(os.environ.get("BENCH_PIPELINE_MODEL_ITERS",
+                               8 if smoke else 30))
+    refit_iters = int(os.environ.get("BENCH_PIPELINE_REFIT_ITERS",
+                                     2 if smoke else 4))
+    replicas = int(os.environ.get("BENCH_PIPELINE_REPLICAS", 2))
+    observe_s = float(os.environ.get("BENCH_PIPELINE_OBSERVE_S",
+                                     25.0 if smoke else 40.0))
+    clients = int(os.environ.get("BENCH_PIPELINE_CLIENTS", 3))
+    # the chaos arms test faults, not fit: the clean promotions must not
+    # flake on holdout noise between two near-identical candidates
+    gate_margin = float(os.environ.get("BENCH_PIPELINE_GATE_MARGIN", 0.05))
+    deadline_ms = 2000.0
+
+    X, y = make_higgs_like(rows, N_FEATURES)
+    n_base, n_fresh = int(rows * 0.6), int(rows * 0.3)
+    td = tempfile.mkdtemp(prefix="lgb_bench_pipeline_")
+    csv = {}
+    for name, sl in (("base", slice(0, n_base)),
+                     ("fresh", slice(n_base, n_base + n_fresh)),
+                     ("hold", slice(n_base + n_fresh, rows))):
+        csv[name] = os.path.join(td, f"{name}.csv")
+        np.savetxt(csv[name], np.column_stack([y[sl], X[sl]]),
+                   delimiter=",", fmt="%.7g")
+
+    # generation 1: the model the fleet boots on (and must KEEP serving
+    # through every injected fault)
+    bst0 = lgb.train({"objective": "binary", "num_leaves": 63,
+                      "learning_rate": 0.1, "max_bin": 63,
+                      "verbosity": -1, "seed": 3},
+                     lgb.Dataset(X[:n_base], label=y[:n_base]),
+                     num_boost_round=iters)
+    model0 = os.path.join(td, "model0.txt")
+    bst0.save_model(model0)
+    assert os.path.exists(model0 + ".quality.json"), \
+        "training did not write the quality sidecar"
+
+    pool = np.ascontiguousarray(X[:256])
+    shifted = pool + 6.0          # the covariate shift that must burn
+    oracle = {}                   # sha -> bitwise reference predictions
+
+    def register(path):
+        sha = validate_candidate(path)
+        ref = lgb.Booster(model_file=path)
+        oracle[sha] = {"pool": ref.predict(pool, raw_score=True),
+                       "shifted": ref.predict(shifted, raw_score=True)}
+        return sha
+
+    sha0 = register(model0)
+    fd = os.path.join(td, "fleet")
+    telemetry.configure(enabled=True)
+    fleet = ServingFleet(
+        model0, replicas=replicas, max_batch=32, max_delay_ms=1.0,
+        queue_size=512, deadline_ms=deadline_ms, retries=3,
+        restart_backoff_s=0.2, fleet_dir=fd,
+        # full quality sampling + short fast window: the drift monitor
+        # must fire within the observation window (run_drift_bench
+        # settings, minus the wire-overhead arm)
+        quality_sample=1.0, quality_audit_sample=0.25,
+        drift_window_s=4.0, quality_min_rows=120)
+
+    sizes = [1, 4, 16]
+    outcomes = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
+    lock = threading.Lock()
+
+    class Traffic:
+        """Client load whose every 200 response is checked bitwise
+        against the oracle of the sha the response CLAIMS."""
+
+        def __init__(self, key, seed0):
+            self.key, self.stop = key, threading.Event()
+            self.threads = [threading.Thread(target=self._run,
+                                             args=(seed0 + i,))
+                            for i in range(clients)]
+            for t in self.threads:
+                t.start()
+
+        def _run(self, seed):
+            rs = np.random.RandomState(seed)
+            src = pool if self.key == "pool" else shifted
+            local = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0}
+            while not self.stop.is_set():
+                m = sizes[rs.randint(len(sizes))]
+                # rotating offsets keep the replicas' quality monitor fed
+                # with the DISTRIBUTION, not one repeated row
+                off = int(rs.randint(0, len(src) - m + 1))
+                try:
+                    st, obj, _ = http_json(
+                        fleet.host, fleet.port, "POST", "/predict",
+                        {"rows": src[off:off + m].tolist(),
+                         "raw_score": True, "deadline_ms": deadline_ms},
+                        timeout=deadline_ms / 1e3 + 5)
+                except OSError:
+                    local["errors"] += 1
+                    continue
+                if st == 200:
+                    ora = oracle.get(obj.get("model_sha256"))
+                    if ora is None or not np.array_equal(
+                            np.asarray(obj["predictions"]),
+                            ora[self.key][off:off + m]):
+                        local["mis_versioned"] += 1
+                    else:
+                        local["ok"] += 1
+                elif st == 503:
+                    local["s503"] += 1
+                else:
+                    local["errors"] += 1
+            with lock:
+                for k, v in local.items():
+                    outcomes[k] += v
+
+        def halt(self):
+            self.stop.set()
+            for t in self.threads:
+                t.join(30)
+
+    out = os.path.join(td, "model.txt")
+
+    def arm_params(**extra):
+        p = {"task": "pipeline", "objective": "binary", "num_leaves": 63,
+             "learning_rate": 0.1, "max_bin": 63, "num_iterations": iters,
+             "verbosity": -1, "seed": 3,
+             "pipeline_fresh_data": csv["fresh"], "valid": csv["hold"],
+             "output_model": out, "serve_fleet_dir": fd,
+             "pipeline_refit_iterations": refit_iters,
+             "pipeline_gate_margin": gate_margin,
+             "pipeline_observe_s": 0.0}
+        p.update(extra)
+        return p
+
+    def as_args(p):
+        return [f"{k}={v}" for k, v in p.items()]
+
+    def serving_shas():
+        return {r: (_http(h, p, "GET", "/ready") or {}).get("model_sha256")
+                for r, h, p in _replica_endpoints(fd)}
+
+    def fleet_serves(sha, timeout_s=30.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            shas = serving_shas()
+            if len(shas) == replicas and all(s == sha
+                                             for s in shas.values()):
+                return True
+            time.sleep(0.25)
+        return False
+
+    failures = []
+    arms = {}
+    t_rollback = None
+    chaos_prev = os.environ.get("LGBTPU_CHAOS")
+    t0_all = time.time()
+    try:
+        fleet.start()
+        if not fleet_serves(sha0):
+            failures.append("fleet did not boot serving model0")
+
+        # ---- ARM1: the clean closed loop, ONE CLI invocation ---------
+        t0 = time.time()
+        rc1 = cli.main(as_args(arm_params(
+            data=csv["base"], snapshot_freq=max(iters // 2, 1),
+            pipeline_observe_s=2.0, pipeline_observe_poll_s=0.25)))
+        p1 = read_pointer(fd)
+        sha1 = register(p1["path"]) if p1 else None
+        drift_stamp = telemetry.global_registry.snapshot()["gauges"].get(
+            "pipeline/train_serve_drift_maxabs")
+        arms["clean"] = {"rc": rc1, "wall_s": round(time.time() - t0, 1),
+                         "generation": p1 and p1["generation"],
+                         "train_serve_drift_maxabs": drift_stamp}
+        if not (rc1 == 0 and p1 and int(p1["generation"]) == 2
+                and fleet_serves(sha1)):
+            failures.append(f"ARM1 clean loop: rc={rc1}, pointer={p1}")
+        if drift_stamp != 0.0:
+            failures.append(f"ARM1 train-vs-serve drift stamp "
+                            f"{drift_stamp!r} != 0.0 (not bitwise)")
+
+        # in-distribution traffic now flows through every failure arm:
+        # the fleet must keep serving sha1 bitwise under each fault
+        tr = Traffic("pool", seed0=41)
+        time.sleep(2.0)
+
+        def failed_arm(name, directive, expect_rc=1):
+            if directive is not None:
+                os.environ["LGBTPU_CHAOS"] = directive
+            try:
+                rc = cli.main(as_args(arm_params(input_model=model0)))
+            finally:
+                if directive is not None:
+                    os.environ.pop("LGBTPU_CHAOS", None)
+            time.sleep(1.0)   # let the replicas re-poll the pointer
+            still = all(s == sha1 for s in serving_shas().values())
+            arms[name] = {"rc": rc, "old_sha_served": still}
+            if rc != expect_rc or not still:
+                failures.append(f"{name}: rc={rc} (want {expect_rc}), "
+                                f"old_sha_served={still}")
+            return rc
+
+        # ---- ARM2: poisoned refit dies at the nan_guard --------------
+        failed_arm("poison_refit", "poison_refit:count=4")
+        if read_pointer(fd) != p1:
+            failures.append("poison_refit moved the pointer")
+
+        # ---- ARM3: truncated candidate dies at the corruption check --
+        failed_arm("truncated_candidate",
+                   f"truncate_snapshot:iter=0,once={td}/m3.marker")
+        if read_pointer(fd) != p1:
+            failures.append("truncated candidate moved the pointer")
+
+        # ---- ARM4: SIGKILL between gate-pass and pointer write -------
+        m4 = os.path.join(td, "m4.marker")
+        env4 = dict(os.environ)
+        env4["LGBTPU_CHAOS"] = f"kill_refit:once={m4}"
+        proc = subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu"]
+            + as_args(arm_params(input_model=model0)),
+            env=env4, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=900)
+        time.sleep(1.0)
+        still4 = all(s == sha1 for s in serving_shas().values())
+        arms["kill_refit"] = {"rc": proc.returncode,
+                              "fired": os.path.exists(m4),
+                              "old_sha_served": still4}
+        if (proc.returncode != 137 or not os.path.exists(m4)
+                or not still4 or read_pointer(fd) != p1):
+            failures.append(
+                f"kill_refit: rc={proc.returncode} (want 137), "
+                f"fired={os.path.exists(m4)}, old_sha={still4}; "
+                f"stderr tail: {proc.stderr[-300:]!r}")
+
+        # ---- ARM5: torn pointer write, then clean recovery -----------
+        failed_arm("torn_pointer",
+                   f"torn_pointer:once={td}/m5.marker")
+        if read_pointer(fd) is not None:
+            failures.append("torn pointer read back as valid JSON")
+        tr.halt()      # clean promotions change the sha mid-flight
+        rc5 = cli.main(as_args(arm_params(input_model=model0,
+                                          refit_decay_rate=0.8)))
+        p5 = read_pointer(fd)
+        sha5 = register(p5["path"]) if p5 else None
+        arms["recovery"] = {"rc": rc5,
+                            "generation": p5 and p5["generation"]}
+        if not (rc5 == 0 and p5 and int(p5["generation"]) == 4
+                and fleet_serves(sha5)):
+            failures.append(f"ARM5 recovery: rc={rc5}, pointer={p5}")
+
+        # ---- ARM6: promote, then burn -> automatic rollback ----------
+        box = {}
+        params6 = arm_params(input_model=model0, refit_decay_rate=0.85,
+                             pipeline_observe_s=observe_s,
+                             pipeline_observe_poll_s=0.3)
+
+        def _arm6():
+            box["report"] = run_pipeline(params6)
+
+        th = threading.Thread(target=_arm6)
+        th.start()
+        p6 = None
+        t_lim = time.time() + 180
+        while time.time() < t_lim:
+            p = read_pointer(fd)
+            if p and int(p["generation"]) == 5:
+                p6 = p
+                break
+            time.sleep(0.25)
+        sha6 = register(p6["path"]) if p6 else None
+        if not (p6 and fleet_serves(sha6)):
+            failures.append(f"ARM6 promotion did not land: {p6}")
+        t_promo = time.time()
+        # covariate-shifted traffic: the replicas' drift alert must fire
+        # and the watcher must roll the fleet back — no operator action
+        tr2 = Traffic("shifted", seed0=71)
+        rolled = None
+        t_lim = time.time() + observe_s + 30
+        while time.time() < t_lim:
+            p = read_pointer(fd)
+            if p and p.get("rollback_from") is not None:
+                rolled = p
+                t_rollback = time.time() - t_promo
+                break
+            time.sleep(0.3)
+        tr2.halt()
+        th.join(observe_s + 120)
+        rep6 = box.get("report", {})
+        obs = rep6.get("observe", {})
+        arms["burn_rollback"] = {
+            "promoted_generation": p6 and p6["generation"],
+            "rollback_s": t_rollback and round(t_rollback, 2),
+            "reason": obs.get("reason"),
+            "observe": obs}
+        if not (rolled and int(rolled["generation"]) == 4
+                and int(rolled["rollback_from"]) == 5
+                and str(rolled["sha256"]) == sha5
+                and obs.get("burned") and rep6.get("ok")
+                and fleet_serves(sha5)):
+            failures.append(
+                f"ARM6 burn/rollback: rolled={rolled}, "
+                f"observe={obs}, report_ok={rep6.get('ok')}")
+    finally:
+        fleet.stop()
+        if chaos_prev is None:
+            os.environ.pop("LGBTPU_CHAOS", None)
+        else:
+            os.environ["LGBTPU_CHAOS"] = chaos_prev
+
+    # ---- evidence: counters, trace timeline, generation history ------
+    snap = telemetry.global_registry.snapshot()
+    ctr = snap["counters"]
+    for key, floor in (("pipeline/promotions", 3),
+                       ("pipeline/gate_failures", 2),
+                       ("pipeline/promotions_torn", 1),
+                       ("fleet/rollbacks", 1),
+                       ("refit/route_replay_passes", 1)):
+        if ctr.get(key, 0) < floor:
+            failures.append(f"counter {key}={ctr.get(key, 0)} < {floor}")
+    trace_path = os.path.join(td, "pipeline_trace.json")
+    telemetry.export_trace(trace_path)
+    with open(trace_path) as fh:
+        trace_txt = fh.read()
+    for ev in ("pipeline:promote", "pipeline:gate_failed",
+               "pipeline:observe_burn", "fleet:rollback"):
+        if ev not in trace_txt:
+            failures.append(f"trace timeline missing {ev!r}")
+    gens = [(h["generation"], h.get("rollback_from"))
+            for h in generation_history(fd)]
+    if gens != [(1, None), (2, None), (3, None), (4, None), (5, None),
+                (4, 5)]:
+        failures.append(f"generation history {gens}")
+    if not (outcomes["errors"] == 0 and outcomes["mis_versioned"] == 0
+            and outcomes["ok"] > 0):
+        failures.append(f"traffic outcomes {outcomes}")
+
+    ok = not failures
+    record = {
+        "metric": "pipeline_chaos_loop",
+        "value": round(t_rollback, 2) if t_rollback else None,
+        "unit": (f"s from promotion to automatic drift rollback "
+                 f"({'OK' if ok else 'FAIL'}: outcomes={outcomes}, "
+                 f"arms={sorted(arms)}, rollbacks="
+                 f"{ctr.get('fleet/rollbacks', 0)})"),
+        "vs_baseline": None,
+        "smoke": smoke,
+        "wall_s": round(time.time() - t0_all, 1),
+        "replicas": replicas,
+        "clients": clients,
+        "observe_window_s": observe_s,
+        "served_200": outcomes["ok"],
+        "shed_503": outcomes["s503"],
+        "non_503_errors": outcomes["errors"],
+        "mis_versioned": outcomes["mis_versioned"],
+        "arms": arms,
+        "generations": gens,
+        "counters": {k: ctr.get(k, 0) for k in
+                     ("pipeline/promotions", "pipeline/gate_failures",
+                      "pipeline/promotions_torn", "fleet/rollbacks",
+                      "refit/route_replay_passes",
+                      "refit/walk_fallback_passes")},
+        "gates": {"failures": failures},
+    }
+    print(json.dumps({k: record[k] for k in
+                      ("metric", "value", "unit", "vs_baseline")}),
+          flush=True)
+    for msg in failures:
+        print(f"BENCH_PIPELINE gate FAIL: {msg}", flush=True)
+    if not smoke:
+        _append_history(record, ok=ok)
+        if ok:
+            # a failing chaos run must not clobber the last PASSING
+            # artifact, and the smoke variant never writes it at all
+            from lightgbm_tpu.robustness.checkpoint import atomic_open
+            with atomic_open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_PIPELINE.json"), "w") as fh:
+                json.dump(record, fh, indent=2)
+                fh.write("\n")
+    return ok
+
+
 def _write_synth_csv(path, n_rows, n_feat, seed=7, chunk=200_000,
                      decimals=None):
     """Stream a synthetic HIGGS-like CSV to disk chunk by chunk — the
@@ -2681,9 +3105,11 @@ if __name__ == "__main__":
         sys.exit(0 if run_drift_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
     if task not in ("", "higgs", "ranking", "multiclass", "goss", "ingest",
-                    "wide", "histfloor"):
+                    "wide", "histfloor", "pipeline"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "
-                 "multiclass, goss, ingest, wide, histfloor")
+                 "multiclass, goss, ingest, wide, histfloor, pipeline")
+    if task == "pipeline":
+        sys.exit(0 if run_pipeline_bench() else 1)
     if task == "goss":
         sys.exit(0 if run_goss() else 1)
     if task == "ingest":

@@ -1726,7 +1726,11 @@ class Booster:
         is collective); only rank 0 writes."""
         from .robustness.checkpoint import write_checkpoint
         it = int(iteration) if iteration is not None else self.current_iteration()
-        return write_checkpoint(self, str(output_model), it, keep=keep)
+        # a configured fleet dir pins promoted snapshots against pruning
+        fleet_dir = str(getattr(getattr(self, "config", None),
+                                "serve_fleet_dir", "") or "")
+        return write_checkpoint(self, str(output_model), it, keep=keep,
+                                fleet_dir=fleet_dir)
 
     def dump_model(self, num_iteration: Optional[int] = None, start_iteration: int = 0,
                    importance_type: str = "split") -> Dict:

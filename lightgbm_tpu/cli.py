@@ -8,6 +8,8 @@ Usage:
     python -m lightgbm_tpu config=train.conf [key=value ...]
     python -m lightgbm_tpu task=train data=train.csv objective=binary ...
     python -m lightgbm_tpu task=predict data=test.csv input_model=model.txt
+    python -m lightgbm_tpu task=pipeline data=train.csv fresh_data=new.csv \
+        valid=holdout.csv serve_fleet_dir=/srv/fleet observe_window_s=30
 """
 from __future__ import annotations
 
@@ -304,6 +306,13 @@ def main(argv=None) -> int:
         run_save_binary(params)
     elif task == "convert_model":
         run_convert_model(params)
+    elif task == "pipeline":
+        # closed-loop freshness: train → refit-on-fresh-data → validation
+        # gate → atomic fleet promotion → observe/auto-rollback
+        # (docs/ROBUSTNESS.md "Closed-loop freshness")
+        from .pipeline import run_pipeline
+        report = run_pipeline(params)
+        return 0 if report.get("ok") else 1
     elif task == "serve":
         # online inference server (docs/SERVING.md); blocks until SIGTERM.
         # serve_replicas > 1 runs the replica-fleet supervisor (restart

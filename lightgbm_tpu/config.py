@@ -232,6 +232,13 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "quality_topk": ["drift_topk"],
     "drift_threshold": ["drift_psi_threshold"],
     "drift_window_s": ["drift_window"],
+    # --- closed-loop pipeline (docs/ROBUSTNESS.md) ---
+    "pipeline_fresh_data": ["fresh_data"],
+    "pipeline_refit_iterations": ["refit_iterations"],
+    "pipeline_gate_margin": ["gate_margin"],
+    "pipeline_observe_s": ["observe_window_s"],
+    "pipeline_observe_poll_s": [],
+    "pipeline_promote": [],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -395,6 +402,8 @@ class Config:
     monotone_penalty: float = 0.0
     feature_contri: Any = None
     forcedsplits_filename: str = ""
+    # task=refit / task=pipeline leaf-value refit: new leaf value is
+    # decay * old + (1 - decay) * refitted (reference: FitByExistingTree)
     refit_decay_rate: float = 0.9
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
@@ -728,6 +737,28 @@ class Config:
     # mirroring the SLO burn-rate pairing)
     drift_window_s: float = 60.0
 
+    # --- closed-loop pipeline: task=pipeline (docs/ROBUSTNESS.md
+    # "Closed-loop freshness") ---
+    # fresh/appended rows for the refit stage (file path, streamed via
+    # the ingest pipeline so fresh data never needs to fit in RAM)
+    pipeline_fresh_data: str = ""
+    # boosting rounds continued on the fresh data before the device leaf
+    # refit (0 = leaf-value refit only, no new trees)
+    pipeline_refit_iterations: int = 2
+    # validation gate: allowed holdout-metric regression of the candidate
+    # vs the baseline model (same units as the metric; 0 = must not
+    # regress at all)
+    pipeline_gate_margin: float = 0.0
+    # post-promotion observation window in seconds: an SLO burn or drift
+    # alert inside it triggers automatic rollback to the prior
+    # generation (0 = no watch, promotion is final)
+    pipeline_observe_s: float = 0.0
+    # poll period of the rollback watcher inside the observation window
+    pipeline_observe_poll_s: float = 0.5
+    # write the promotion pointer on gate pass (false = dry run: train,
+    # refit and gate the candidate but leave the fleet untouched)
+    pipeline_promote: bool = True
+
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records
     telemetry: bool = False
@@ -887,6 +918,22 @@ class Config:
         if self.drift_window_s <= 0:
             raise LightGBMError(
                 f"drift_window_s={self.drift_window_s} must be > 0")
+        if not 0.0 <= self.refit_decay_rate <= 1.0:
+            raise LightGBMError(
+                f"refit_decay_rate={self.refit_decay_rate} must be in "
+                "[0, 1]")
+        if self.pipeline_refit_iterations < 0:
+            raise LightGBMError(
+                f"pipeline_refit_iterations={self.pipeline_refit_iterations}"
+                " must be >= 0")
+        if self.pipeline_observe_s < 0:
+            raise LightGBMError(
+                f"pipeline_observe_s={self.pipeline_observe_s} must be "
+                ">= 0")
+        if self.pipeline_observe_poll_s <= 0:
+            raise LightGBMError(
+                f"pipeline_observe_poll_s={self.pipeline_observe_poll_s} "
+                "must be > 0")
         # GOSS parameter conflicts (reference: Config::CheckParamConflict,
         # src/io/config.cpp — "cannot use bagging in GOSS" and the sampled
         # fractions must partition the data)

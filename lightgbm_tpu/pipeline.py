@@ -1,0 +1,493 @@
+"""Closed-loop freshness pipeline: ``task=pipeline``
+(docs/ROBUSTNESS.md "Closed-loop freshness").
+
+One CLI invocation runs the whole production loop:
+
+1. **train**   — base model (skipped when ``input_model`` is given), with
+   a final PR 3 checkpoint so the refit stage continues from a
+   crash-consistent snapshot, not a bare model file.
+2. **refit**   — continued training on ``pipeline_fresh_data`` (streamed
+   via the ingest pipeline: fresh data never needs to fit in RAM), then
+   the TPU-native leaf-value refit (``refit.refit_leaf_values``: stream
+   kernel route replay + f64 segment sums, ``refit_decay_rate`` blend).
+3. **gate**    — the candidate must pass nan_guard/corruption validation
+   (``validate_candidate``), must not regress the holdout metric by more
+   than ``pipeline_gate_margin`` vs the serving baseline, and must carry
+   a regenerated quality-profile sidecar.
+4. **promote** — atomic fleet-wide promotion through the ``promote.json``
+   generation pointer; the promotion is a telemetry instant, replicas'
+   convergence is awaited, and the train-vs-serve score drift of a probe
+   batch is stamped into telemetry (zero tolerance: the fleet must serve
+   ``Booster.predict`` bitwise).
+5. **observe** — for ``pipeline_observe_s`` seconds the watcher polls the
+   replicas' SLO and drift alerts; a burn triggers automatic rollback to
+   the prior generation (``rollback_pointer``) without operator action.
+
+Every fault injected by the chaos matrix (poison_refit, kill_refit,
+torn_pointer, truncated candidate) must leave the fleet serving its old
+sha256 — the pipeline only ever moves the pointer AFTER the gate, and
+verifies its own pointer write before declaring success.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .utils.log import LightGBMError, log_info, log_warning
+
+_PROMOTE_WAIT_S = 30.0
+
+
+# ---------------------------------------------------------------------------
+# fleet-dir plumbing (file-based: works with no in-process fleet handle)
+# ---------------------------------------------------------------------------
+
+def _replica_endpoints(fleet_dir: str) -> List[Tuple[int, str, int]]:
+    """(rank, host, port) from the replica_<r>.json files the replicas
+    publish; unreadable files (replica mid-restart) are skipped."""
+    out: List[Tuple[int, str, int]] = []
+    if not fleet_dir:
+        return out
+    import glob as _glob
+    import re as _re
+    for p in sorted(_glob.glob(os.path.join(fleet_dir, "replica_*.json"))):
+        m = _re.match(r"replica_(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as fh:
+                ep = json.load(fh)
+            out.append((int(m.group(1)), str(ep["host"]), int(ep["port"])))
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def _http(host: str, port: int, method: str, path: str, obj=None,
+          timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    import http.client
+    from .serving.front import http_json
+    try:
+        _, payload, _ = http_json(host, port, method, path, obj=obj,
+                                  timeout=timeout)
+        return payload
+    except (OSError, http.client.HTTPException, ValueError):
+        return None
+
+
+def _wait_for_sha(fleet_dir: str, sha: str, generation: int,
+                  timeout_s: float) -> Dict[str, Any]:
+    """Poll replica /ready until every reachable replica serves ``sha``
+    (and has processed ``generation``); returns the convergence record."""
+    deadline = time.monotonic() + timeout_s
+    converged: Dict[int, bool] = {}
+    reachable = 0
+    while True:
+        eps = _replica_endpoints(fleet_dir)
+        if not eps:
+            # pointer-only promotion (no replica has published an endpoint
+            # file): nothing to await — the pointer is the contract
+            break
+        states = {r: _http(h, p, "GET", "/ready") for r, h, p in eps}
+        reachable = sum(1 for s in states.values() if s is not None)
+        converged = {
+            r: (s is not None and str(s.get("model_sha256")) == sha
+                and int(s.get("seen_generation", 0)) >= 0)
+            for r, s in states.items()}
+        if reachable and all(converged.values()):
+            break
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.1)
+    return {"generation": int(generation), "sha256": sha,
+            "reachable": reachable,
+            "converged": sorted(r for r, ok in converged.items() if ok),
+            "pending": sorted(r for r, ok in converged.items() if not ok)}
+
+
+# ---------------------------------------------------------------------------
+# stages
+# ---------------------------------------------------------------------------
+
+def _stage_train(params: Dict[str, Any], cfg: Config,
+                 out_model: str) -> Tuple[Booster, Optional[Dataset], str]:
+    """Base model: load ``input_model`` when given, else train on
+    ``data=`` and force a final checkpoint (the refit stage continues
+    from the snapshot, proving the PR 3 interplay end to end)."""
+    from .engine import train as engine_train
+
+    input_model = str(params.get("input_model", "") or "")
+    if input_model:
+        bst = Booster(model_file=input_model, params=dict(params))
+        return bst, None, input_model
+    data_path = params.get("data")
+    if not data_path:
+        raise LightGBMError(
+            "task=pipeline requires data=<file> (or input_model=<file>)")
+    ds = Dataset(str(data_path), params=dict(params))
+    num_rounds = int(params.get("num_iterations", 100))
+    bst = engine_train(params, ds, num_boost_round=num_rounds)
+    bst.save_model(out_model)
+    keep = int(params.get("snapshot_keep", -1) or -1)
+    bst.checkpoint(out_model, keep=keep)
+    return bst, ds, out_model
+
+
+def _stage_refit(params: Dict[str, Any], cfg: Config, base_bst: Booster,
+                 base_ds: Optional[Dataset], base_path: str,
+                 out_model: str, candidate_path: str,
+                 report: Dict[str, Any]) -> Booster:
+    """Continued training on the fresh data + device leaf refit."""
+    from .engine import train as engine_train
+    from .refit import refit_leaf_values
+    from .robustness.checkpoint import latest_valid_snapshot, load_checkpoint
+
+    fresh = str(params.get("pipeline_fresh_data", "") or "")
+    if not fresh:
+        raise LightGBMError(
+            "task=pipeline requires pipeline_fresh_data=<file> "
+            "(alias fresh_data)")
+    # resume source: the newest valid checkpoint of the base model when
+    # one exists (crash-consistent, sha-sealed), else the model file
+    init = base_bst
+    snap = latest_valid_snapshot(out_model, params=dict(params))
+    if snap is not None:
+        model_str, manifest, _ = load_checkpoint(snap, params=dict(params))
+        init = Booster(model_str=model_str, params=dict(params))
+        report["refit_source"] = {"checkpoint": snap,
+                                  "iteration": int(manifest["iteration"])}
+    else:
+        report["refit_source"] = {"model_file": base_path}
+    fresh_ds = Dataset(fresh, params=dict(params), reference=base_ds)
+    refit_iters = int(cfg.pipeline_refit_iterations)
+    if refit_iters > 0:
+        p2 = dict(params)
+        # the candidate's own snapshots must not clobber the base run's,
+        # and num_iterations= in the user params governs the BASE model,
+        # not the continuation (engine.train lets it trump num_boost_round)
+        p2["output_model"] = candidate_path
+        p2["num_iterations"] = refit_iters
+        p2.pop("snapshot_freq", None)
+        cand = engine_train(p2, fresh_ds, num_boost_round=refit_iters,
+                            init_model=init)
+    else:
+        cand = Booster(model_str=init.model_to_string(),
+                       params=dict(params))
+    report["refit"] = refit_leaf_values(cand, fresh_ds,
+                                        decay_rate=cfg.refit_decay_rate)
+    report["refit"]["continued_iterations"] = refit_iters
+    stats = getattr(fresh_ds, "ingest_stats", None) or {}
+    report["refit"]["ingest_mode"] = stats.get("mode", "inmem")
+    cand.save_model(candidate_path)
+    # chaos matrix: a candidate torn on disk (partial write, dying fs)
+    # must die at the gate's parse/truncation check, never in the fleet
+    from .robustness import chaos
+    chaos.maybe_truncate_snapshot(candidate_path, 0)
+    return cand
+
+
+def _stage_gate(params: Dict[str, Any], cfg: Config, cand: Booster,
+                candidate_path: str, baseline_path: str,
+                report: Dict[str, Any]) -> bool:
+    """All checks must pass before the candidate may touch the pointer."""
+    from .metrics import create_metrics
+    from .model_io import _objective_string
+    from .serving.fleet import validate_candidate
+    from .telemetry.quality import QUALITY_SUFFIX
+
+    gate: Dict[str, Any] = {"checks": {}}
+    report["gate"] = gate
+    ok = True
+
+    # 1) nan_guard + corruption/truncation: the exact validation every
+    # promoter and replica runs (a poisoned or torn candidate dies here)
+    try:
+        gate["sha256"] = validate_candidate(candidate_path)
+        gate["checks"]["nan_guard"] = "pass"
+    except LightGBMError as e:
+        gate["checks"]["nan_guard"] = f"FAIL: {e}"
+        ok = False
+
+    # 2) holdout metric vs the serving baseline
+    vspec = str(params.get("valid", params.get("valid_data", "")) or "")
+    valid_path = vspec.split(",")[0].strip() if vspec else ""
+    if valid_path and ok:
+        from .dataset_io import load_data_file
+        Xv, yv, _ = load_data_file(valid_path, dict(params))
+        if yv is None:
+            raise LightGBMError(
+                "pipeline gate needs a labeled holdout (valid=<file>)")
+        obj_name = _objective_string(cand).split(" ")[0] or "regression"
+        cfg2 = Config.from_params({**params, "objective": obj_name})
+        metrics = create_metrics(cfg2, obj_name)
+        base = Booster(model_file=baseline_path)
+
+        def _eval(b: Booster):
+            score = np.asarray(b.predict(Xv, raw_score=True))
+            out = {}
+            for m in metrics:
+                m.init(yv, None)
+                for name, val, hb in m.evaluate(score,
+                                                b._convert_output_fn()):
+                    out[name] = (float(val), bool(hb))
+            return out
+
+        cand_ev, base_ev = _eval(cand), _eval(base)
+        margin = float(cfg.pipeline_gate_margin)
+        worse = []
+        for name, (cv, hb) in cand_ev.items():
+            bv = base_ev.get(name, (cv, hb))[0]
+            regressed = (cv < bv - margin) if hb else (cv > bv + margin)
+            if regressed:
+                worse.append(f"{name} {cv:.6g} vs baseline {bv:.6g}")
+        gate["holdout"] = {"candidate": {k: v[0] for k, v in cand_ev.items()},
+                           "baseline": {k: v[0] for k, v in base_ev.items()},
+                           "margin": margin}
+        if worse:
+            gate["checks"]["holdout_metric"] = "FAIL: " + "; ".join(worse)
+            ok = False
+        else:
+            gate["checks"]["holdout_metric"] = "pass"
+    else:
+        gate["checks"]["holdout_metric"] = ("skipped (no valid=)"
+                                            if not valid_path else "skipped")
+
+    # 3) quality-profile regeneration (PR 16): the sidecar must ride the
+    # candidate so the fleet's drift monitor has a reference to compare to
+    if bool(getattr(cfg, "quality_profile", True)):
+        sidecar = candidate_path + QUALITY_SUFFIX
+        if os.path.exists(sidecar):
+            gate["checks"]["quality_profile"] = "pass"
+        else:
+            gate["checks"]["quality_profile"] = (
+                "FAIL: sidecar missing (candidate saved without an engine "
+                "or quality_profile write failed)")
+            ok = False
+    else:
+        gate["checks"]["quality_profile"] = "skipped (quality_profile=false)"
+
+    gate["pass"] = ok
+    return ok
+
+
+def _stage_promote(params: Dict[str, Any], cfg: Config, cand: Booster,
+                   candidate_path: str, fleet_dir: str,
+                   report: Dict[str, Any]) -> bool:
+    from . import telemetry
+    from .robustness import chaos
+    from .serving.fleet import promote_pointer, read_pointer
+
+    # the chaos window the whole design exists for: gate passed, pointer
+    # not yet written — a crash here must leave the fleet untouched
+    chaos.maybe_kill_refit()
+    pointer = promote_pointer(fleet_dir, candidate_path)
+    gen, sha = int(pointer["generation"]), str(pointer["sha256"])
+    # verify our own write: a torn pointer (chaos or a dying filesystem)
+    # reads back as None/garbage and must be reported as a FAILED
+    # promotion, not waited on
+    back = read_pointer(fleet_dir)
+    if back is None or int(back.get("generation", -1)) != gen \
+            or str(back.get("sha256")) != sha:
+        report["promote"] = {"generation": gen, "sha256": sha,
+                             "torn_pointer": True}
+        telemetry.inc("pipeline/promotions_torn")
+        log_warning("pipeline: pointer write did not read back; the fleet "
+                    "keeps its old generation")
+        return False
+    telemetry.instant("pipeline:promote", generation=gen, sha256=sha,
+                      path=candidate_path)
+    telemetry.inc("pipeline/promotions")
+    conv = _wait_for_sha(fleet_dir, sha, gen, _PROMOTE_WAIT_S)
+    report["promote"] = {"generation": gen, "sha256": sha,
+                         "convergence": conv}
+
+    # train-vs-serve drift stamp: the served scores of a probe batch must
+    # be bitwise Booster.predict of the PROMOTED ARTIFACT — reloaded from
+    # the candidate file, because that is what the replicas loaded (the
+    # in-memory engine booster differs in the serialization ulps)
+    probe = _probe_rows(params, cand)
+    if probe is not None and conv["converged"]:
+        local = np.asarray(Booster(model_file=candidate_path).predict(probe),
+                           np.float64)
+        eps = _replica_endpoints(fleet_dir)
+        drift = None
+        mis_versioned = 0
+        for r, h, p in eps:
+            resp = _http(h, p, "POST", "/predict",
+                         {"rows": probe.tolist()}, timeout=10.0)
+            if resp is None or "predictions" not in resp:
+                continue
+            if str(resp.get("model_sha256")) != sha:
+                mis_versioned += 1
+                continue
+            served = np.asarray(resp["predictions"], np.float64)
+            d = float(np.max(np.abs(served - local))) if served.size else 0.0
+            drift = d if drift is None else max(drift, d)
+        if drift is not None:
+            telemetry.gauge("pipeline/train_serve_drift_maxabs", drift)
+            report["promote"]["train_serve_drift_maxabs"] = drift
+            report["promote"]["mis_versioned"] = mis_versioned
+    return True
+
+
+def _probe_rows(params: Dict[str, Any],
+                cand: Booster) -> Optional[np.ndarray]:
+    """A small feature batch for the train-vs-serve drift stamp (holdout
+    file first, fresh data second); None when neither loads."""
+    from .dataset_io import load_data_file
+    for key in ("valid", "pipeline_fresh_data"):
+        spec = str(params.get(key, "") or "").split(",")[0].strip()
+        if not spec:
+            continue
+        try:
+            X, label, _ = load_data_file(spec, dict(params))
+        except (LightGBMError, OSError):
+            continue
+        if X.shape[1] == cand.num_feature() - 1 and label is not None:
+            X = np.column_stack([label, X])
+        return np.asarray(X[: min(64, X.shape[0])], np.float64)
+    return None
+
+
+def _stage_observe(cfg: Config, fleet_dir: str,
+                   report: Dict[str, Any]) -> None:
+    """Post-promotion rollback watcher: any replica reporting an SLO burn
+    or a drift alert inside the observation window reverts the fleet to
+    the prior generation — no operator in the loop."""
+    from . import telemetry
+    from .serving.fleet import read_pointer, rollback_pointer
+
+    window = float(cfg.pipeline_observe_s)
+    obs: Dict[str, Any] = {"window_s": window, "burned": False}
+    report["observe"] = obs
+    if window <= 0:
+        obs["skipped"] = "pipeline_observe_s=0"
+        return
+    deadline = time.monotonic() + window
+    poll = float(cfg.pipeline_observe_poll_s)
+    while time.monotonic() < deadline:
+        for r, h, p in _replica_endpoints(fleet_dir):
+            st = _http(h, p, "GET", "/ready")
+            if st is None:
+                continue
+            reasons = []
+            if st.get("slo_alert"):
+                reasons.append("slo_burn")
+            if st.get("drift_alert"):
+                reasons.append("drift_alert")
+            if reasons:
+                why = "+".join(reasons) + f" on replica {r}"
+                telemetry.instant("pipeline:observe_burn", replica=r,
+                                  reasons=",".join(reasons))
+                pointer = rollback_pointer(fleet_dir, reason=why)
+                conv = _wait_for_sha(fleet_dir, str(pointer["sha256"]),
+                                     int(pointer["generation"]),
+                                     _PROMOTE_WAIT_S)
+                obs.update({"burned": True, "reason": why,
+                            "rollback": {
+                                "generation": int(pointer["generation"]),
+                                "rollback_from": pointer.get("rollback_from"),
+                                "sha256": pointer["sha256"],
+                                "convergence": conv}})
+                return
+        time.sleep(poll)
+    obs["healthy"] = True
+    log_info(f"pipeline: observation window ({window:.1f}s) passed clean; "
+             f"generation {read_pointer(fleet_dir)['generation'] if read_pointer(fleet_dir) else '?'} stands")
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def run_pipeline(params: Dict[str, Any]) -> Dict[str, Any]:
+    """The closed loop, one invocation.  Returns the stage report;
+    ``report["ok"]`` is the CLI exit status."""
+    from . import telemetry
+
+    cfg = Config.from_params(params)
+    if cfg.telemetry:
+        telemetry.configure(enabled=True)
+    out_model = str(params.get("output_model", "LightGBM_model.txt"))
+    fleet_dir = str(params.get("serve_fleet_dir", "") or "")
+    # generation-unique candidate path: a later pipeline run (even one
+    # that fails its gate) must never overwrite the model file the
+    # fleet's pointer currently targets
+    if fleet_dir:
+        from .serving.fleet import _current_generation
+        candidate_path = (
+            f"{out_model}.candidate_gen{_current_generation(fleet_dir) + 1}")
+    else:
+        candidate_path = out_model + ".candidate"
+    report: Dict[str, Any] = {"ok": False, "candidate": candidate_path,
+                              "fleet_dir": fleet_dir}
+
+    with telemetry.global_tracer.span("pipeline/train"):
+        base_bst, base_ds, base_path = _stage_train(params, cfg, out_model)
+    report["base_model"] = base_path
+
+    with telemetry.global_tracer.span("pipeline/refit"):
+        cand = _stage_refit(params, cfg, base_bst, base_ds, base_path,
+                            out_model, candidate_path, report)
+
+    with telemetry.global_tracer.span("pipeline/gate"):
+        # baseline for the gate: what the fleet serves NOW (pointer
+        # target) when there is one, else the base model
+        baseline = base_path
+        if fleet_dir:
+            from .serving.fleet import read_pointer
+            p = read_pointer(fleet_dir)
+            if p and os.path.exists(str(p["path"])):
+                baseline = str(p["path"])
+        gate_ok = _stage_gate(params, cfg, cand, candidate_path, baseline,
+                              report)
+    if not gate_ok:
+        telemetry.instant("pipeline:gate_failed",
+                          checks=json.dumps(report["gate"]["checks"]))
+        telemetry.inc("pipeline/gate_failures")
+        log_warning(f"pipeline: gate FAILED ({report['gate']['checks']}); "
+                    "the fleet keeps its current generation")
+        _finish(params, report)
+        return report
+
+    if not fleet_dir or not bool(cfg.pipeline_promote):
+        report["promote"] = {"skipped": ("no serve_fleet_dir" if not fleet_dir
+                                         else "pipeline_promote=false")}
+        report["ok"] = True
+        _finish(params, report)
+        return report
+
+    with telemetry.global_tracer.span("pipeline/promote"):
+        promoted = _stage_promote(params, cfg, cand, candidate_path,
+                                  fleet_dir, report)
+    if not promoted:
+        _finish(params, report)
+        return report
+
+    with telemetry.global_tracer.span("pipeline/observe"):
+        _stage_observe(cfg, fleet_dir, report)
+
+    report["ok"] = True
+    _finish(params, report)
+    return report
+
+
+def _finish(params: Dict[str, Any], report: Dict[str, Any]) -> None:
+    from . import telemetry
+
+    if telemetry.enabled():
+        telemetry.gauge("pipeline/ok", 1.0 if report["ok"] else 0.0)
+        trace_out = str(params.get("trace_out", "") or "")
+        if trace_out:
+            try:
+                telemetry.export_trace(trace_out)
+            except OSError as e:
+                log_warning(f"pipeline: trace export failed: {e}")
+    log_info(f"pipeline: {'OK' if report['ok'] else 'FAILED'} "
+             f"(candidate {report['candidate']})")

@@ -14,6 +14,14 @@ Grammar (directives separated by ``;``, options by ``,``)::
     LGBTPU_CHAOS="hang:iter=3,rank=1,once=/tmp/m"   # stop heartbeating
     LGBTPU_CHAOS="heartbeat_delay:seconds=2"        # slow every heartbeat
 
+Closed-loop pipeline faults (docs/ROBUSTNESS.md "Closed-loop
+freshness"; ``iter`` for ``poison_refit`` is the 1-based tree index of
+the refit loop)::
+
+    LGBTPU_CHAOS="poison_refit:iter=1,count=4"      # NaN refit leaf values
+    LGBTPU_CHAOS="kill_refit:once=/tmp/m"           # die between gate and pointer
+    LGBTPU_CHAOS="torn_pointer:once=/tmp/m"         # truncated promote.json write
+
 Serving-fleet faults (docs/SERVING.md fleet architecture; ``rank`` here
 is the REPLICA rank — the supervisor exports ``LGBTPU_REPLICA_RANK`` to
 every replica process and rank matching prefers it over
@@ -197,6 +205,55 @@ def heartbeat_hook(iteration: int) -> None:
             time.sleep(d.seconds or 3600.0)
         elif _matches(d, "heartbeat_delay", iteration):
             time.sleep(d.seconds or 1.0)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop pipeline faults (docs/ROBUSTNESS.md "Closed-loop freshness")
+# ---------------------------------------------------------------------------
+
+def inject_nan_refit(values: "np.ndarray", tree_index: int):
+    """Poison the first ``count`` refitted leaf values of tree
+    ``tree_index`` (1-based) with NaN — the validation gate's nan_guard
+    must refuse the candidate; identity otherwise."""
+    for d in directives():
+        if _matches(d, "poison_refit", tree_index) and _fire_once(d):
+            import numpy as np
+            n = min(d.count or 4, values.shape[0])
+            log_warning(f"chaos: poisoning {n} refit leaf values of tree "
+                        f"{tree_index}")
+            out = np.array(values, np.float64, copy=True)
+            out[:n] = np.nan
+            return out
+    return values
+
+
+def maybe_kill_refit() -> None:
+    """Simulate the pipeline process dying BETWEEN gate-pass and the
+    promotion pointer write (``os._exit``, like SIGKILL): the fleet must
+    keep serving the old generation because the pointer never moved."""
+    for d in directives():
+        if _matches(d, "kill_refit", None) and _fire_once(d):
+            log_warning("chaos: killing pipeline between gate and "
+                        "pointer write")
+            os._exit(137)
+
+
+def maybe_tear_pointer(fleet_dir: str, pointer_text: str) -> bool:
+    """Replace the atomic promote.json write with a NON-atomic truncated
+    write (first half of the JSON) — simulates a promoter dying mid-write
+    on a filesystem without atomic rename.  Replicas must treat the torn
+    pointer as unreadable and keep serving.  Returns True when fired (the
+    caller must then skip its own pointer write)."""
+    for d in directives():
+        if _matches(d, "torn_pointer", None) and _fire_once(d):
+            path = os.path.join(fleet_dir, "promote.json")
+            torn = pointer_text[:max(len(pointer_text) // 2, 1)]
+            with open(path, "w") as fh:
+                fh.write(torn)
+            log_warning(f"chaos: tore pointer write at {path} "
+                        f"({len(pointer_text)} -> {len(torn)} bytes)")
+            return True
+    return False
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,11 @@ _VOLATILE_PARAMS = frozenset({
     "quality_profile", "quality_sample", "quality_audit_sample",
     "quality_min_rows", "quality_topk", "drift_threshold",
     "drift_window_s",
+    # closed-loop pipeline orchestration: these shape WHEN a candidate is
+    # built/promoted, never the trees inside a checkpoint
+    "pipeline_fresh_data", "pipeline_refit_iterations",
+    "pipeline_gate_margin", "pipeline_observe_s",
+    "pipeline_observe_poll_s", "pipeline_promote",
 })
 
 
@@ -277,7 +282,7 @@ def snapshot_path(output_model: str, iteration: int) -> str:
 
 
 def write_checkpoint(booster, output_model: str, iteration: int,
-                     keep: int = -1) -> str:
+                     keep: int = -1, fleet_dir: str = "") -> str:
     """Write the iteration-``N`` checkpoint for ``output_model`` and prune
     to the ``keep`` newest (``keep <= 0`` keeps all).  Multi-process: every
     rank participates in state capture (collective), rank 0 writes."""
@@ -313,12 +318,42 @@ def write_checkpoint(booster, output_model: str, iteration: int,
                       json.dumps(manifest, indent=1, sort_keys=True))
     chaos.maybe_truncate_snapshot(path, int(iteration))
     if keep and keep > 0:
-        prune_snapshots(str(output_model), keep)
+        prune_snapshots(str(output_model), keep, fleet_dir=fleet_dir)
     return path
 
 
-def prune_snapshots(output_model: str, keep: int) -> None:
+def promoted_paths(fleet_dir: str) -> set:
+    """Real paths a live ``promote.json`` generation points at — the
+    currently served model AND its rollback target (``prev``).  Read
+    directly (not via serving.fleet) so the checkpoint layer stays
+    import-light; a torn/unreadable pointer pins nothing."""
+    pinned: set = set()
+    if not fleet_dir:
+        return pinned
+    try:
+        with open(os.path.join(fleet_dir, "promote.json")) as fh:
+            p = json.load(fh)
+    except (OSError, ValueError):
+        return pinned
+    for rec in (p, p.get("prev") or {}):
+        target = rec.get("path")
+        if target:
+            pinned.add(os.path.realpath(str(target)))
+    return pinned
+
+
+def prune_snapshots(output_model: str, keep: int,
+                    fleet_dir: str = "") -> None:
+    """Delete all but the ``keep`` newest snapshots — EXCEPT any snapshot
+    a live promotion generation (current or rollback target) points at:
+    pruning the fleet's serving model out from under it would break every
+    replica restart and the rollback path."""
+    pinned = promoted_paths(fleet_dir)
     for it, path in list_snapshots(output_model)[:-keep]:
+        if os.path.realpath(path) in pinned:
+            log_debug(f"snapshot {path} pinned by a live promotion; "
+                      "not pruned")
+            continue
         for p in (path, path + STATE_SUFFIX, path + MANIFEST_SUFFIX):
             try:
                 os.unlink(p)

@@ -106,15 +106,71 @@ def read_pointer(fleet_dir: str) -> Optional[Dict[str, Any]]:
         return None
 
 
-def write_pointer(fleet_dir: str, path: str, sha: str,
-                  generation: int) -> Dict[str, Any]:
+HISTORY_NAME = "generations.jsonl"
+
+
+def generation_history(fleet_dir: str) -> List[Dict[str, Any]]:
+    """Append-only promotion audit trail (one JSON line per pointer
+    write).  Survives a torn/corrupt ``promote.json``: the next promoter
+    recovers the generation counter from here instead of resetting to 1
+    (which the monotonicity guard would then refuse fleet-wide)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(os.path.join(fleet_dir, HISTORY_NAME)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue   # torn final line of a killed writer
+    except OSError:
+        pass
+    return out
+
+
+def write_pointer(fleet_dir: str, path: str, sha: str, generation: int,
+                  prev: Optional[Dict[str, Any]] = None,
+                  rollback_from: Optional[int] = None) -> Dict[str, Any]:
     """Atomically replace the promotion pointer (tmp + ``os.replace``:
-    a replica's watcher never reads a half-written pointer)."""
-    pointer = {"generation": int(generation), "path": str(path),
-               "sha256": sha, "promoted_unix": time.time()}
-    atomic_write_text(os.path.join(fleet_dir, PROMOTE_NAME),
-                      json.dumps(pointer))
+    a replica's watcher never reads a half-written pointer).  ``prev``
+    records the generation being replaced (the rollback target);
+    ``rollback_from`` marks an intentional downgrade so replicas accept
+    the backwards generation."""
+    pointer: Dict[str, Any] = {
+        "generation": int(generation), "path": str(path),
+        "sha256": sha, "promoted_unix": time.time()}
+    if prev:
+        pointer["prev"] = {"generation": int(prev["generation"]),
+                           "path": str(prev["path"]),
+                           "sha256": prev["sha256"]}
+    if rollback_from is not None:
+        pointer["rollback_from"] = int(rollback_from)
+    # history first, pointer second: a writer killed in between leaves a
+    # history entry with no pointer — harmless — while the reverse order
+    # could leave a served generation with no audit trail
+    try:
+        with open(os.path.join(fleet_dir, HISTORY_NAME), "a") as fh:
+            fh.write(json.dumps(pointer) + "\n")
+    except OSError as e:
+        log_warning(f"fleet: generation history append failed: {e}")
+    from ..robustness import chaos
+    text = json.dumps(pointer)
+    if chaos.maybe_tear_pointer(fleet_dir, text):
+        return pointer
+    atomic_write_text(os.path.join(fleet_dir, PROMOTE_NAME), text)
     return pointer
+
+
+def _current_generation(fleet_dir: str) -> int:
+    """Last written generation: the pointer, or (torn/missing pointer)
+    the newest history entry."""
+    cur = read_pointer(fleet_dir)
+    if cur is not None:
+        return int(cur["generation"])
+    hist = generation_history(fleet_dir)
+    return int(hist[-1]["generation"]) if hist else 0
 
 
 def promote_pointer(fleet_dir: str, path: str,
@@ -128,13 +184,70 @@ def promote_pointer(fleet_dir: str, path: str,
             f"serving candidate {path!r} sha256 mismatch (expected "
             f"{sha[:12]}..., file {checked[:12]}...)")
     cur = read_pointer(fleet_dir)
-    gen = int(cur["generation"]) + 1 if cur else 1
-    return write_pointer(fleet_dir, path, checked, gen)
+    gen = _current_generation(fleet_dir) + 1
+    return write_pointer(fleet_dir, path, checked, gen, prev=cur)
+
+
+def rollback_pointer(fleet_dir: str, reason: str = "") -> Dict[str, Any]:
+    """Revert the fleet to the previous generation: re-validate the prior
+    target and write it back with a ``rollback_from`` marker (the only
+    thing that lets a replica accept a backwards generation).  The target
+    comes from the current pointer's ``prev`` record, or — when the
+    pointer is torn — the history trail."""
+    from .. import telemetry
+
+    cur = read_pointer(fleet_dir)
+    target = (cur or {}).get("prev")
+    cur_gen = _current_generation(fleet_dir)
+    if target is None:
+        hist = generation_history(fleet_dir)
+        for rec in reversed(hist):
+            if int(rec.get("generation", 0)) < cur_gen:
+                target = rec
+                break
+    if target is None:
+        raise LightGBMError(
+            f"fleet dir {fleet_dir!r} has no prior generation to roll "
+            "back to")
+    sha = validate_candidate(str(target["path"]))
+    if sha != target.get("sha256"):
+        raise LightGBMError(
+            f"rollback target {target['path']!r} sha256 changed since its "
+            f"promotion ({sha[:12]}... != "
+            f"{str(target.get('sha256'))[:12]}...)")
+    pointer = write_pointer(fleet_dir, str(target["path"]), sha,
+                            int(target["generation"]),
+                            rollback_from=cur_gen)
+    telemetry.instant("fleet:rollback", generation=pointer["generation"],
+                      rollback_from=cur_gen, sha256=sha,
+                      reason=reason or "unspecified")
+    telemetry.inc("fleet/rollbacks")
+    log_warning(f"fleet: rolled back generation {cur_gen} -> "
+                f"{pointer['generation']} ({reason or 'unspecified'})")
+    return pointer
 
 
 # ---------------------------------------------------------------------------
 # replica process
 # ---------------------------------------------------------------------------
+
+def pointer_transition(applied: int, pointer: Optional[Dict[str, Any]]
+                       ) -> str:
+    """The promotion watcher's decision for a freshly read pointer, given
+    the generation this replica last applied: ``"apply"``, ``"ignore"``
+    (unreadable/unchanged), or ``"refuse"`` (backwards generation with no
+    ``rollback_from`` marker — a stale or duplicate promoter must not
+    silently downgrade the fleet; only ``rollback_pointer`` writes the
+    marker that makes a downgrade intentional)."""
+    if pointer is None:
+        return "ignore"
+    gen = int(pointer["generation"])
+    if gen == applied:
+        return "ignore"
+    if gen < applied and pointer.get("rollback_from") is None:
+        return "refuse"
+    return "apply"
+
 
 def _replica_main(spec_path: str, rank: int) -> int:
     """Entry point of one replica process (spawned by the supervisor as
@@ -251,9 +364,19 @@ def _replica_main(spec_path: str, rank: int) -> int:
         applied = int(pointer["generation"])
         while not stop.wait(float(spec.get("poll_s", _BEAT_S))):
             p = read_pointer(fleet_dir)
-            if p is None or int(p["generation"]) <= applied:
+            decision = pointer_transition(applied, p)
+            if decision == "ignore":
                 continue
             gen = int(p["generation"])
+            if decision == "refuse":
+                log_warning(
+                    f"replica {rank}: refusing pointer generation "
+                    f"{gen} < applied {applied} without a "
+                    "rollback_from marker (stale promoter?)")
+                continue
+            if gen < applied:
+                log_warning(f"replica {rank}: rollback generation "
+                            f"{gen} (from {p['rollback_from']})")
             applied = gen
             try:
                 # re-validate against the POINTER's sha first: a file
@@ -397,8 +520,9 @@ class ServingFleet:
         # boots on the same validated version
         sha = validate_candidate(model_path)
         cur = read_pointer(self.dir)
-        gen = int(cur["generation"]) + 1 if cur else 1
-        self._pointer = write_pointer(self.dir, model_path, sha, gen)
+        gen = _current_generation(self.dir) + 1
+        self._pointer = write_pointer(self.dir, model_path, sha, gen,
+                                      prev=cur)
         # observability knobs ride to every replica via the spec; the
         # access log treats the configured path as a DIRECTORY in fleet
         # mode (access_front.jsonl + access_replica_<r>.jsonl inside)
@@ -678,6 +802,29 @@ class ServingFleet:
                 "promoted": sorted(promoted),
                 "rejected": {str(r): m for r, m in sorted(rejected.items())},
                 "unreachable": sorted(set(unreachable) - set(promoted))}
+
+    def rollback(self, reason: str = "",
+                 timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Revert the fleet to the previous generation and wait for the
+        live replicas to converge on the rollback target's sha256 (the
+        generation number moves DOWN, so the promote() wait — which keys
+        on seen_generation advancing — does not apply)."""
+        pointer = rollback_pointer(self.dir, reason=reason)
+        sha = str(pointer["sha256"])
+        deadline = time.monotonic() + timeout_s
+        reverted: Dict[int, bool] = {}
+        while time.monotonic() < deadline:
+            states = self._ready_states()
+            reverted = {r: (st is not None
+                            and str(st.get("model_sha256")) == sha)
+                        for r, st in states.items()}
+            if states and all(reverted.values()):
+                break
+            time.sleep(0.1)
+        return {"generation": int(pointer["generation"]),
+                "rollback_from": pointer.get("rollback_from"),
+                "sha256": sha,
+                "reverted": sorted(r for r, ok in reverted.items() if ok)}
 
     def _ready_states(self) -> Dict[int, Optional[Dict[str, Any]]]:
         """rank -> /ready payload (None when unreachable) for every live

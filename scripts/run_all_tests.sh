@@ -45,6 +45,13 @@ python -m pytest tests/test_fleet.py -x -q -m 'not slow'
 # model quality") — a lying drift monitor poisons every rollout decision
 echo "=== stage: data/model quality fast tier ==="
 python -m pytest tests/test_quality.py -x -q -m 'not slow'
+# closed-loop freshness fast tier: TPU-native refit bitwise vs the host
+# reference (weighted + decay), streamed-fresh-data byte identity,
+# checkpoint/resume bit-identity through refit, generation-pointer
+# monotonicity, and the pointer-only pipeline end-to-end with
+# poison/torn chaos arms (docs/ROBUSTNESS.md "Closed-loop freshness")
+echo "=== stage: closed-loop pipeline fast tier ==="
+python -m pytest tests/test_pipeline.py -x -q -m 'not slow'
 # drift bench smoke: reduced rows + short alternating QPS windows —
 # gates the full behavior arm (alert FIRES under a +6-sigma covariate
 # shift, CLEARS on recovery, shadow audit is 0-mismatch over >= 500
@@ -180,6 +187,18 @@ BENCH_FLEET=1 \
 BENCH_FLEET_ROWS="${BENCH_FLEET_ROWS:-20000}" \
 BENCH_FLEET_MODEL_ITERS="${BENCH_FLEET_MODEL_ITERS:-10}" \
 BENCH_FLEET_SECS="${BENCH_FLEET_SECS:-8}" \
+    python bench.py
+# closed-loop pipeline chaos bench (reduced-size smoke): one CLI
+# invocation drives train -> TPU refit -> gate -> atomic promote ->
+# observe against a live 2-replica fleet while chaos poisons the refit,
+# truncates the candidate, SIGKILLs the pipeline pre-pointer-write,
+# tears the pointer, and a covariate shift forces the automatic
+# post-promotion rollback — all under bitwise-checked traffic;
+# BENCH_PIPELINE_SMOKE=1 never clobbers the committed BENCH_PIPELINE.json
+echo "=== stage: pipeline chaos bench smoke (BENCH_TASK=pipeline) ==="
+BENCH_TASK=pipeline \
+BENCH_PIPELINE_SMOKE=1 \
+BENCH_HISTORY=0 \
     python bench.py
 # native sanitizer tier: builds native/binner.cpp under ASan/UBSan and
 # drives every extern-C entry point (incl. the categorical bitset

@@ -358,6 +358,63 @@ def test_promote_pointer_generations(served, tmp_path):
     assert read_pointer(d)["generation"] == 2
 
 
+def test_two_concurrent_promoters_cannot_downgrade(served, tmp_path):
+    """Two promoters racing on the same fleet dir: the slow one writes a
+    pointer with a generation the fleet already moved past.  The replica
+    watcher must refuse the backwards pointer (no ``rollback_from``
+    marker) instead of silently downgrading the fleet."""
+    from lightgbm_tpu.serving.fleet import (pointer_transition,
+                                            validate_candidate,
+                                            write_pointer)
+    pa, pb, _, _, _ = served
+    d = str(tmp_path)
+    # writer A promotes twice; the fleet's replicas applied generation 2
+    promote_pointer(d, pa)
+    p2 = promote_pointer(d, pb)
+    applied = p2["generation"]
+    assert pointer_transition(applied, read_pointer(d)) == "ignore"
+    # writer B raced: it read generation 1 before A's second promotion
+    # and now writes its (validated, parseable) candidate as generation 2
+    # ... then loses the os.replace race and re-writes as the stale gen 1
+    sha_a = validate_candidate(pa)
+    stale = write_pointer(d, pa, sha_a, 1)
+    assert read_pointer(d)["generation"] == 1          # file says 1
+    assert pointer_transition(applied, stale) == "refuse"
+    assert pointer_transition(applied, read_pointer(d)) == "refuse"
+    # only an intentional rollback (the marker rollback_pointer writes)
+    # may move a replica's generation backwards
+    marked = write_pointer(d, pa, sha_a, 1, rollback_from=applied)
+    assert pointer_transition(applied, marked) == "apply"
+    # and an unreadable/torn pointer is a no-op, never a downgrade
+    assert pointer_transition(applied, None) == "ignore"
+
+
+def test_rollback_pointer_reverts_to_prev(served, tmp_path):
+    """rollback_pointer targets the current pointer's ``prev`` record,
+    re-validates it, and stamps ``rollback_from`` so replicas accept the
+    downgrade; a fleet with no prior generation refuses to roll back."""
+    from lightgbm_tpu.serving.fleet import (generation_history,
+                                            rollback_pointer)
+    pa, pb, _, _, _ = served
+    d = str(tmp_path)
+    with pytest.raises(lgb.LightGBMError, match="no prior generation"):
+        rollback_pointer(d)
+    p1 = promote_pointer(d, pa)
+    with pytest.raises(lgb.LightGBMError, match="no prior generation"):
+        rollback_pointer(d)                  # generation 1 has no prev
+    p2 = promote_pointer(d, pb)
+    assert p2["prev"]["generation"] == p1["generation"]
+    rb = rollback_pointer(d, reason="slo burn")
+    assert rb["generation"] == p1["generation"]
+    assert rb["sha256"] == p1["sha256"]
+    assert rb["rollback_from"] == p2["generation"]
+    assert read_pointer(d)["path"] == str(pa)
+    # the audit trail records promote, promote, rollback in order
+    gens = [(h["generation"], h.get("rollback_from"))
+            for h in generation_history(d)]
+    assert gens == [(1, None), (2, None), (1, p2["generation"])]
+
+
 # ---------------------------------------------------------------------------
 # the real fleet: restart-with-backoff + fleet-wide reload (subprocesses)
 # ---------------------------------------------------------------------------

@@ -271,6 +271,75 @@ def test_chaos_parse_and_cli(monkeypatch):
         chaos.directives()
 
 
+def test_chaos_closed_loop_directives(monkeypatch, tmp_path):
+    """The refit/promotion chaos hooks (docs/ROBUSTNESS.md chaos matrix):
+    poison_refit NaNs leaf values, torn_pointer half-writes promote.json,
+    and all three parse with the standard option grammar."""
+    monkeypatch.setenv(
+        chaos.ENV_VAR,
+        "poison_refit:iter=1,count=3; kill_refit:once=/tmp/m; "
+        "torn_pointer:once=/tmp/m2")
+    ds = chaos.directives()
+    assert [d.name for d in ds] == ["poison_refit", "kill_refit",
+                                    "torn_pointer"]
+    assert ds[0].count == 3 and ds[1].once == "/tmp/m"
+    vals = np.linspace(-1.0, 1.0, 8)
+    poisoned = chaos.inject_nan_refit(vals, tree_index=1)
+    assert np.isnan(poisoned[:3]).all() and np.isfinite(poisoned[3:]).all()
+    assert np.isfinite(vals).all()             # input untouched
+    # unmatched tree index: exact pass-through
+    assert chaos.inject_nan_refit(vals, tree_index=2) is vals
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert chaos.inject_nan_refit(vals, tree_index=1) is vals
+    assert chaos.maybe_tear_pointer(str(tmp_path), "{}") is False
+    chaos.maybe_kill_refit()                   # must not exit
+
+
+def test_prune_never_deletes_promoted_snapshot(tmp_path):
+    """snapshot_keep pruning must skip any snapshot a live promote.json
+    generation points at — current target or rollback target — else a
+    replica restart/rollback would load a deleted file."""
+    from lightgbm_tpu.robustness.checkpoint import prune_snapshots
+    from lightgbm_tpu.serving.fleet import promote_pointer
+
+    X, y = make_synthetic_binary(n=800)
+    M = tmp_path / "model.txt"
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    lgb.train(_binary_params(M, snapshot_freq=2),
+              lgb.Dataset(X, label=y), num_boost_round=8)
+    snaps = dict(list_snapshots(str(M)))
+    assert set(snaps) == {2, 4, 6, 8}
+    # promote iter-2 (-> prev of nothing), then iter-4: the pointer now
+    # pins 4 (current) AND 2 (rollback target)
+    promote_pointer(str(fleet), snaps[2])
+    promote_pointer(str(fleet), snaps[4])
+    prune_snapshots(str(M), keep=1, fleet_dir=str(fleet))
+    kept = set(dict(list_snapshots(str(M))))
+    assert kept == {2, 4, 8}                   # newest + both pinned
+    # without the fleet dir the same call would have deleted them
+    prune_snapshots(str(M), keep=1, fleet_dir="")
+    assert set(dict(list_snapshots(str(M)))) == {8}
+
+
+def test_checkpoint_threads_fleet_dir_pin(tmp_path):
+    """Booster.checkpoint must thread serve_fleet_dir into pruning: a
+    training run with snapshot_keep=1 keeps the promoted snapshot."""
+    from lightgbm_tpu.serving.fleet import promote_pointer
+
+    X, y = make_synthetic_binary(n=800)
+    M = tmp_path / "model.txt"
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    p = _binary_params(M, snapshot_freq=2, serve_fleet_dir=str(fleet))
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    snaps = dict(list_snapshots(str(M)))
+    assert set(snaps) == {2, 4}
+    promote_pointer(str(fleet), snaps[2])
+    bst.checkpoint(str(M), keep=1)             # prunes, but pin survives
+    assert set(dict(list_snapshots(str(M)))) == {2, 4}
+
+
 def test_chaos_truncate_snapshot_skipped_by_latest_valid(tmp_path,
                                                          monkeypatch):
     X, y = make_synthetic_binary(n=800)
