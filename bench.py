@@ -2852,6 +2852,333 @@ def run_pipeline_bench():
     return ok
 
 
+def run_multimodel_bench():
+    """BENCH_TASK=multimodel: the multi-tenant serving gate
+    (docs/SERVING.md "Multi-tenant serving").
+
+    One ServingApp hosts N same-shape tenants behind the HBM-resident
+    multi-model cache and takes mixed traffic — binary-wire v2 predicts
+    and device-batched ``/explain`` — across every tenant at once:
+
+      * every 200/ST_OK response is bitwise equal to the FILE-loaded
+        ``Booster.predict`` of the tenant the response names, and stamps
+        that tenant's sha256 (zero mis-versioned responses);
+      * ``/explain`` responses match ``predict(pred_contrib=True)``
+        bitwise per tenant;
+      * after the warmup pass ZERO XLA programs are traced — mixed
+        tenants share the stacked ``serve_predict_multi`` programs via
+        the shape envelope, so tenant count never multiplies compiles;
+      * halfway through, the cache budget is squeezed to ~55% of
+        residency: LRU evict/readmit churns under live traffic with
+        zero non-503 errors, zero recompiles (compiled programs are
+        keyed by shape and survive eviction) and bitwise readmissions;
+      * a 2-tenant fleet takes ONE ``task=pipeline`` promotion keyed
+        ``pipeline_model_id=a`` (the PR 18 closed loop) — tenant a
+        converges on the candidate while tenant b's responses stay
+        bitwise; a truncated candidate for a is refused at validation
+        and perturbs NOBODY.
+
+    Writes BENCH_MULTIMODEL.json on a passing non-smoke run and appends
+    to BENCH_HISTORY.jsonl; BENCH_MULTIMODEL_SMOKE=1 shrinks every arm
+    and never touches the committed artifact."""
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import cli, telemetry
+    from lightgbm_tpu.basic import LightGBMError
+    from lightgbm_tpu.serving import (BinaryClient, ServingApp,
+                                      ServingFleet, WireError)
+    from lightgbm_tpu.serving.fleet import read_pointer, validate_candidate
+    from lightgbm_tpu.serving.front import http_json
+    from lightgbm_tpu.telemetry import recompile_counts
+
+    smoke = os.environ.get("BENCH_MULTIMODEL_SMOKE", "") == "1"
+    n_models = int(os.environ.get("BENCH_MULTIMODEL_MODELS",
+                                  4 if smoke else 12))
+    rows = int(os.environ.get("BENCH_MULTIMODEL_ROWS",
+                              2_000 if smoke else 8_000))
+    iters = int(os.environ.get("BENCH_MULTIMODEL_MODEL_ITERS",
+                               8 if smoke else 20))
+    secs = float(os.environ.get("BENCH_MULTIMODEL_SECS",
+                                4.0 if smoke else 10.0))
+    clients = int(os.environ.get("BENCH_MULTIMODEL_CLIENTS", 4))
+    telemetry.configure(enabled=True)
+
+    td = tempfile.mkdtemp(prefix="lgb_bench_mm_")
+    mids = [f"t{i:02d}" for i in range(n_models)]
+    roster, oracle = {}, {}
+    Xp = None
+    for i, mid in enumerate(mids):
+        X, y = make_higgs_like(rows, N_FEATURES, seed=100 + i)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "learning_rate": 0.1, "max_bin": 63,
+                         "verbosity": -1, "seed": i},
+                        lgb.Dataset(X, label=y), num_boost_round=iters)
+        p = os.path.join(td, f"{mid}.txt")
+        bst.save_model(p)
+        roster[mid] = p
+        if Xp is None:
+            Xp = np.ascontiguousarray(X[:256])
+        ref = lgb.Booster(model_file=p)   # the bytes the server serves
+        oracle[mid] = {"sha": validate_candidate(p),
+                       "raw": ref.predict(Xp, raw_score=True),
+                       "contrib": ref.predict(Xp[:64], pred_contrib=True)}
+
+    app = ServingApp("", models=roster, port=0, binary_port=0,
+                     max_batch=64, max_delay_ms=1.0, queue_size=2048,
+                     explain_max_batch=16, explain_queue_size=256).start()
+    failures = []
+    sizes = [1, 4, 16]
+
+    # ---- exactness + warmup: every tenant through BOTH wires (this
+    # also primes any path the boot warmup missed before the counters
+    # are pinned)
+    exact = True
+    with BinaryClient(app.host, app.binary_port) as c:
+        for mid in mids:
+            for m in sizes:
+                r = c.request(Xp[:m], raw_score=True, model_id=mid)
+                exact &= (r["status"] == 0 and r["model_id"] == mid
+                          and r["model_sha256"] == oracle[mid]["sha"]
+                          and np.array_equal(r["predictions"],
+                                             oracle[mid]["raw"][:m]))
+            e = c.explain(Xp[:4], model_id=mid)
+            want = oracle[mid]["contrib"][:4]
+            exact &= (e["status"] == 0 and np.array_equal(
+                np.asarray(e["predictions"]).reshape(want.shape), want))
+    if not exact:
+        failures.append("per-tenant exactness pass failed pre-traffic")
+    compiles0 = dict(recompile_counts())
+    evict0 = app.registry.evictions
+
+    # ---- mixed timed traffic across every tenant at once; halfway
+    # through the HBM budget squeezes to ~55% and the cache churns
+    stop = threading.Event()
+    lock = threading.Lock()
+    outcomes = {"ok": 0, "s503": 0, "errors": 0, "mis_versioned": 0,
+                "explain_ok": 0}
+
+    def wire_client(seed):
+        rs = np.random.RandomState(seed)
+        local = dict.fromkeys(outcomes, 0)
+        try:
+            c = BinaryClient(app.host, app.binary_port, timeout=30)
+        except (OSError, WireError):
+            local["errors"] += 1
+        else:
+            try:
+                while not stop.is_set():
+                    mid = mids[rs.randint(n_models)]
+                    m = sizes[rs.randint(len(sizes))]
+                    off = int(rs.randint(0, len(Xp) - m + 1))
+                    if rs.rand() < 0.15:
+                        r = c.explain(Xp[off % 48:off % 48 + m],
+                                      model_id=mid)
+                        if r["status"] == 0:
+                            want = oracle[mid]["contrib"][
+                                off % 48:off % 48 + m]
+                            if np.array_equal(np.asarray(
+                                    r["predictions"]).reshape(want.shape),
+                                    want):
+                                local["explain_ok"] += 1
+                            else:
+                                local["mis_versioned"] += 1
+                        elif r["status"] == 2:
+                            local["s503"] += 1
+                        else:
+                            local["errors"] += 1
+                        continue
+                    r = c.request(Xp[off:off + m], raw_score=True,
+                                  model_id=mid)
+                    if r["status"] == 0:
+                        if (r["model_id"] == mid
+                                and r["model_sha256"] == oracle[mid]["sha"]
+                                and np.array_equal(
+                                    r["predictions"],
+                                    oracle[mid]["raw"][off:off + m])):
+                            local["ok"] += 1
+                        else:
+                            local["mis_versioned"] += 1
+                    elif r["status"] == 2:
+                        local["s503"] += 1
+                    else:
+                        local["errors"] += 1
+            except (OSError, WireError):
+                local["errors"] += 1
+            finally:
+                c.close()
+        with lock:
+            for k, v in local.items():
+                outcomes[k] += v
+
+    threads = [threading.Thread(target=wire_client, args=(500 + i,))
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(secs / 2)
+    # squeeze: the LRU cache must churn under live traffic without an
+    # error surge or a single fresh trace
+    full_bytes = app.registry.resident_bytes()
+    app.registry.budget_bytes = max(int(full_bytes * 0.55), 1)
+    time.sleep(secs / 2)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    elapsed = time.time() - t0
+    churn_evictions = app.registry.evictions - evict0
+    readmissions = app.registry.stats()["cache"]["readmissions"]
+    compiles1 = dict(recompile_counts())
+    fresh = {k: v - compiles0.get(k, 0) for k, v in compiles1.items()
+             if v != compiles0.get(k, 0)}
+    app.shutdown(drain=True)
+
+    qps = (outcomes["ok"] + outcomes["explain_ok"]) / max(elapsed, 1e-9)
+    if outcomes["errors"] or outcomes["mis_versioned"]:
+        failures.append(f"traffic outcomes {outcomes}")
+    if outcomes["ok"] == 0 or outcomes["explain_ok"] == 0:
+        failures.append(f"no verified traffic served: {outcomes}")
+    if fresh:
+        failures.append(f"recompiles after warmup: {fresh}")
+    if churn_evictions == 0 or readmissions == 0:
+        failures.append(f"budget squeeze did not churn the cache "
+                        f"(evictions={churn_evictions}, "
+                        f"readmissions={readmissions})")
+
+    # ---- per-tenant promotion through the PR 18 pipeline: ONE tenant
+    # moves, its sibling must stay bitwise; a poisoned candidate for the
+    # same tenant is refused at validation and perturbs nobody
+    pipe = {}
+    fd = os.path.join(td, "fleet")
+    csv_base = os.path.join(td, "base.csv")
+    csv_hold = os.path.join(td, "hold.csv")
+    Xf, yf = make_higgs_like(rows, N_FEATURES, seed=900)
+    nb = int(rows * 0.7)
+    np.savetxt(csv_base, np.column_stack([yf[:nb], Xf[:nb]]),
+               delimiter=",", fmt="%.7g")
+    np.savetxt(csv_hold, np.column_stack([yf[nb:], Xf[nb:]]),
+               delimiter=",", fmt="%.7g")
+    fleet = ServingFleet("", models={"a": roster[mids[0]],
+                                     "b": roster[mids[1]]},
+                         replicas=1, max_batch=32, max_delay_ms=1.0,
+                         fleet_dir=fd, warmup=False,
+                         startup_timeout_s=240.0)
+    try:
+        fleet.start()
+
+        def served(mid, m=16):
+            st, obj, _ = http_json(
+                fleet.host, fleet.port, "POST", "/predict",
+                {"rows": Xp[:m].tolist(), "raw_score": True,
+                 "model_id": mid}, timeout=30)
+            return st, (np.asarray(obj["predictions"])
+                        if st == 200 else obj)
+        st_a, pre_a = served("a")
+        st_b, pre_b = served("b")
+        if not (st_a == st_b == 200
+                and np.array_equal(pre_a, oracle[mids[0]]["raw"][:16])
+                and np.array_equal(pre_b, oracle[mids[1]]["raw"][:16])):
+            failures.append("fleet boot tenants not bitwise")
+        rc = cli.main([
+            "task=pipeline", "objective=binary", "num_leaves=31",
+            "learning_rate=0.1", "max_bin=63", f"num_iterations={iters}",
+            "verbosity=-1", "seed=3", f"data={csv_base}",
+            f"valid={csv_hold}", f"pipeline_fresh_data={csv_hold}",
+            f"output_model={os.path.join(td, 'pipe.txt')}",
+            f"serve_fleet_dir={fd}", "pipeline_model_id=a",
+            "pipeline_refit_iterations=2", "pipeline_gate_margin=0.05",
+            "pipeline_observe_s=2.0", "pipeline_observe_poll_s=0.25"])
+        pa = read_pointer(fd, "a")
+        pb = read_pointer(fd, "b")
+        cand_sha = pa and str(pa.get("sha256"))
+        deadline = time.time() + 30
+        conv = False
+        while time.time() < deadline and not conv:
+            st_a, post_a = served("a")
+            conv = (st_a == 200 and cand_sha and np.array_equal(
+                post_a,
+                lgb.Booster(model_file=str(pa["path"])).predict(
+                    Xp[:16], raw_score=True)))
+            if not conv:
+                time.sleep(0.5)
+        st_b, post_b = served("b")
+        pipe["clean"] = {"rc": rc, "gen_a": pa and pa.get("generation"),
+                         "gen_b": pb and pb.get("generation")}
+        if not (rc == 0 and pa and int(pa["generation"]) == 2
+                and pb and int(pb["generation"]) == 1 and conv):
+            failures.append(f"pipeline tenant-a promotion: {pipe['clean']}")
+        if not (st_b == 200 and np.array_equal(post_b, pre_b)):
+            failures.append("tenant-a promotion perturbed tenant b")
+
+        # poisoned candidate for a: refused at validate, nobody moves
+        bad = os.path.join(td, "poison.txt")
+        with open(str(pa["path"])) as fh:
+            blob = fh.read()
+        with open(bad, "w") as fh:
+            fh.write(blob[: len(blob) // 2])
+        refused = False
+        try:
+            fleet.promote(bad, model_id="a", timeout_s=30.0)
+        except LightGBMError:
+            refused = True
+        pa2 = read_pointer(fd, "a")
+        st_a, after_a = served("a")
+        st_b, after_b = served("b")
+        pipe["poison"] = {"refused": refused,
+                          "gen_a": pa2 and pa2.get("generation")}
+        if not (refused and pa2 == pa and st_a == 200 and st_b == 200
+                and np.array_equal(after_a, post_a)
+                and np.array_equal(after_b, pre_b)):
+            failures.append(f"poisoned candidate arm: {pipe['poison']}")
+    finally:
+        fleet.stop()
+
+    ok = not failures
+    record = {
+        "metric": "serve_multimodel_qps",
+        "value": round(qps, 1),
+        "unit": (f"verified req/s over {elapsed:.1f}s, {n_models} tenants "
+                 f"x {clients} clients mixed wire-v2+explain "
+                 f"({'OK' if ok else 'FAIL'}: outcomes={outcomes}, "
+                 f"recompiles_after_warmup={sum(fresh.values())}, "
+                 f"cache churn evictions={churn_evictions} "
+                 f"readmissions={readmissions})"),
+        "vs_baseline": None,
+        "smoke": smoke,
+        "models": n_models,
+        "clients": clients,
+        "served_200": outcomes["ok"],
+        "explain_200": outcomes["explain_ok"],
+        "shed_503": outcomes["s503"],
+        "non_503_errors": outcomes["errors"],
+        "mis_versioned": outcomes["mis_versioned"],
+        "recompiles_after_warmup": fresh,
+        "cache": {"evictions": churn_evictions,
+                  "readmissions": readmissions,
+                  "budget_fraction": 0.55},
+        "pipeline": pipe,
+        "gates": {"failures": failures},
+    }
+    print(json.dumps({k: record[k] for k in
+                      ("metric", "value", "unit", "vs_baseline")}),
+          flush=True)
+    for msg in failures:
+        print(f"BENCH_MULTIMODEL gate FAIL: {msg}", flush=True)
+    if not smoke:
+        _append_history(record, ok=ok)
+        if ok:
+            # a failing run must not clobber the last PASSING artifact,
+            # and the smoke variant never writes it at all
+            from lightgbm_tpu.robustness.checkpoint import atomic_open
+            with atomic_open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_MULTIMODEL.json"), "w") as fh:
+                json.dump(record, fh, indent=2)
+                fh.write("\n")
+    return ok
+
+
 def _write_synth_csv(path, n_rows, n_feat, seed=7, chunk=200_000,
                      decimals=None):
     """Stream a synthetic HIGGS-like CSV to disk chunk by chunk — the
@@ -3105,11 +3432,14 @@ if __name__ == "__main__":
         sys.exit(0 if run_drift_bench() else 1)
     task = os.environ.get("BENCH_TASK", "")
     if task not in ("", "higgs", "ranking", "multiclass", "goss", "ingest",
-                    "wide", "histfloor", "pipeline"):
+                    "wide", "histfloor", "pipeline", "multimodel"):
         sys.exit(f"unknown BENCH_TASK={task!r}; one of higgs, ranking, "
-                 "multiclass, goss, ingest, wide, histfloor, pipeline")
+                 "multiclass, goss, ingest, wide, histfloor, pipeline, "
+                 "multimodel")
     if task == "pipeline":
         sys.exit(0 if run_pipeline_bench() else 1)
+    if task == "multimodel":
+        sys.exit(0 if run_multimodel_bench() else 1)
     if task == "goss":
         sys.exit(0 if run_goss() else 1)
     if task == "ingest":

@@ -16,7 +16,8 @@ allow-list:
   * ``fleet/replica/<r>/...`` — bounded by ``serve_replicas``;
   * ``recompile/<name>`` — bounded by the watched_jit entry-point set;
   * ``drift/feature/<i>/...`` — bounded by ``quality_topk``;
-  * ``quality/audit/<field>`` — bounded by the fixed audit stat set.
+  * ``quality/audit/<field>`` — bounded by the fixed audit stat set;
+  * ``model/<id>/<field>`` — bounded by the ``serve_models`` roster.
 
 Everything else — bare variables, ``+`` concatenation, ``%``/
 ``str.format``, unlisted f-strings — is flagged.  Names are data, not
@@ -53,6 +54,10 @@ _ALLOWED_SKELETONS = (
     # quality/audit/<field> — bounded by the fixed shadow-audit stat set
     # (rows/mismatches/pending/dropped)
     re.compile(r"^quality/audit/\*$"),
+    # model/<id>/<field> — bounded by the serve_models roster (config,
+    # max 64-char validated ids), never by traffic: per-tenant cache
+    # events (evictions/readmissions) of the multi-model registry
+    re.compile(r"^model/\*/[a-z0-9_]+$"),
 )
 
 

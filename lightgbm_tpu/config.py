@@ -208,6 +208,12 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "serve_heartbeat": ["serve_heartbeat_file"],
     "serve_binary_port": ["binary_port", "serve_wire_port"],
     "serve_binary_accept_threads": ["binary_accept_threads"],
+    "serve_models": ["model_roster", "serve_model_roster"],
+    "serve_hbm_budget_mb": ["hbm_budget_mb", "serve_cache_budget_mb"],
+    "serve_default_model": ["default_model_id"],
+    "serve_explain_max_batch": ["explain_max_batch"],
+    "serve_explain_queue_size": ["explain_queue_size"],
+    "serve_explain_max_delay_ms": ["explain_max_delay_ms"],
     "serve_replicas": ["num_replicas", "serve_num_replicas"],
     "serve_fleet_mode": ["fleet_mode"],
     "serve_fleet_dir": ["fleet_dir"],
@@ -239,6 +245,7 @@ _PARAM_ALIASES: Dict[str, List[str]] = {
     "pipeline_observe_s": ["observe_window_s"],
     "pipeline_observe_poll_s": [],
     "pipeline_promote": [],
+    "pipeline_model_id": ["model_id"],
     # --- telemetry (docs/OBSERVABILITY.md) ---
     "telemetry": ["enable_telemetry"],
     "telemetry_out": ["telemetry_output", "metrics_out"],
@@ -653,6 +660,31 @@ class Config:
     # multi-accept front: connection setup never serializes behind one
     # thread)
     serve_binary_accept_threads: int = 2
+    # multi-tenant serving roster "id=path[,id=path...]" ("" = single
+    # model from input_model): every id becomes an HBM-resident tenant
+    # behind /predict model_id routing, the wire v2 model field and
+    # per-model SLO/drift isolation (docs/SERVING.md "Multi-tenant
+    # serving")
+    serve_models: str = ""
+    # HBM byte budget (MiB) for the multi-tenant model cache: resident
+    # device arrays beyond it are LRU-evicted (compiled programs stay;
+    # readmission re-verifies the manifest and recompiles nothing);
+    # 0 = unlimited
+    serve_hbm_budget_mb: float = 0.0
+    # which roster id answers requests that carry no model_id ("" = the
+    # first entry of serve_models)
+    serve_default_model: str = ""
+    # /explain micro-batcher lane: max coalesced rows per SHAP dispatch
+    # (contributions are k*(n_features+1) values per row — much heavier
+    # than predictions, so the lane defaults far smaller)
+    serve_explain_max_batch: int = 16
+    # /explain admission control: queue depth beyond which explain
+    # requests shed with a structured 503 (its own lane — explain
+    # overload never sheds /predict traffic)
+    serve_explain_queue_size: int = 64
+    # /explain micro-batcher: max milliseconds an explain request waits
+    # for batch-mates
+    serve_explain_max_delay_ms: float = 2.0
     # replica fleet size for task=serve; > 1 runs the fleet supervisor
     # (N replica processes + restart-with-backoff + fleet-wide promotion,
     # docs/SERVING.md "Fleet architecture") instead of one process
@@ -758,6 +790,11 @@ class Config:
     # write the promotion pointer on gate pass (false = dry run: train,
     # refit and gate the candidate but leave the fleet untouched)
     pipeline_promote: bool = True
+    # multi-tenant promotion keying: the roster model_id this pipeline
+    # run refits/gates/promotes — generations advance per (model_id,
+    # generation) so promoting one tenant leaves its siblings' pointers
+    # (and served bytes) untouched; "" = the fleet's default pointer
+    pipeline_model_id: str = ""
 
     # --- telemetry (docs/OBSERVABILITY.md) ---
     # master switch: span tracer + metrics registry + per-iteration records
@@ -898,6 +935,32 @@ class Config:
         if self.serve_slo_burn <= 0:
             raise LightGBMError(
                 f"serve_slo_burn={self.serve_slo_burn} must be > 0")
+        if self.serve_models:
+            # fail at config time, not at first routed request: the
+            # roster grammar is id=path[,id=path...]
+            from .serving.multimodel import parse_model_roster
+            roster = parse_model_roster(self.serve_models)
+            if self.serve_default_model and \
+                    self.serve_default_model not in roster:
+                raise LightGBMError(
+                    f"serve_default_model={self.serve_default_model!r} "
+                    "is not an id in serve_models")
+        if self.serve_hbm_budget_mb < 0:
+            raise LightGBMError(
+                f"serve_hbm_budget_mb={self.serve_hbm_budget_mb} must be "
+                ">= 0 (0 = unlimited)")
+        if self.serve_explain_max_batch < 1:
+            raise LightGBMError(
+                f"serve_explain_max_batch={self.serve_explain_max_batch} "
+                "must be >= 1")
+        if self.serve_explain_queue_size < 1:
+            raise LightGBMError(
+                f"serve_explain_queue_size="
+                f"{self.serve_explain_queue_size} must be >= 1")
+        if self.serve_explain_max_delay_ms < 0:
+            raise LightGBMError(
+                f"serve_explain_max_delay_ms="
+                f"{self.serve_explain_max_delay_ms} must be >= 0")
         if not 0.0 <= self.quality_sample <= 1.0:
             raise LightGBMError(
                 f"quality_sample={self.quality_sample} must be a "

@@ -81,10 +81,24 @@ def _http(host: str, port: int, method: str, path: str, obj=None,
         return None
 
 
+def _tenant_state(state: Optional[Dict[str, Any]],
+                  model_id: str) -> Dict[str, Any]:
+    """The record carrying sha256/generation/alerts for the promotion's
+    target: the per-model entry of a multi-tenant replica's /ready when
+    ``model_id`` is set, the flat payload otherwise."""
+    if state is None:
+        return {}
+    if model_id:
+        return (state.get("models") or {}).get(model_id) or {}
+    return state
+
+
 def _wait_for_sha(fleet_dir: str, sha: str, generation: int,
-                  timeout_s: float) -> Dict[str, Any]:
+                  timeout_s: float, model_id: str = "") -> Dict[str, Any]:
     """Poll replica /ready until every reachable replica serves ``sha``
-    (and has processed ``generation``); returns the convergence record."""
+    (for ``model_id``'s tenant when set — siblings are not consulted);
+    returns the convergence record."""
+    sha_key = "sha256" if model_id else "model_sha256"
     deadline = time.monotonic() + timeout_s
     converged: Dict[int, bool] = {}
     reachable = 0
@@ -97,18 +111,22 @@ def _wait_for_sha(fleet_dir: str, sha: str, generation: int,
         states = {r: _http(h, p, "GET", "/ready") for r, h, p in eps}
         reachable = sum(1 for s in states.values() if s is not None)
         converged = {
-            r: (s is not None and str(s.get("model_sha256")) == sha
-                and int(s.get("seen_generation", 0)) >= 0)
+            r: (str(_tenant_state(s, model_id).get(sha_key)) == sha
+                and int(_tenant_state(s, model_id)
+                        .get("seen_generation", 0)) >= 0)
             for r, s in states.items()}
         if reachable and all(converged.values()):
             break
         if time.monotonic() > deadline:
             break
         time.sleep(0.1)
-    return {"generation": int(generation), "sha256": sha,
-            "reachable": reachable,
-            "converged": sorted(r for r, ok in converged.items() if ok),
-            "pending": sorted(r for r, ok in converged.items() if not ok)}
+    out = {"generation": int(generation), "sha256": sha,
+           "reachable": reachable,
+           "converged": sorted(r for r, ok in converged.items() if ok),
+           "pending": sorted(r for r, ok in converged.items() if not ok)}
+    if model_id:
+        out["model_id"] = model_id
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -278,7 +296,7 @@ def _stage_gate(params: Dict[str, Any], cfg: Config, cand: Booster,
 
 def _stage_promote(params: Dict[str, Any], cfg: Config, cand: Booster,
                    candidate_path: str, fleet_dir: str,
-                   report: Dict[str, Any]) -> bool:
+                   report: Dict[str, Any], model_id: str = "") -> bool:
     from . import telemetry
     from .robustness import chaos
     from .serving.fleet import promote_pointer, read_pointer
@@ -286,12 +304,13 @@ def _stage_promote(params: Dict[str, Any], cfg: Config, cand: Booster,
     # the chaos window the whole design exists for: gate passed, pointer
     # not yet written — a crash here must leave the fleet untouched
     chaos.maybe_kill_refit()
-    pointer = promote_pointer(fleet_dir, candidate_path)
+    pointer = promote_pointer(fleet_dir, candidate_path,
+                              model_id=model_id)
     gen, sha = int(pointer["generation"]), str(pointer["sha256"])
     # verify our own write: a torn pointer (chaos or a dying filesystem)
     # reads back as None/garbage and must be reported as a FAILED
     # promotion, not waited on
-    back = read_pointer(fleet_dir)
+    back = read_pointer(fleet_dir, model_id)
     if back is None or int(back.get("generation", -1)) != gen \
             or str(back.get("sha256")) != sha:
         report["promote"] = {"generation": gen, "sha256": sha,
@@ -301,11 +320,14 @@ def _stage_promote(params: Dict[str, Any], cfg: Config, cand: Booster,
                     "keeps its old generation")
         return False
     telemetry.instant("pipeline:promote", generation=gen, sha256=sha,
-                      path=candidate_path)
+                      path=candidate_path, model_id=model_id or "")
     telemetry.inc("pipeline/promotions")
-    conv = _wait_for_sha(fleet_dir, sha, gen, _PROMOTE_WAIT_S)
+    conv = _wait_for_sha(fleet_dir, sha, gen, _PROMOTE_WAIT_S,
+                         model_id=model_id)
     report["promote"] = {"generation": gen, "sha256": sha,
                          "convergence": conv}
+    if model_id:
+        report["promote"]["model_id"] = model_id
 
     # train-vs-serve drift stamp: the served scores of a probe batch must
     # be bitwise Booster.predict of the PROMOTED ARTIFACT — reloaded from
@@ -318,9 +340,11 @@ def _stage_promote(params: Dict[str, Any], cfg: Config, cand: Booster,
         eps = _replica_endpoints(fleet_dir)
         drift = None
         mis_versioned = 0
+        body: Dict[str, Any] = {"rows": probe.tolist()}
+        if model_id:
+            body["model_id"] = model_id
         for r, h, p in eps:
-            resp = _http(h, p, "POST", "/predict",
-                         {"rows": probe.tolist()}, timeout=10.0)
+            resp = _http(h, p, "POST", "/predict", body, timeout=10.0)
             if resp is None or "predictions" not in resp:
                 continue
             if str(resp.get("model_sha256")) != sha:
@@ -356,10 +380,13 @@ def _probe_rows(params: Dict[str, Any],
 
 
 def _stage_observe(cfg: Config, fleet_dir: str,
-                   report: Dict[str, Any]) -> None:
+                   report: Dict[str, Any], model_id: str = "") -> None:
     """Post-promotion rollback watcher: any replica reporting an SLO burn
     or a drift alert inside the observation window reverts the fleet to
-    the prior generation — no operator in the loop."""
+    the prior generation — no operator in the loop.  When the promotion
+    targeted one tenant, only THAT tenant's per-model alerts are watched
+    and only its pointer is rolled back: a sibling's burn neither blames
+    nor reverts this promotion."""
     from . import telemetry
     from .serving.fleet import read_pointer, rollback_pointer
 
@@ -374,21 +401,26 @@ def _stage_observe(cfg: Config, fleet_dir: str,
     while time.monotonic() < deadline:
         for r, h, p in _replica_endpoints(fleet_dir):
             st = _http(h, p, "GET", "/ready")
-            if st is None:
+            rec = _tenant_state(st, model_id)
+            if not rec:
                 continue
             reasons = []
-            if st.get("slo_alert"):
+            if rec.get("slo_alert"):
                 reasons.append("slo_burn")
-            if st.get("drift_alert"):
+            if rec.get("drift_alert"):
                 reasons.append("drift_alert")
             if reasons:
                 why = "+".join(reasons) + f" on replica {r}"
+                if model_id:
+                    why += f" (model {model_id})"
                 telemetry.instant("pipeline:observe_burn", replica=r,
-                                  reasons=",".join(reasons))
-                pointer = rollback_pointer(fleet_dir, reason=why)
+                                  reasons=",".join(reasons),
+                                  model_id=model_id or "")
+                pointer = rollback_pointer(fleet_dir, reason=why,
+                                           model_id=model_id)
                 conv = _wait_for_sha(fleet_dir, str(pointer["sha256"]),
                                      int(pointer["generation"]),
-                                     _PROMOTE_WAIT_S)
+                                     _PROMOTE_WAIT_S, model_id=model_id)
                 obs.update({"burned": True, "reason": why,
                             "rollback": {
                                 "generation": int(pointer["generation"]),
@@ -398,8 +430,10 @@ def _stage_observe(cfg: Config, fleet_dir: str,
                 return
         time.sleep(poll)
     obs["healthy"] = True
+    cur = read_pointer(fleet_dir, model_id)
     log_info(f"pipeline: observation window ({window:.1f}s) passed clean; "
-             f"generation {read_pointer(fleet_dir)['generation'] if read_pointer(fleet_dir) else '?'} stands")
+             f"generation {cur['generation'] if cur else '?'} stands"
+             + (f" (model {model_id})" if model_id else ""))
 
 
 # ---------------------------------------------------------------------------
@@ -416,17 +450,24 @@ def run_pipeline(params: Dict[str, Any]) -> Dict[str, Any]:
         telemetry.configure(enabled=True)
     out_model = str(params.get("output_model", "LightGBM_model.txt"))
     fleet_dir = str(params.get("serve_fleet_dir", "") or "")
+    # multi-tenant keying: pipeline_model_id targets ONE tenant's
+    # promote_<id>.json — its generation counter, its candidate naming,
+    # its rollback; sibling tenants keep serving their bytes untouched
+    mid = str(cfg.pipeline_model_id or "")
     # generation-unique candidate path: a later pipeline run (even one
     # that fails its gate) must never overwrite the model file the
     # fleet's pointer currently targets
+    tag = f".{mid}" if mid else ""
     if fleet_dir:
         from .serving.fleet import _current_generation
-        candidate_path = (
-            f"{out_model}.candidate_gen{_current_generation(fleet_dir) + 1}")
+        candidate_path = (f"{out_model}{tag}.candidate_gen"
+                          f"{_current_generation(fleet_dir, mid) + 1}")
     else:
-        candidate_path = out_model + ".candidate"
+        candidate_path = out_model + tag + ".candidate"
     report: Dict[str, Any] = {"ok": False, "candidate": candidate_path,
                               "fleet_dir": fleet_dir}
+    if mid:
+        report["model_id"] = mid
 
     with telemetry.global_tracer.span("pipeline/train"):
         base_bst, base_ds, base_path = _stage_train(params, cfg, out_model)
@@ -442,7 +483,7 @@ def run_pipeline(params: Dict[str, Any]) -> Dict[str, Any]:
         baseline = base_path
         if fleet_dir:
             from .serving.fleet import read_pointer
-            p = read_pointer(fleet_dir)
+            p = read_pointer(fleet_dir, mid)
             if p and os.path.exists(str(p["path"])):
                 baseline = str(p["path"])
         gate_ok = _stage_gate(params, cfg, cand, candidate_path, baseline,
@@ -465,13 +506,13 @@ def run_pipeline(params: Dict[str, Any]) -> Dict[str, Any]:
 
     with telemetry.global_tracer.span("pipeline/promote"):
         promoted = _stage_promote(params, cfg, cand, candidate_path,
-                                  fleet_dir, report)
+                                  fleet_dir, report, model_id=mid)
     if not promoted:
         _finish(params, report)
         return report
 
     with telemetry.global_tracer.span("pipeline/observe"):
-        _stage_observe(cfg, fleet_dir, report)
+        _stage_observe(cfg, fleet_dir, report, model_id=mid)
 
     report["ok"] = True
     _finish(params, report)

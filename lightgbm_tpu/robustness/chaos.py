@@ -238,15 +238,18 @@ def maybe_kill_refit() -> None:
             os._exit(137)
 
 
-def maybe_tear_pointer(fleet_dir: str, pointer_text: str) -> bool:
-    """Replace the atomic promote.json write with a NON-atomic truncated
-    write (first half of the JSON) — simulates a promoter dying mid-write
-    on a filesystem without atomic rename.  Replicas must treat the torn
-    pointer as unreadable and keep serving.  Returns True when fired (the
-    caller must then skip its own pointer write)."""
+def maybe_tear_pointer(fleet_dir: str, pointer_text: str,
+                       name: str = "promote.json") -> bool:
+    """Replace the atomic promotion-pointer write with a NON-atomic
+    truncated write (first half of the JSON) — simulates a promoter dying
+    mid-write on a filesystem without atomic rename.  ``name`` selects
+    the pointer file (per-tenant pointers are ``promote_<id>.json``).
+    Replicas must treat the torn pointer as unreadable and keep serving.
+    Returns True when fired (the caller must then skip its own pointer
+    write)."""
     for d in directives():
         if _matches(d, "torn_pointer", None) and _fire_once(d):
-            path = os.path.join(fleet_dir, "promote.json")
+            path = os.path.join(fleet_dir, name)
             torn = pointer_text[:max(len(pointer_text) // 2, 1)]
             with open(path, "w") as fh:
                 fh.write(torn)

@@ -18,26 +18,37 @@ Four layers on top of the trained-model stack:
     ``task=serve``;
   * :mod:`.fleet` + :mod:`.front` — the replica-pool supervisor
     (restart-with-backoff, heartbeat liveness, shared-directory
-    fleet-wide promotion) and the fanout front (deadline/retry/backoff,
-    per-replica circuit breaker, load shedding); ``serve_replicas > 1``
-    serves through the fleet.
+    fleet-wide promotion keyed ``(model_id, generation)``) and the
+    fanout front (deadline/retry/backoff, per-replica circuit breaker,
+    load shedding); ``serve_replicas > 1`` serves through the fleet;
+  * :mod:`.multimodel` — the HBM-resident multi-model cache behind
+    ``serve_models``: byte-accounted LRU residency, per-tenant
+    registries, and stacked dispatch of same-shape tenants through ONE
+    compiled ``serve_predict_multi`` program (docs/SERVING.md
+    "Multi-tenant serving").
 """
 from .batcher import DeadlineError, MicroBatcher, OverloadError, PredictResult
-from .compiled import CompiledPredictor, bucket_ladder
+from .compiled import (CompiledPredictor, bucket_ladder, raw_scores_stacked,
+                       shape_envelope)
 from .front import CircuitBreaker, FanoutFront
 from .fleet import ServingFleet, run_fleet
+from .multimodel import MultiModelRegistry, parse_model_roster
 from .registry import ModelRegistry, ServingModel
 from .server import (ServingApp, reuseport_available, run_server,
                      serve_from_params)
 from .slo import SLOMonitor
-from .wire import BinaryClient, BinaryServer, FleetBinaryClient, WireError
+from .wire import (OP_EXPLAIN, OP_PREDICT, BinaryClient, BinaryServer,
+                   FleetBinaryClient, WireError)
 
 __all__ = [
-    "CompiledPredictor", "bucket_ladder",
+    "CompiledPredictor", "bucket_ladder", "shape_envelope",
+    "raw_scores_stacked",
     "ModelRegistry", "ServingModel",
+    "MultiModelRegistry", "parse_model_roster",
     "MicroBatcher", "OverloadError", "DeadlineError", "PredictResult",
     "ServingApp", "run_server", "serve_from_params",
     "ServingFleet", "run_fleet", "FanoutFront", "CircuitBreaker",
     "SLOMonitor", "reuseport_available",
     "BinaryServer", "BinaryClient", "FleetBinaryClient", "WireError",
+    "OP_PREDICT", "OP_EXPLAIN",
 ]

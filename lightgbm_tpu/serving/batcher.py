@@ -93,12 +93,17 @@ class PredictResult:
     model_version: int       # the version that actually scored it
     batched_rows: int        # total rows of the coalesced dispatch
     queue_wait_s: float      # enqueue -> dispatch latency
+    model_id: str = ""       # multi-tenant routing key ("" single-model)
+    sha256: str = ""         # exact bytes that scored this request
 
 
 @dataclass
 class _Request:
     rows: np.ndarray
     raw_score: bool
+    model: Optional[ServingModel] = None  # pinned at submit: an eviction
+    #                                       or hot-swap mid-flight drains
+    #                                       on this old reference
     deadline: Optional[float] = None      # absolute time.perf_counter point
     trace: Any = None                     # telemetry.TraceContext or None
     future: Future = field(default_factory=Future)
@@ -125,12 +130,21 @@ class MicroBatcher:
 
     def __init__(self, registry: ModelRegistry, *, max_batch: int = 256,
                  max_delay_ms: float = 2.0, queue_size: int = 512,
-                 heartbeat_path: str = ""):
+                 heartbeat_path: str = "", mode: str = "predict"):
+        if mode not in ("predict", "explain"):
+            raise LightGBMError(f"batcher mode {mode!r} must be predict "
+                                "or explain")
         self.registry = registry
+        self.mode = mode
         self.max_batch = max(int(max_batch), 1)
         self.max_delay_s = max(float(max_delay_ms), 0.0) / 1e3
         self.queue_size = max(int(queue_size), 1)
         self.heartbeat_path = str(heartbeat_path or "")
+        # explain lane: SHAP dispatches pad to their OWN bucket ladder so
+        # the device contribution kernel sees shape-stable batches
+        from .compiled import bucket_ladder
+        self._explain_buckets = (bucket_ladder(self.max_batch)
+                                 if mode == "explain" else None)
         self._q: "queue.Queue[_Request]" = queue.Queue(self.queue_size)
         self._stop = threading.Event()
         # serializes enqueue against stop(): _stop is SET under this lock
@@ -143,8 +157,11 @@ class MicroBatcher:
         self._worker: Optional[threading.Thread] = None
         # optional QualityMonitor (set by ServingApp): drift accumulation
         # + shadow-audit capture on the dispatch path, both behind their
-        # own sampling draws — None keeps the hot path untouched
+        # own sampling draws — None keeps the hot path untouched.  A
+        # multi-tenant app sets quality_lookup (model_id -> monitor) so
+        # each tenant accumulates into ITS OWN drift window.
         self.quality = None
+        self.quality_lookup = None
         self.batches = 0
         self.served = 0
         self.rejected = 0
@@ -199,15 +216,20 @@ class MicroBatcher:
     def submit(self, rows, raw_score: bool = False,
                fast: bool = False,
                deadline: Optional[float] = None,
-               trace=None) -> "Future[PredictResult]":
+               trace=None,
+               model_id: Optional[str] = None) -> "Future[PredictResult]":
         """Enqueue one request; returns a Future resolving to
         :class:`PredictResult`.  Raises :class:`OverloadError` at once
         when the queue is full (or ``deadline`` — an absolute
         ``time.perf_counter`` point — has already passed),
-        :class:`LightGBMError` on shape errors."""
+        :class:`LightGBMError` on shape errors or an unknown
+        ``model_id``.  The resolved model is PINNED into the request: a
+        hot-swap or LRU eviction mid-flight drains on the old
+        reference."""
         from .. import telemetry
 
-        model = self.registry.current()
+        model = self.registry.current(model_id) if model_id \
+            else self.registry.current()
         X = model.validate_rows(rows)
         if self._stop.is_set():
             raise OverloadError(self._q.qsize(), self.queue_size,
@@ -219,7 +241,7 @@ class MicroBatcher:
                 self.expired += 1
             telemetry.inc("serve/deadline_expired")
             raise DeadlineError(self._q.qsize(), self.queue_size)
-        if fast and X.shape[0] == 1:
+        if fast and X.shape[0] == 1 and self.mode == "predict":
             # latency-critical singleton: pre-bound native walk, caller
             # thread, zero queueing — still version-stamped
             t0 = time.perf_counter()
@@ -232,10 +254,11 @@ class MicroBatcher:
             with self._submit_lock:
                 self.served += 1
             fut: "Future[PredictResult]" = Future()
-            fut.set_result(PredictResult(values, model.version, 1, 0.0))
+            fut.set_result(PredictResult(values, model.version, 1, 0.0,
+                                         model.model_id, model.sha256))
             return fut
         req = _Request(np.ascontiguousarray(X), bool(raw_score),
-                       deadline=deadline, trace=trace)
+                       model=model, deadline=deadline, trace=trace)
         with self._submit_lock:
             if self._stop.is_set():
                 raise OverloadError(self._q.qsize(), self.queue_size,
@@ -297,6 +320,35 @@ class MicroBatcher:
                 break
         return batch
 
+    def _quality_for(self, model: ServingModel):
+        if self.quality_lookup is not None:
+            return self.quality_lookup(model.model_id)
+        return self.quality
+
+    def _dispatch(self, jobs) -> List[np.ndarray]:
+        """Score every (model, rows) job of one window.  Predict mode
+        routes multi-tenant windows through the registry's grouped
+        (model-axis-stacked) dispatch when it has one; explain mode pads
+        each job to the lane's own bucket ladder for the SHAP kernel."""
+        if self.mode == "explain":
+            outs = []
+            for model, X in jobs:
+                m = X.shape[0]
+                b = next((b for b in self._explain_buckets if m <= b),
+                         self._explain_buckets[-1])
+                if m < b:
+                    Xp = np.zeros((b, X.shape[1]), np.float64)
+                    Xp[:m] = X
+                else:
+                    Xp = X
+                outs.append(model.explain_raw(Xp)[:m])
+            return outs
+        if len(jobs) > 1:
+            grouped = getattr(self.registry, "raw_scores_grouped", None)
+            if grouped is not None:
+                return grouped(jobs)
+        return [model.raw_scores(X) for model, X in jobs]
+
     def _process(self, batch: List[_Request]) -> None:
         from .. import telemetry
 
@@ -306,17 +358,28 @@ class MicroBatcher:
         batch = [r for r in batch if not self._expire(r)]
         if not batch:
             return
-        model = self.registry.current()   # pinned for the WHOLE batch
-        good = [r for r in batch
-                if r.rows.shape[1] == model.num_features]
+        # group by the model PINNED at submit time: a hot-swap or LRU
+        # eviction mid-flight drains on the old reference, and a
+        # multi-tenant window carries several models at once
+        order: List[ServingModel] = []
+        by_model: Dict[int, List[_Request]] = {}
         for r in batch:
-            if r.rows.shape[1] != model.num_features:
+            if r.model is None:     # legacy direct caller: pin per batch
+                r.model = self.registry.current()
+            if r.rows.shape[1] != r.model.num_features:
                 # the model was hot-swapped to a different feature count
                 # between submit-time validation and dispatch
                 r.resolve(error=LightGBMError(
-                    f"model v{model.version} expects "
-                    f"{model.num_features} features, request has "
+                    f"model v{r.model.version} expects "
+                    f"{r.model.num_features} features, request has "
                     f"{r.rows.shape[1]}"))
+                continue
+            key = id(r.model)
+            if key not in by_model:
+                by_model[key] = []
+                order.append(r.model)
+            by_model[key].append(r)
+        good = [r for m in order for r in by_model[id(m)]]
         if not good:
             return
         t0 = time.perf_counter()
@@ -329,57 +392,76 @@ class MicroBatcher:
             telemetry.request_complete(
                 r.trace, "serve/queue_wait", r.t_enqueue,
                 t0 - r.t_enqueue, rows=int(r.rows.shape[0]))
-        X = (good[0].rows if len(good) == 1
-             else np.concatenate([r.rows for r in good], axis=0))
-        n = X.shape[0]
+        jobs = []
+        for model in order:
+            reqs = by_model[id(model)]
+            jobs.append((model, reqs[0].rows if len(reqs) == 1
+                         else np.concatenate([r.rows for r in reqs],
+                                             axis=0)))
+        n = sum(x.shape[0] for _, x in jobs)
         dispatch_span = (telemetry.span("serve/dispatch", rows=n,
                                         requests=len(good),
+                                        models=len(jobs),
                                         trace_ids=sampled)
                          if sampled else _NULL_DISPATCH)
         with dispatch_span:
-            if n == 1 and len(good) == 1:
+            if (self.mode == "predict" and n == 1 and len(good) == 1):
                 # a lone singleton skips the device: native single-row walk
                 # (raw_scores has the pre-bound n==1 path — this is the
                 # model.predict code path with submit-time validation)
-                raw = model.raw_scores(good[0].rows)
+                raws = [jobs[0][0].raw_scores(jobs[0][1])]
             else:
                 with (telemetry.span("serve/device", rows=n,
                                      trace_ids=sampled)
                       if sampled else _NULL_DISPATCH):
-                    raw = model.raw_scores(X)
-            off = 0
-            for r in good:
-                m = r.rows.shape[0]
-                r.resolve(PredictResult(
-                    model.finish(raw[off:off + m], r.raw_score),
-                    model.version, n, t0 - r.t_enqueue))
-                off += m
-        q = self.quality
-        if q is not None:
-            # drift accumulation + shadow-audit capture; each call does
-            # its own sampling draw, and neither may ever break serving
-            try:
+                    raws = self._dispatch(jobs)
+            for (model, _), raw in zip(jobs, raws):
                 off = 0
-                for r in good:
+                for r in by_model[id(model)]:
                     m = r.rows.shape[0]
-                    q.offer_audit(model, r.rows, raw[off:off + m],
-                                  r.raw_score,
-                                  r.trace.trace_id if r.trace is not None
-                                  else None)
+                    values = (raw[off:off + m] if self.mode == "explain"
+                              else model.finish(raw[off:off + m],
+                                                r.raw_score))
+                    r.resolve(PredictResult(
+                        values, model.version, n, t0 - r.t_enqueue,
+                        model.model_id, model.sha256))
                     off += m
-                q.observe_batch(model, X, raw)
-            except Exception as e:   # noqa: BLE001
-                log_debug(f"serve quality hook failed: {e}")
+        if self.mode == "predict":
+            for (model, Xm), raw in zip(jobs, raws):
+                q = self._quality_for(model)
+                if q is None:
+                    continue
+                # drift accumulation + shadow-audit capture; each call
+                # does its own sampling draw, and neither may ever break
+                # serving
+                try:
+                    off = 0
+                    for r in by_model[id(model)]:
+                        m = r.rows.shape[0]
+                        q.offer_audit(model, r.rows, raw[off:off + m],
+                                      r.raw_score,
+                                      r.trace.trace_id
+                                      if r.trace is not None else None)
+                        off += m
+                    q.observe_batch(model, Xm, raw)
+                except Exception as e:   # noqa: BLE001
+                    log_debug(f"serve quality hook failed: {e}")
         dt = time.perf_counter() - t0
         with self._submit_lock:
             self.batches += 1
             self.served += len(good)
             # EWMA feeds the Retry-After estimate for shed responses
             self._dispatch_ewma = 0.8 * self._dispatch_ewma + 0.2 * dt
-        telemetry.inc("serve/requests", len(good))
-        telemetry.inc("serve/rows", n)
-        telemetry.inc("serve/batches")
-        telemetry.observe("serve/dispatch_s", dt)
+        if self.mode == "explain":
+            telemetry.inc("serve/explain/requests", len(good))
+            telemetry.inc("serve/explain/rows", n)
+            telemetry.inc("serve/explain/batches")
+            telemetry.observe("serve/explain/dispatch_s", dt)
+        else:
+            telemetry.inc("serve/requests", len(good))
+            telemetry.inc("serve/rows", n)
+            telemetry.inc("serve/batches")
+            telemetry.observe("serve/dispatch_s", dt)
         telemetry.observe("serve/batch_rows", float(n),
                           bounds=DEPTH_BOUNDS)
         for r in good:

@@ -226,8 +226,28 @@ def _score_impl(pack: PackedServingTrees, leaf_values, keys_hi, keys_lo,
                              jnp.zeros((n, k), jnp.float64))
 
 
+def _score_multi_impl(pack: PackedServingTrees, leaf_values, keys_hi,
+                      keys_lo, nan_mask, iv, max_depth: int, num_class: int):
+    """Model-axis-stacked scoring: every argument carries a leading model
+    axis G and slot ``g`` is scored with slot ``g``'s pack — a vmap of
+    ``_score_impl``, so per slot the walk and the float64 accumulation
+    are the IDENTICAL element-wise IEEE-754 op sequence as the
+    single-model program (bitwise equal to each member's own
+    ``Booster.predict``).  One dispatch serves a whole multi-tenant
+    micro-batch window with zero cross-model launches."""
+    import jax
+
+    def one(p, lv, kh, kl, nm, i):
+        return _score_impl(PackedServingTrees(*p), lv, kh, kl, nm, i,
+                           max_depth, num_class)
+
+    return jax.vmap(one)(tuple(pack), leaf_values, keys_hi, keys_lo,
+                         nan_mask, iv)
+
+
 _serve_walk = None    # lazily-built watched_jits (import must stay jax-free)
 _serve_score = None
+_serve_score_multi = None
 
 
 def _get_walk():
@@ -255,6 +275,20 @@ def _get_score():
                                    static_argnames=("max_depth",
                                                     "num_class"))
     return _serve_score
+
+
+def _get_score_multi():
+    global _serve_score_multi
+    if _serve_score_multi is None:
+        from ..telemetry import watched_jit
+        # the multi-tenant hot path: same program vmapped over a model
+        # axis; model-count/bucket ladders legitimately re-specialize
+        _serve_score_multi = watched_jit(_score_multi_impl,
+                                         name="serve_predict_multi",
+                                         warn_after=0,
+                                         static_argnames=("max_depth",
+                                                          "num_class"))
+    return _serve_score_multi
 
 
 def bucket_ladder(max_batch: int, spec: str = "",
@@ -289,7 +323,8 @@ class CompiledPredictor:
     backends (host accumulation fallback)."""
 
     def __init__(self, trees: Sequence, num_class: int, num_features: int,
-                 max_batch: int = 256, buckets: Optional[Sequence[int]] = None):
+                 max_batch: int = 256, buckets: Optional[Sequence[int]] = None,
+                 envelope: Optional[Tuple[int, int, int, int]] = None):
         for t in trees:
             if getattr(t, "is_linear", False):
                 # linear leaves need raw-feature dot products in float64 —
@@ -304,7 +339,16 @@ class CompiledPredictor:
         self._leaf_values = [np.asarray(t.leaf_value, np.float64)
                              for t in trees]
         nt = len(trees)
-        M = max(max((t.num_leaves - 1 for t in trees), default=0), 1)
+        # envelope = (leaves-1, cat rows, cat words, depth) MINIMUMS: pad
+        # the pack out to a shared rounded shape (shape_envelope) so
+        # same-family models of a multi-tenant cache land on identical
+        # traced shapes and reuse ONE compiled serve_predict program.
+        # Padding only widens never-visited node/bitset slots and no-op
+        # walk iterations (a settled leaf is inactive), so scores are
+        # bit-identical to the unpadded pack.
+        env_m, env_c, env_w, env_d = (int(x) for x in envelope) \
+            if envelope is not None else (0, 0, 0, 0)
+        M = max(max((t.num_leaves - 1 for t in trees), default=0), 1, env_m)
 
         sf = np.zeros((nt, M), np.int32)
         thr = np.zeros((nt, M), np.float64)
@@ -331,14 +375,19 @@ class CompiledPredictor:
                 s, e = int(t.cat_boundaries[k]), int(t.cat_boundaries[k + 1])
                 co[ti, i] = len(cat_rows)
                 cat_rows.append(np.asarray(t.cat_threshold[s:e], np.uint32))
-        self.max_depth = int(maxd)
-        W = max((len(r) for r in cat_rows), default=1)
-        cw = np.zeros((max(len(cat_rows), 1), W), np.uint32)
+        self.max_depth = max(int(maxd), env_d)
+        W = max([1, env_w] + [len(r) for r in cat_rows])
+        cw = np.zeros((max(len(cat_rows), 1, env_c), W), np.uint32)
         for ri, r in enumerate(cat_rows):
             cw[ri, :len(r)] = r
 
         import jax.numpy as jnp
         thi, tlo = _split_key(_key64(thr))
+        # host copies kept only in envelope (multi-tenant) mode — the
+        # stacked serve_predict_multi dispatch stacks them per call
+        self._host_pack = (sf, thi, tlo, dt, lc, rc, co, cw) \
+            if envelope is not None else None
+        self._host_lv = None
         self._pack = PackedServingTrees(
             split_feature=jnp.asarray(sf), thr_hi=jnp.asarray(thi),
             thr_lo=jnp.asarray(tlo), decision_type=jnp.asarray(dt),
@@ -355,6 +404,8 @@ class CompiledPredictor:
             for ti, t in enumerate(trees):
                 nlv = min(t.num_leaves, M + 1)
                 lvt[ti, :nlv] = np.asarray(t.leaf_value[:nlv], np.float64)
+            if envelope is not None:
+                self._host_lv = lvt
             with _x64_scope():
                 self._lv_dev = jnp.asarray(lvt)
         # pinned per-bucket pad buffers: one (bucket, F) set per bucket,
@@ -364,6 +415,27 @@ class CompiledPredictor:
         # serialize on this lock rather than corrupt each other's pads)
         self._buf_lock = threading.Lock()
         self._pads: Dict[int, Tuple[np.ndarray, ...]] = {}
+
+    @property
+    def shape_signature(self) -> Tuple:
+        """Everything a traced serve_predict program specializes on:
+        models with equal signatures share compiled programs (and may be
+        dispatched together by ``raw_scores_stacked``)."""
+        T, M = self._pack.split_feature.shape
+        C, W = self._pack.cat_words.shape
+        return (int(T), int(M), int(C), int(W), self.max_depth,
+                self.num_class, self.num_features, bool(self.device_accum),
+                tuple(self.buckets))
+
+    def device_bytes(self) -> int:
+        """Bytes of device residency this model pins (pack + f64 leaf
+        table) — the multi-tenant cache's HBM accounting unit."""
+        n = 0
+        for a in self._pack:
+            n += int(np.prod(a.shape)) * int(np.dtype(a.dtype).itemsize)
+        if self._lv_dev is not None:
+            n += int(np.prod(self._lv_dev.shape)) * 8
+        return n
 
     # -- host-side row encoding -------------------------------------------
     def _encode(self, X: np.ndarray):
@@ -479,3 +551,90 @@ class CompiledPredictor:
         for b in self.buckets:
             self.raw_scores(np.zeros((b, self.num_features), np.float64))
         return len(self.buckets)
+
+
+def shape_envelope(trees: Sequence) -> Tuple[int, int, int, int]:
+    """Deterministic rounded-up pack minimums (leaves-1, cat rows, cat
+    words, depth) for :class:`CompiledPredictor`'s ``envelope`` argument.
+    Same-family models (same feature count / class count / tree count /
+    similar size) round to the SAME envelope without any cross-model
+    coordination, so every member of a multi-tenant cache group shares
+    one compiled program per bucket — zero cross-model recompile churn."""
+    from ..pallas.predict_kernel import tree_max_depth
+    m = c = w = 0
+    d = 1
+    for t in trees:
+        ni = max(t.num_leaves - 1, 0)
+        m = max(m, ni)
+        if ni == 0:
+            continue
+        d = max(d, tree_max_depth(t))
+        dts = np.asarray(t.decision_type[:ni], np.uint8)
+        for i in np.nonzero(dts & 1)[0]:
+            k = int(t.threshold_bin[i])
+            c += 1
+            w = max(w, int(t.cat_boundaries[k + 1])
+                    - int(t.cat_boundaries[k]))
+
+    def up(v: int, step: int) -> int:
+        return max(step, ((int(v) + step - 1) // step) * step)
+
+    return (up(m, 16), up(c, 8), up(w, 4), up(d, 4))
+
+
+def raw_scores_stacked(preds: Sequence["CompiledPredictor"],
+                       X_list: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Score several SAME-SHAPE models in ONE ``serve_predict_multi``
+    dispatch: member ``g``'s pack and rows ride slot ``g`` of a
+    model-axis stack (models padded to a power-of-two slot count, rows
+    padded to a shared bucket).  Returns per-member float64 raw scores,
+    bitwise equal to each member's own :meth:`raw_scores`.  Requires
+    every member built with the same ``envelope`` (identical
+    ``shape_signature``) and device accumulation."""
+    if len(preds) != len(X_list) or not preds:
+        raise LightGBMError("raw_scores_stacked: one row block per model")
+    lead = preds[0]
+    sig = lead.shape_signature
+    for p in preds[1:]:
+        if p.shape_signature != sig:
+            raise LightGBMError("stacked dispatch requires identical "
+                                "pack shapes (same envelope group)")
+    if lead._lv_dev is None or any(p._host_pack is None for p in preds):
+        raise LightGBMError("stacked dispatch requires device "
+                            "accumulation and envelope packing")
+    rows = [np.ascontiguousarray(x, np.float64) for x in X_list]
+    m_max = max(x.shape[0] for x in rows)
+    if m_max > lead.buckets[-1]:
+        raise LightGBMError("stacked dispatch rows exceed the bucket "
+                            "ladder; use per-model raw_scores")
+    b = lead.bucket_for(max(m_max, 1))
+    g_pad = 1
+    while g_pad < len(preds):
+        g_pad *= 2
+    F = lead.num_features
+    khi = np.zeros((g_pad, b, F), np.uint32)
+    klo = np.zeros((g_pad, b, F), np.uint32)
+    nan = np.zeros((g_pad, b, F), bool)
+    iv = np.zeros((g_pad, b, F), np.int32)
+    for g, (p, x) in enumerate(zip(preds, rows)):
+        if x.shape[0] == 0:
+            continue
+        h, lo, nm, i32 = p._encode(x)
+        m = x.shape[0]
+        khi[g, :m], klo[g, :m], nan[g, :m], iv[g, :m] = h, lo, nm, i32
+    # pad slots replicate member 0's pack (their rows are zeros whose
+    # walk output is sliced away)
+    order = list(range(len(preds))) + [0] * (g_pad - len(preds))
+    import jax.numpy as jnp
+    stacked = [np.stack([preds[i]._host_pack[j] for i in order])
+               for j in range(8)]
+    lv = np.stack([preds[i]._host_lv for i in order])
+    score = _get_score_multi()
+    k = lead.num_class
+    with _x64_scope():
+        pack = PackedServingTrees(*(jnp.asarray(a) for a in stacked))
+        out = np.asarray(score(
+            pack, jnp.asarray(lv), jnp.asarray(khi), jnp.asarray(klo),
+            jnp.asarray(nan), jnp.asarray(iv),
+            max_depth=lead.max_depth, num_class=k))
+    return [out[g, :x.shape[0]] for g, x in enumerate(rows)]

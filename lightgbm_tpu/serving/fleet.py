@@ -36,6 +36,11 @@ State directory layout (``serve_fleet_dir``; a private tmpdir when
 unset)::
 
     promote.json       {"generation", "path", "sha256", "promoted_unix"}
+    promote_<id>.json  per-tenant pointer of a multi-tenant fleet —
+                       promotion is keyed (model_id, generation); one
+                       tenant's pointer advances without its siblings
+                       reloading anything (docs/SERVING.md "Multi-tenant
+                       serving")
     replica_<r>.json   {"rank", "host", "port", "pid", "started_unix"}
     hb_<r>             heartbeat file (mtime = liveness)
     replica_<r>.log    stdout/stderr of the replica process
@@ -45,6 +50,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
 import shutil
 import signal
 import subprocess
@@ -59,6 +65,23 @@ from ..robustness.heartbeat import heartbeat_age, write_heartbeat
 from ..utils.log import LightGBMError, log_debug, log_info, log_warning
 
 PROMOTE_NAME = "promote.json"
+_MID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def pointer_name(model_id: str = "") -> str:
+    """Pointer file for one tenant: the flat ``promote.json`` when
+    ``model_id`` is empty (single-model fleets; also the boot gate of a
+    multi-model fleet), ``promote_<id>.json`` per tenant otherwise —
+    promotion is keyed by ``(model_id, generation)`` so one tenant's
+    pointer advances without its siblings ever re-validating, reloading,
+    or recompiling anything."""
+    if not model_id:
+        return PROMOTE_NAME
+    if not _MID_RE.match(model_id):
+        raise LightGBMError(
+            f"model_id {model_id!r} is not a valid tenant id "
+            "(1-64 chars of [A-Za-z0-9._-])")
+    return f"promote_{model_id}.json"
 _BEAT_S = 0.25           # replica heartbeat-loop period (chaos beat unit)
 _SUPERVISE_S = 0.2       # supervisor poll period
 _RESTART_CAP_S = 30.0    # backoff ceiling
@@ -98,9 +121,10 @@ def validate_candidate(path: str) -> str:
     return sha
 
 
-def read_pointer(fleet_dir: str) -> Optional[Dict[str, Any]]:
+def read_pointer(fleet_dir: str,
+                 model_id: str = "") -> Optional[Dict[str, Any]]:
     try:
-        with open(os.path.join(fleet_dir, PROMOTE_NAME)) as fh:
+        with open(os.path.join(fleet_dir, pointer_name(model_id))) as fh:
             return json.load(fh)
     except (OSError, ValueError):
         return None
@@ -109,11 +133,16 @@ def read_pointer(fleet_dir: str) -> Optional[Dict[str, Any]]:
 HISTORY_NAME = "generations.jsonl"
 
 
-def generation_history(fleet_dir: str) -> List[Dict[str, Any]]:
+def generation_history(fleet_dir: str,
+                       model_id: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
     """Append-only promotion audit trail (one JSON line per pointer
-    write).  Survives a torn/corrupt ``promote.json``: the next promoter
-    recovers the generation counter from here instead of resetting to 1
-    (which the monotonicity guard would then refuse fleet-wide)."""
+    write, every tenant interleaved in promotion order).  Survives a
+    torn/corrupt pointer file: the next promoter recovers the generation
+    counter from here instead of resetting to 1 (which the monotonicity
+    guard would then refuse fleet-wide).  ``model_id=None`` returns the
+    full interleaved trail; ``""`` filters to the flat (single-model)
+    pointer's entries, a tenant id to that tenant's."""
     out: List[Dict[str, Any]] = []
     try:
         with open(os.path.join(fleet_dir, HISTORY_NAME)) as fh:
@@ -122,9 +151,12 @@ def generation_history(fleet_dir: str) -> List[Dict[str, Any]]:
                 if not line:
                     continue
                 try:
-                    out.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
                     continue   # torn final line of a killed writer
+                if model_id is None \
+                        or str(rec.get("model_id", "")) == model_id:
+                    out.append(rec)
     except OSError:
         pass
     return out
@@ -132,15 +164,19 @@ def generation_history(fleet_dir: str) -> List[Dict[str, Any]]:
 
 def write_pointer(fleet_dir: str, path: str, sha: str, generation: int,
                   prev: Optional[Dict[str, Any]] = None,
-                  rollback_from: Optional[int] = None) -> Dict[str, Any]:
+                  rollback_from: Optional[int] = None,
+                  model_id: str = "") -> Dict[str, Any]:
     """Atomically replace the promotion pointer (tmp + ``os.replace``:
     a replica's watcher never reads a half-written pointer).  ``prev``
     records the generation being replaced (the rollback target);
     ``rollback_from`` marks an intentional downgrade so replicas accept
-    the backwards generation."""
+    the backwards generation; ``model_id`` selects a tenant's pointer
+    file (generation counters are per-tenant)."""
     pointer: Dict[str, Any] = {
         "generation": int(generation), "path": str(path),
         "sha256": sha, "promoted_unix": time.time()}
+    if model_id:
+        pointer["model_id"] = str(model_id)
     if prev:
         pointer["prev"] = {"generation": int(prev["generation"]),
                            "path": str(prev["path"]),
@@ -157,50 +193,59 @@ def write_pointer(fleet_dir: str, path: str, sha: str, generation: int,
         log_warning(f"fleet: generation history append failed: {e}")
     from ..robustness import chaos
     text = json.dumps(pointer)
-    if chaos.maybe_tear_pointer(fleet_dir, text):
+    if chaos.maybe_tear_pointer(fleet_dir, text,
+                                name=pointer_name(model_id)):
         return pointer
-    atomic_write_text(os.path.join(fleet_dir, PROMOTE_NAME), text)
+    atomic_write_text(os.path.join(fleet_dir, pointer_name(model_id)),
+                      text)
     return pointer
 
 
-def _current_generation(fleet_dir: str) -> int:
-    """Last written generation: the pointer, or (torn/missing pointer)
-    the newest history entry."""
-    cur = read_pointer(fleet_dir)
+def _current_generation(fleet_dir: str, model_id: str = "") -> int:
+    """Last written generation of one tenant's pointer (the flat pointer
+    when ``model_id`` is empty): the pointer file, or (torn/missing
+    pointer) that tenant's newest history entry."""
+    cur = read_pointer(fleet_dir, model_id)
     if cur is not None:
         return int(cur["generation"])
-    hist = generation_history(fleet_dir)
+    hist = generation_history(fleet_dir, model_id)
     return int(hist[-1]["generation"]) if hist else 0
 
 
 def promote_pointer(fleet_dir: str, path: str,
-                    sha: Optional[str] = None) -> Dict[str, Any]:
-    """Validate ``path`` and advance the shared pointer one generation.
-    Any process with the fleet directory can promote — the supervisor,
-    a replica's ``/reload``, or an external deploy tool."""
+                    sha: Optional[str] = None,
+                    model_id: str = "") -> Dict[str, Any]:
+    """Validate ``path`` and advance the shared pointer one generation —
+    only ``model_id``'s pointer when set, so promoting one tenant never
+    touches (or re-validates) its siblings.  Any process with the fleet
+    directory can promote — the supervisor, a replica's ``/reload``, or
+    an external deploy tool."""
     checked = validate_candidate(path)
     if sha is not None and sha != checked:
         raise LightGBMError(
             f"serving candidate {path!r} sha256 mismatch (expected "
             f"{sha[:12]}..., file {checked[:12]}...)")
-    cur = read_pointer(fleet_dir)
-    gen = _current_generation(fleet_dir) + 1
-    return write_pointer(fleet_dir, path, checked, gen, prev=cur)
+    cur = read_pointer(fleet_dir, model_id)
+    gen = _current_generation(fleet_dir, model_id) + 1
+    return write_pointer(fleet_dir, path, checked, gen, prev=cur,
+                         model_id=model_id)
 
 
-def rollback_pointer(fleet_dir: str, reason: str = "") -> Dict[str, Any]:
-    """Revert the fleet to the previous generation: re-validate the prior
-    target and write it back with a ``rollback_from`` marker (the only
-    thing that lets a replica accept a backwards generation).  The target
-    comes from the current pointer's ``prev`` record, or — when the
-    pointer is torn — the history trail."""
+def rollback_pointer(fleet_dir: str, reason: str = "",
+                     model_id: str = "") -> Dict[str, Any]:
+    """Revert one tenant (the flat pointer when ``model_id`` is empty)
+    to its previous generation: re-validate the prior target and write
+    it back with a ``rollback_from`` marker (the only thing that lets a
+    replica accept a backwards generation).  The target comes from the
+    current pointer's ``prev`` record, or — when the pointer is torn —
+    the tenant's history trail."""
     from .. import telemetry
 
-    cur = read_pointer(fleet_dir)
+    cur = read_pointer(fleet_dir, model_id)
     target = (cur or {}).get("prev")
-    cur_gen = _current_generation(fleet_dir)
+    cur_gen = _current_generation(fleet_dir, model_id)
     if target is None:
-        hist = generation_history(fleet_dir)
+        hist = generation_history(fleet_dir, model_id)
         for rec in reversed(hist):
             if int(rec.get("generation", 0)) < cur_gen:
                 target = rec
@@ -208,7 +253,7 @@ def rollback_pointer(fleet_dir: str, reason: str = "") -> Dict[str, Any]:
     if target is None:
         raise LightGBMError(
             f"fleet dir {fleet_dir!r} has no prior generation to roll "
-            "back to")
+            "back to" + (f" for model {model_id!r}" if model_id else ""))
     sha = validate_candidate(str(target["path"]))
     if sha != target.get("sha256"):
         raise LightGBMError(
@@ -217,13 +262,16 @@ def rollback_pointer(fleet_dir: str, reason: str = "") -> Dict[str, Any]:
             f"{str(target.get('sha256'))[:12]}...)")
     pointer = write_pointer(fleet_dir, str(target["path"]), sha,
                             int(target["generation"]),
-                            rollback_from=cur_gen)
+                            rollback_from=cur_gen, model_id=model_id)
     telemetry.instant("fleet:rollback", generation=pointer["generation"],
                       rollback_from=cur_gen, sha256=sha,
+                      model_id=model_id or "",
                       reason=reason or "unspecified")
     telemetry.inc("fleet/rollbacks")
-    log_warning(f"fleet: rolled back generation {cur_gen} -> "
-                f"{pointer['generation']} ({reason or 'unspecified'})")
+    log_warning(f"fleet: rolled back "
+                + (f"model {model_id!r} " if model_id else "")
+                + f"generation {cur_gen} -> {pointer['generation']} "
+                f"({reason or 'unspecified'})")
     return pointer
 
 
@@ -293,35 +341,75 @@ def _replica_main(spec_path: str, rank: int) -> int:
                                    daemon=True)
     beat_thread.start()
 
-    # boot from the CURRENT pointer, but only after the same
+    # boot from the CURRENT pointer(s), but only after the same
     # re-validation the promotion watcher performs — a candidate the
     # fleet rejected (file tampered after promotion) must not be served
     # just because this replica restarted; wait for a pointer that
-    # validates instead of crash-looping on a dead one
+    # validates instead of crash-looping on a dead one.  A multi-tenant
+    # spec carries a model roster: every tenant boots from ITS OWN
+    # promote_<id>.json (the supervisor writes them before spawning).
+    roster: Dict[str, str] = {str(k): str(v)
+                              for k, v in (spec.get("models") or {}).items()}
+    default_mid = str(spec.get("default_model", "") or "")
+    if roster and not default_mid:
+        default_mid = next(iter(roster))
+    applied: Dict[str, int] = {}
     pointer = None
-    while pointer is None:
-        p = read_pointer(fleet_dir)
-        if p is None:
-            raise LightGBMError(f"fleet dir {fleet_dir!r} has no promotion "
-                                "pointer; the supervisor writes it before "
-                                "spawning replicas")
-        try:
-            sha = validate_candidate(str(p["path"]))
-            if sha != p.get("sha256"):
+    if roster:
+        boot_roster: Dict[str, str] = {}
+        for mid in roster:
+            while mid not in boot_roster:
+                p = read_pointer(fleet_dir, mid)
+                if p is None:
+                    # shared dir predating this tenant: serve the spec
+                    # roster path; generation 0 until someone promotes
+                    boot_roster[mid] = roster[mid]
+                    applied[mid] = 0
+                    break
+                try:
+                    sha = validate_candidate(str(p["path"]))
+                    if sha != p.get("sha256"):
+                        raise LightGBMError(
+                            f"model {mid!r} pointer generation "
+                            f"{p['generation']} sha256 mismatch "
+                            f"({sha[:12]}... != "
+                            f"{str(p.get('sha256'))[:12]}...) — the file "
+                            "changed after promotion")
+                    boot_roster[mid] = str(p["path"])
+                    applied[mid] = int(p["generation"])
+                except LightGBMError as e:
+                    log_warning(f"replica {rank}: promoted model failed "
+                                f"boot validation ({e}); waiting for a "
+                                "valid promotion")
+                    if stop.wait(1.0):
+                        return 0
+    else:
+        while pointer is None:
+            p = read_pointer(fleet_dir)
+            if p is None:
                 raise LightGBMError(
-                    f"pointer generation {p['generation']} sha256 mismatch "
-                    f"({sha[:12]}... != {str(p.get('sha256'))[:12]}...) — "
-                    "the file changed after promotion")
-            pointer = p
-        except LightGBMError as e:
-            log_warning(f"replica {rank}: promoted model failed boot "
-                        f"validation ({e}); waiting for a valid promotion")
-            if stop.wait(1.0):
-                return 0
+                    f"fleet dir {fleet_dir!r} has no promotion pointer; "
+                    "the supervisor writes it before spawning replicas")
+            try:
+                sha = validate_candidate(str(p["path"]))
+                if sha != p.get("sha256"):
+                    raise LightGBMError(
+                        f"pointer generation {p['generation']} sha256 "
+                        f"mismatch ({sha[:12]}... != "
+                        f"{str(p.get('sha256'))[:12]}...) — the file "
+                        "changed after promotion")
+                pointer = p
+            except LightGBMError as e:
+                log_warning(f"replica {rank}: promoted model failed boot "
+                            f"validation ({e}); waiting for a valid "
+                            "promotion")
+                if stop.wait(1.0):
+                    return 0
+        applied[""] = int(pointer["generation"])
     reuseport = bool(spec.get("reuseport"))
     access_dir = str(spec.get("access_log_dir", "") or "")
     app = ServingApp(
-        str(pointer["path"]),
+        str(pointer["path"]) if pointer is not None else "",
         host=spec["host"],
         port=int(spec["shared_port"]) if reuseport else 0,
         max_batch=int(spec["max_batch"]),
@@ -351,63 +439,109 @@ def _replica_main(spec_path: str, rank: int) -> int:
         drift_threshold=float(spec.get("drift_threshold", 0.2)),
         drift_window_s=float(spec.get("drift_window_s", 60.0)),
         quality_min_rows=int(spec.get("quality_min_rows", 200)),
-        quality_topk=int(spec.get("quality_topk", 5)))
+        quality_topk=int(spec.get("quality_topk", 5)),
+        models=(boot_roster if roster else None),
+        hbm_budget_mb=float(spec.get("hbm_budget_mb", 0.0)),
+        default_model_id=default_mid,
+        explain_max_batch=int(spec.get("explain_max_batch", 16)),
+        explain_queue_size=int(spec.get("explain_queue_size", 64)),
+        explain_max_delay_ms=float(spec.get("explain_max_delay_ms", 2.0)))
     app.replica_rank = rank
     # per-replica drift snapshot export (merged by `python -m
     # lightgbm_tpu.telemetry.quality report <fleet_dir>`)
     app.drift_export_path = os.path.join(fleet_dir,
                                          f"drift_replica_{rank}.json")
-    app.generation = int(pointer["generation"])
+    app.generation = applied[default_mid if roster else ""]
     app.seen_generation = app.generation
+    if roster:
+        for mid, gen in applied.items():
+            reg = app.registry.tenant(mid)
+            reg.generation = gen
+            reg.seen_generation = gen
 
-    def _watch_promotions() -> None:
-        applied = int(pointer["generation"])
-        while not stop.wait(float(spec.get("poll_s", _BEAT_S))):
-            p = read_pointer(fleet_dir)
-            decision = pointer_transition(applied, p)
-            if decision == "ignore":
-                continue
-            gen = int(p["generation"])
-            if decision == "refuse":
-                log_warning(
-                    f"replica {rank}: refusing pointer generation "
-                    f"{gen} < applied {applied} without a "
-                    "rollback_from marker (stale promoter?)")
-                continue
-            if gen < applied:
-                log_warning(f"replica {rank}: rollback generation "
-                            f"{gen} (from {p['rollback_from']})")
-            applied = gen
-            try:
-                # re-validate against the POINTER's sha first: a file
-                # swapped after promotion must not be served even if it
-                # parses
-                sha = validate_candidate(str(p["path"]))
-                if sha != p.get("sha256"):
-                    raise LightGBMError(
-                        f"candidate {p['path']!r} does not match the "
-                        f"promoted sha256 ({sha[:12]}... != "
-                        f"{str(p.get('sha256'))[:12]}...) — the file "
-                        "changed after promotion")
+    # the watcher polls ONE pointer per tenant (the flat promote.json in
+    # single-model mode): a promotion of tenant A swaps A's registry and
+    # NOTHING else — siblings keep their device arrays, compiled
+    # programs and version counters bitwise untouched
+    sources: List[str] = list(roster) if roster else [""]
+    tenant_degraded: Dict[str, str] = {}
+
+    def _apply_pointer(mid: str) -> None:
+        p = read_pointer(fleet_dir, mid)
+        decision = pointer_transition(applied[mid], p)
+        if decision == "ignore":
+            return
+        gen = int(p["generation"])
+        who = f"model {mid!r} " if mid else ""
+        if decision == "refuse":
+            log_warning(
+                f"replica {rank}: refusing {who}pointer generation "
+                f"{gen} < applied {applied[mid]} without a "
+                "rollback_from marker (stale promoter?)")
+            return
+        if gen < applied[mid]:
+            log_warning(f"replica {rank}: {who}rollback generation "
+                        f"{gen} (from {p['rollback_from']})")
+        applied[mid] = gen
+        reg = app.registry.tenant(mid) if roster else None
+        try:
+            # re-validate against the POINTER's sha first: a file
+            # swapped after promotion must not be served even if it
+            # parses
+            sha = validate_candidate(str(p["path"]))
+            if sha != p.get("sha256"):
+                raise LightGBMError(
+                    f"candidate {p['path']!r} does not match the "
+                    f"promoted sha256 ({sha[:12]}... != "
+                    f"{str(p.get('sha256'))[:12]}...) — the file "
+                    "changed after promotion")
+            if roster:
+                app.registry.load(str(p["path"]), mid)
+            else:
                 app.registry.load(str(p["path"]))
-            except LightGBMError as e:
-                app.degraded = (f"candidate generation {gen} rejected: {e}")
+        except LightGBMError as e:
+            msg = f"{who}candidate generation {gen} rejected: {e}"
+            tenant_degraded[mid] = msg
+            app.degraded = "; ".join(tenant_degraded.values())
+            if reg is not None:
+                reg.seen_generation = gen
+            if not mid or mid == default_mid:
                 app.seen_generation = gen
-                log_warning(f"replica {rank}: {app.degraded}; still "
-                            f"serving generation {app.generation}")
-                continue
+            log_warning(f"replica {rank}: {msg}; still serving "
+                        f"{who}generation "
+                        f"{reg.generation if reg is not None else app.generation}")
+            return
+        if reg is not None:
+            reg.generation = gen
+            reg.seen_generation = gen
+        if not mid or mid == default_mid:
             app.generation = gen
             app.seen_generation = gen
-            app.degraded = None
-            log_info(f"replica {rank}: promoted to generation {gen} "
-                     f"(sha {str(p['sha256'])[:12]})")
+        tenant_degraded.pop(mid, None)
+        app.degraded = "; ".join(tenant_degraded.values()) or None
+        log_info(f"replica {rank}: promoted {who}to generation {gen} "
+                 f"(sha {str(p['sha256'])[:12]})")
 
-    def _promote_fn(path: str):
+    def _watch_promotions() -> None:
+        while not stop.wait(float(spec.get("poll_s", _BEAT_S))):
+            for mid in sources:
+                _apply_pointer(mid)
+
+    def _promote_fn(path: str, model_id: str = ""):
         # any replica's /reload promotes FLEET-WIDE through the shared
-        # pointer (its own watcher applies the swap like everyone else's)
-        p = promote_pointer(fleet_dir, path)
-        return {"promoted_generation": p["generation"],
-                "sha256": p["sha256"], "fleet_wide": True}
+        # pointer (its own watcher applies the swap like everyone else's);
+        # in a multi-tenant fleet an un-addressed reload targets the
+        # default tenant's pointer
+        mid = str(model_id or "") or (default_mid if roster else "")
+        if roster and mid not in roster:
+            raise LightGBMError(f"unknown model_id {mid!r} (roster: "
+                                f"{', '.join(sorted(roster))})")
+        p = promote_pointer(fleet_dir, path, model_id=mid)
+        out = {"promoted_generation": p["generation"],
+               "sha256": p["sha256"], "fleet_wide": True}
+        if mid:
+            out["model_id"] = mid
+        return out
 
     app.promote_fn = _promote_fn
     app.start()
@@ -475,6 +609,11 @@ class ServingFleet:
                  quality_audit_sample: float = 0.01,
                  drift_threshold: float = 0.2, drift_window_s: float = 60.0,
                  quality_min_rows: int = 200, quality_topk: int = 5,
+                 models=None, hbm_budget_mb: float = 0.0,
+                 default_model_id: str = "",
+                 explain_max_batch: int = 16,
+                 explain_queue_size: int = 64,
+                 explain_max_delay_ms: float = 2.0,
                  python: str = sys.executable):
         from .server import reuseport_available
 
@@ -515,14 +654,42 @@ class ServingFleet:
         self._own_dir = not fleet_dir
         self.dir = fleet_dir or tempfile.mkdtemp(prefix="lgb_tpu_fleet_")
         os.makedirs(self.dir, exist_ok=True)
+        # multi-tenant fleet: the roster maps model_id -> model file;
+        # every tenant gets its OWN promote_<id>.json generation counter
+        self.roster: Dict[str, str] = {}
+        self.default_model_id = str(default_model_id or "")
+        if models:
+            from .multimodel import parse_model_roster
+            self.roster = dict(parse_model_roster(models))
+            if not self.default_model_id:
+                self.default_model_id = next(iter(self.roster))
+            if self.default_model_id not in self.roster:
+                raise LightGBMError(
+                    f"default model_id {self.default_model_id!r} is not "
+                    f"in the roster ({', '.join(sorted(self.roster))})")
+            if not model_path:
+                model_path = self.roster[self.default_model_id]
+        elif not model_path:
+            raise LightGBMError(
+                "ServingFleet needs a model_path or a model roster")
         # gen 1 (or continue a pre-existing shared dir's count): the
-        # pointer exists BEFORE any replica starts, so every replica
-        # boots on the same validated version
+        # pointer(s) exist BEFORE any replica starts, so every replica
+        # boots on the same validated version.  The flat promote.json is
+        # always written (single-model fleets, plus back-compat tooling
+        # that reads it); a roster adds one pointer per tenant
         sha = validate_candidate(model_path)
         cur = read_pointer(self.dir)
         gen = _current_generation(self.dir) + 1
         self._pointer = write_pointer(self.dir, model_path, sha, gen,
                                       prev=cur)
+        for mid, mpath in self.roster.items():
+            msha = validate_candidate(mpath)
+            mcur = read_pointer(self.dir, mid)
+            if mcur is not None and str(mcur.get("sha256")) == msha:
+                continue   # shared dir already points at these bytes
+            mgen = _current_generation(self.dir, mid) + 1
+            write_pointer(self.dir, mpath, msha, mgen, prev=mcur,
+                          model_id=mid)
         # observability knobs ride to every replica via the spec; the
         # access log treats the configured path as a DIRECTORY in fleet
         # mode (access_front.jsonl + access_replica_<r>.jsonl inside)
@@ -561,6 +728,15 @@ class ServingFleet:
             "drift_window_s": float(drift_window_s),
             "quality_min_rows": int(quality_min_rows),
             "quality_topk": int(quality_topk),
+            # multi-tenant serving: replicas boot every tenant from its
+            # own pointer; the roster here is only the fallback for a
+            # tenant whose pointer a shared dir does not have yet
+            "models": self.roster,
+            "default_model": self.default_model_id,
+            "hbm_budget_mb": float(hbm_budget_mb),
+            "explain_max_batch": int(explain_max_batch),
+            "explain_queue_size": int(explain_queue_size),
+            "explain_max_delay_ms": float(explain_max_delay_ms),
             **self.slo_params,
         }
         self._spec_path = os.path.join(self.dir, "replica_spec.json")
@@ -761,21 +937,55 @@ class ServingFleet:
                  f"dir {self.dir})")
         return self
 
+    def _pointer_mid(self, model_id: Optional[str]) -> str:
+        """Resolve a promote/rollback target to a pointer key: the named
+        tenant in a roster fleet (un-addressed calls hit the DEFAULT
+        tenant's pointer — the flat promote.json is not watched by
+        multi-tenant replicas), the flat pointer otherwise."""
+        mid = str(model_id or "")
+        if self.roster:
+            mid = mid or self.default_model_id
+            if mid not in self.roster:
+                raise LightGBMError(
+                    f"unknown model_id {mid!r} (roster: "
+                    f"{', '.join(sorted(self.roster))})")
+            return mid
+        if mid:
+            raise LightGBMError(
+                "model_id promotion needs a multi-tenant fleet "
+                "(serve_models)")
+        return ""
+
     @property
     def generation(self) -> int:
-        p = read_pointer(self.dir)
+        p = read_pointer(self.dir, self._pointer_mid(None))
         return int(p["generation"]) if p else 0
 
-    def current_pointer(self) -> Optional[Dict[str, Any]]:
-        return read_pointer(self.dir)
+    def current_pointer(self, model_id: Optional[str] = None
+                        ) -> Optional[Dict[str, Any]]:
+        return read_pointer(self.dir, self._pointer_mid(model_id))
 
-    def promote(self, path: str,
-                timeout_s: float = 60.0) -> Dict[str, Any]:
-        """Validate + write the pointer, then wait for every live
-        replica to process the new generation.  Returns the per-replica
-        outcome; raises only when the CANDIDATE fails validation (the
-        fleet is untouched in that case)."""
-        pointer = promote_pointer(self.dir, path)
+    def _replica_gen_state(self, st: Optional[Dict[str, Any]],
+                           mid: str) -> Dict[str, Any]:
+        """(seen_generation, generation, degraded) of one tenant in one
+        replica's /ready payload — the per-model record when addressing
+        a roster tenant, the flat fields otherwise."""
+        if st is None:
+            return {}
+        if mid:
+            return (st.get("models") or {}).get(mid) or {}
+        return st
+
+    def promote(self, path: str, timeout_s: float = 60.0,
+                model_id: Optional[str] = None) -> Dict[str, Any]:
+        """Validate + write one tenant's pointer, then wait for every
+        live replica to process the new generation.  Returns the
+        per-replica outcome; raises only when the CANDIDATE fails
+        validation (the fleet is untouched in that case).  Sibling
+        tenants are never touched — their registries, versions and
+        compiled programs stay bitwise identical through the promotion."""
+        mid = self._pointer_mid(model_id)
+        pointer = promote_pointer(self.dir, path, model_id=mid)
         gen = int(pointer["generation"])
         deadline = time.monotonic() + timeout_s
         promoted: Dict[int, bool] = {}
@@ -784,47 +994,58 @@ class ServingFleet:
             states = self._ready_states()
             pending = False
             for rank, st in states.items():
-                if st is None or int(st.get("seen_generation", 0)) < gen:
+                rec = self._replica_gen_state(st, mid)
+                if not rec or int(rec.get("seen_generation", 0)) < gen:
                     pending = True
                     continue
-                if int(st.get("generation", 0)) == gen:
+                if int(rec.get("generation", 0)) == gen:
                     promoted[rank] = True
                     rejected.pop(rank, None)
                 else:
-                    rejected[rank] = str(st.get("degraded", "rejected"))
+                    rejected[rank] = str((st or {}).get("degraded",
+                                                        "rejected"))
             if not pending and states:
                 break
             time.sleep(0.1)
-        unreachable = [r for r, st in self._ready_states().items()
-                       if st is None
-                       or int(st.get("seen_generation", 0)) < gen]
-        return {"generation": gen, "sha256": pointer["sha256"],
-                "promoted": sorted(promoted),
-                "rejected": {str(r): m for r, m in sorted(rejected.items())},
-                "unreachable": sorted(set(unreachable) - set(promoted))}
+        unreachable = [
+            r for r, st in self._ready_states().items()
+            if int(self._replica_gen_state(st, mid)
+                   .get("seen_generation", 0)) < gen]
+        out = {"generation": gen, "sha256": pointer["sha256"],
+               "promoted": sorted(promoted),
+               "rejected": {str(r): m for r, m in sorted(rejected.items())},
+               "unreachable": sorted(set(unreachable) - set(promoted))}
+        if mid:
+            out["model_id"] = mid
+        return out
 
-    def rollback(self, reason: str = "",
-                 timeout_s: float = 60.0) -> Dict[str, Any]:
-        """Revert the fleet to the previous generation and wait for the
+    def rollback(self, reason: str = "", timeout_s: float = 60.0,
+                 model_id: Optional[str] = None) -> Dict[str, Any]:
+        """Revert one tenant to its previous generation and wait for the
         live replicas to converge on the rollback target's sha256 (the
         generation number moves DOWN, so the promote() wait — which keys
         on seen_generation advancing — does not apply)."""
-        pointer = rollback_pointer(self.dir, reason=reason)
+        mid = self._pointer_mid(model_id)
+        pointer = rollback_pointer(self.dir, reason=reason, model_id=mid)
         sha = str(pointer["sha256"])
         deadline = time.monotonic() + timeout_s
         reverted: Dict[int, bool] = {}
         while time.monotonic() < deadline:
             states = self._ready_states()
-            reverted = {r: (st is not None
-                            and str(st.get("model_sha256")) == sha)
-                        for r, st in states.items()}
+            reverted = {
+                r: (str(self._replica_gen_state(st, mid)
+                        .get("sha256" if mid else "model_sha256")) == sha)
+                for r, st in states.items()}
             if states and all(reverted.values()):
                 break
             time.sleep(0.1)
-        return {"generation": int(pointer["generation"]),
-                "rollback_from": pointer.get("rollback_from"),
-                "sha256": sha,
-                "reverted": sorted(r for r, ok in reverted.items() if ok)}
+        out = {"generation": int(pointer["generation"]),
+               "rollback_from": pointer.get("rollback_from"),
+               "sha256": sha,
+               "reverted": sorted(r for r, ok in reverted.items() if ok)}
+        if mid:
+            out["model_id"] = mid
+        return out
 
     def _ready_states(self) -> Dict[int, Optional[Dict[str, Any]]]:
         """rank -> /ready payload (None when unreachable) for every live
@@ -916,8 +1137,9 @@ def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
 
     cfg = Config.from_params(params)
     model_path = str(params.get("input_model", "") or "")
-    if not model_path:
-        raise LightGBMError("task=serve requires input_model=<model file>")
+    if not model_path and not cfg.serve_models:
+        raise LightGBMError("task=serve requires input_model=<model file> "
+                            "(or serve_models=<id=path,...>)")
     return ServingFleet(
         model_path, replicas=cfg.serve_replicas,
         host=cfg.serve_host, port=cfg.serve_port,
@@ -945,7 +1167,13 @@ def fleet_from_params(params: Dict[str, Any]) -> ServingFleet:
         drift_threshold=cfg.drift_threshold,
         drift_window_s=cfg.drift_window_s,
         quality_min_rows=cfg.quality_min_rows,
-        quality_topk=cfg.quality_topk)
+        quality_topk=cfg.quality_topk,
+        models=cfg.serve_models or None,
+        hbm_budget_mb=cfg.serve_hbm_budget_mb,
+        default_model_id=cfg.serve_default_model,
+        explain_max_batch=cfg.serve_explain_max_batch,
+        explain_queue_size=cfg.serve_explain_queue_size,
+        explain_max_delay_ms=cfg.serve_explain_max_delay_ms)
 
 
 def run_fleet(params: Dict[str, Any]) -> int:

@@ -470,14 +470,17 @@ class FanoutFront:
 
     def handle_reload(self, body: Dict[str, Any]
                       ) -> Tuple[int, Dict[str, Any]]:
+        # model_id keys the promotion to ONE tenant's pointer in a
+        # multi-tenant fleet; un-addressed reloads hit the default tenant
+        mid = str(body.get("model_id", "") or "") or None
         path = str(body.get("path", "") or "")
-        if not path:
-            p = self.fleet.current_pointer()
-            if p is None:
-                return 409, {"error": "fleet has no promoted model"}
-            path = str(p["path"])
         try:
-            outcome = self.fleet.promote(path)
+            if not path:
+                p = self.fleet.current_pointer(mid)
+                if p is None:
+                    return 409, {"error": "fleet has no promoted model"}
+                path = str(p["path"])
+            outcome = self.fleet.promote(path, model_id=mid)
         except LightGBMError as e:
             # candidate failed validation: nothing was promoted anywhere
             return 409, {"error": str(e),

@@ -1,0 +1,312 @@
+"""HBM-resident multi-model serving cache (docs/SERVING.md
+"Multi-tenant serving").
+
+Production traffic is per-segment/per-country model FAMILIES, not one
+booster (the reference C API is explicitly multi-booster: 98 ``LGBM_*``
+handles over reader-writer-locked Booster wrappers).  This module holds
+N tenants behind one serving surface:
+
+  * each tenant is a full :class:`ModelRegistry` (manifest-verified
+    loads, atomic hot-reload, quality sidecar, per-tenant version/sha
+    history) keyed by a caller-chosen ``model_id``;
+  * every tenant packs with the DETERMINISTIC rounded shape envelope
+    (``compiled.shape_envelope``), so same-family models land on
+    identical ``(T, M, C, W, depth)`` traced shapes and SHARE one
+    compiled ``serve_predict`` program per bucket — admitting, evicting,
+    or promoting a tenant never traces anything new;
+  * mixed-tenant micro-batch windows dispatch as ONE model-axis-stacked
+    ``serve_predict_multi`` program (``compiled.raw_scores_stacked``)
+    instead of a per-tenant launch train;
+  * residency is byte-accounted against ``serve_hbm_budget_mb`` with LRU
+    eviction.  Evicting drops only the tenant's device arrays — compiled
+    programs are keyed by shape and stay cached, so readmission rebuilds
+    from the manifest-verified FILE (re-verifying sha256 and re-attaching
+    the quality sidecar) and warms with zero recompiles.  In-flight
+    requests that pinned the evicting :class:`ServingModel` drain on
+    their old reference, exactly like a hot-reload swap.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_info
+from .compiled import raw_scores_stacked
+from .registry import ModelRegistry, ServingModel
+
+# stacked dispatch caps the model axis here; wider windows chunk.  Keeps
+# the (model-slots, bucket) specialization lattice small enough that
+# warmup covers it entirely (zero recompiles under live traffic).
+MAX_STACK = 8
+
+
+def parse_model_roster(spec) -> "OrderedDict[str, str]":
+    """``serve_models`` parser: ``id=path[,id=path...]`` (or an already
+    parsed mapping).  Ids must be short ASCII tokens — they ride a
+    length-prefixed field of the binary wire frame."""
+    if isinstance(spec, dict):
+        items = list(spec.items())
+    else:
+        items = []
+        for tok in str(spec or "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" not in tok:
+                raise LightGBMError(
+                    f"serve_models entry {tok!r} must be model_id=path")
+            mid, path = tok.split("=", 1)
+            items.append((mid.strip(), path.strip()))
+    out: "OrderedDict[str, str]" = OrderedDict()
+    for mid, path in items:
+        if not mid or len(mid) > 64 or not all(
+                c.isalnum() or c in "._-" for c in mid):
+            raise LightGBMError(
+                f"model_id {mid!r} must be 1-64 chars of [A-Za-z0-9._-]")
+        if not path:
+            raise LightGBMError(f"model_id {mid!r} has an empty path")
+        if mid in out:
+            raise LightGBMError(f"duplicate model_id {mid!r}")
+        out[mid] = path
+    if not out:
+        raise LightGBMError("serve_models lists no models")
+    return out
+
+
+class MultiModelRegistry:
+    """N tenant registries behind the single-model registry surface
+    (``current``/``load``/``stats``/``sha_for_version``) plus LRU
+    residency and stacked multi-tenant dispatch."""
+
+    def __init__(self, models, *, max_batch: int = 256,
+                 buckets_spec: str = "", warmup: bool = True,
+                 hbm_budget_mb: float = 0.0,
+                 default_id: Optional[str] = None):
+        from .. import telemetry
+
+        roster = parse_model_roster(models)
+        self._lock = threading.Lock()        # LRU order + counters
+        self._max_batch = int(max_batch)
+        self._warmup = bool(warmup)
+        self.budget_bytes = int(float(hbm_budget_mb) * (1 << 20))
+        self.default_id = default_id or next(iter(roster))
+        if self.default_id not in roster:
+            raise LightGBMError(
+                f"default model_id {self.default_id!r} is not in "
+                "serve_models")
+        self._tenants: "OrderedDict[str, ModelRegistry]" = OrderedDict()
+        self._admit_locks: Dict[str, threading.Lock] = {}
+        self._lru: "OrderedDict[str, None]" = OrderedDict()
+        self.readmissions = 0
+        for mid, path in roster.items():
+            self._admit_locks[mid] = threading.Lock()
+            reg = ModelRegistry(path, max_batch=self._max_batch,
+                                buckets_spec=buckets_spec,
+                                warmup=self._warmup, envelope="auto",
+                                model_id=mid)
+            self._tenants[mid] = reg
+            with self._lock:
+                self._lru[mid] = None
+        if self._warmup:
+            self.warmup_stacked()
+        self._enforce_budget()
+        telemetry.gauge("serve/cache/models", len(self._tenants))
+        log_info(f"multi-model cache: {len(self._tenants)} tenants, "
+                 f"{self.resident_bytes()} device bytes resident, budget "
+                 f"{self.budget_bytes or 'unlimited'}")
+
+    # -- residency accounting ---------------------------------------------
+    def model_ids(self) -> List[str]:
+        return list(self._tenants)
+
+    def tenant(self, model_id: Optional[str] = None) -> ModelRegistry:
+        mid = model_id or self.default_id
+        reg = self._tenants.get(mid)
+        if reg is None:
+            raise LightGBMError(f"unknown model_id {mid!r}")
+        return reg
+
+    def resident_bytes(self) -> int:
+        total = 0
+        for reg in self._tenants.values():
+            model = reg.peek()
+            if model is not None:
+                total += model.device_bytes()
+        return total
+
+    def _touch(self, mid: str) -> None:
+        with self._lock:
+            self._lru.pop(mid, None)
+            self._lru[mid] = None
+
+    def _enforce_budget(self, keep: Optional[str] = None) -> None:
+        """Evict least-recently-used tenants until the residency fits the
+        byte budget (never evicting ``keep`` or the last resident)."""
+        from .. import telemetry
+        if self.budget_bytes <= 0:
+            return
+        while self.resident_bytes() > self.budget_bytes:
+            victim = None
+            with self._lock:
+                for mid in self._lru:
+                    if mid == keep:
+                        continue
+                    if self._tenants[mid].peek() is not None:
+                        victim = mid
+                        break
+            if victim is None:
+                return          # nothing evictable (budget < one model)
+            self._tenants[victim].evict()
+            telemetry.inc(f"model/{victim}/evictions")
+            telemetry.gauge("serve/cache/resident_bytes",
+                            self.resident_bytes())
+
+    # -- the registry surface ---------------------------------------------
+    def current(self, model_id: Optional[str] = None) -> ServingModel:
+        """The tenant's resident model, readmitting (manifest-verified
+        rebuild) when it was evicted.  Touches the LRU."""
+        reg = self.tenant(model_id)
+        mid = reg.model_id
+        model = reg.peek()
+        if model is None:
+            with self._admit_locks[mid]:
+                model = reg.peek()
+                if model is None:
+                    model = reg.readmit()
+                    from .. import telemetry
+                    with self._lock:
+                        self.readmissions += 1
+                    telemetry.inc(f"model/{mid}/readmissions")
+        self._touch(mid)
+        self._enforce_budget(keep=mid)
+        return model
+
+    def peek(self, model_id: Optional[str] = None) -> Optional[ServingModel]:
+        return self.tenant(model_id).peek()
+
+    def load(self, path: str, model_id: Optional[str] = None) -> ServingModel:
+        """Hot-reload ONE tenant (promotion path): validate + build +
+        warm off to the side, atomic per-tenant swap — sibling tenants
+        keep serving their old versions bitwise untouched."""
+        reg = self.tenant(model_id)
+        model = reg.load(path)
+        self._touch(reg.model_id)
+        self._enforce_budget(keep=reg.model_id)
+        return model
+
+    @property
+    def version(self) -> int:
+        return self.tenant().version
+
+    def sha_for_version(self, version: int) -> Optional[str]:
+        return self.tenant().sha_for_version(version)
+
+    @property
+    def reloads_ok(self) -> int:
+        return sum(r.reloads_ok for r in self._tenants.values())
+
+    @property
+    def reloads_failed(self) -> int:
+        return sum(r.reloads_failed for r in self._tenants.values())
+
+    @property
+    def evictions(self) -> int:
+        return sum(r.evictions for r in self._tenants.values())
+
+    def stats(self) -> Dict[str, Any]:
+        models = {mid: reg.stats() for mid, reg in self._tenants.items()}
+        with self._lock:
+            lru = list(self._lru)
+        out: Dict[str, Any] = {
+            "reloads_ok": self.reloads_ok,
+            "reloads_failed": self.reloads_failed,
+            "models": models,
+            "cache": {
+                "tenants": len(self._tenants),
+                "resident": [mid for mid, reg in self._tenants.items()
+                             if reg.peek() is not None],
+                "lru": lru,
+                "resident_bytes": self.resident_bytes(),
+                "budget_bytes": self.budget_bytes,
+                "evictions": self.evictions,
+                "readmissions": self.readmissions,
+            },
+        }
+        cur = self.tenant().peek()
+        if cur is not None:
+            out["model"] = cur.describe()
+        return out
+
+    # -- stacked multi-tenant dispatch ------------------------------------
+    @staticmethod
+    def _stackable(model: ServingModel, n_rows: int) -> bool:
+        c = model._compiled
+        return (c is not None and c._host_pack is not None
+                and c._lv_dev is not None and 0 < n_rows <= c.buckets[-1])
+
+    def raw_scores_grouped(self, jobs: Sequence[Tuple[ServingModel,
+                                                      np.ndarray]]
+                           ) -> List[np.ndarray]:
+        """Score one micro-batch window of (model, rows) jobs.  Jobs
+        whose models share a pack shape dispatch together as ONE
+        ``serve_predict_multi`` program (chunked at MAX_STACK models);
+        everything else falls back to the per-model path.  Output order
+        matches input order; every value is bitwise equal to the job's
+        own ``model.raw_scores(rows)``."""
+        from .. import telemetry
+
+        out: List[Optional[np.ndarray]] = [None] * len(jobs)
+        groups: Dict[Tuple, List[int]] = {}
+        for i, (model, X) in enumerate(jobs):
+            if self._stackable(model, X.shape[0]):
+                key = model._compiled.shape_signature
+                groups.setdefault(key, []).append(i)
+            else:
+                out[i] = model.raw_scores(jobs[i][1])
+        for idxs in groups.values():
+            for s in range(0, len(idxs), MAX_STACK):
+                chunk = idxs[s:s + MAX_STACK]
+                if len(chunk) == 1:
+                    i = chunk[0]
+                    out[i] = jobs[i][0].raw_scores(jobs[i][1])
+                    continue
+                scores = raw_scores_stacked(
+                    [jobs[i][0]._compiled for i in chunk],
+                    [jobs[i][1] for i in chunk])
+                for i, sc in zip(chunk, scores):
+                    out[i] = sc
+                telemetry.inc("serve/multi/stacked_dispatches")
+                telemetry.inc("serve/multi/stacked_models", len(chunk))
+        return out  # type: ignore[return-value]
+
+    def warmup_stacked(self) -> int:
+        """Trace every (model-slots, bucket) combination live traffic can
+        hit, grouped by pack shape — called at boot BEFORE the budget
+        sweep so compiled programs outlive any later eviction."""
+        traced = 0
+        groups: Dict[Tuple, List[ServingModel]] = {}
+        for reg in self._tenants.values():
+            model = reg.peek()
+            if model is not None and self._stackable(model, 1):
+                groups.setdefault(model._compiled.shape_signature,
+                                  []).append(model)
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            cap = min(len(members), MAX_STACK)
+            g = 2           # slot counts 2, 4, ... up to round-up(cap)
+            while True:
+                use = members[:min(g, cap)]
+                for b in use[0]._compiled.buckets:
+                    raw_scores_stacked(
+                        [m._compiled for m in use],
+                        [np.zeros((b, m.num_features), np.float64)
+                         for m in use])
+                    traced += 1
+                if g >= cap:
+                    break
+                g *= 2
+        return traced

@@ -42,7 +42,8 @@ class ServingModel:
 
     def __init__(self, path: str, model_str: str, sha256: str,
                  max_batch: int = 256,
-                 buckets: Optional[List[int]] = None):
+                 buckets: Optional[List[int]] = None,
+                 envelope=None):
         from ..basic import Booster
         from ..predict_fast import SingleRowFastPredictor
         from ..robustness.guards import check_model_trees
@@ -50,6 +51,7 @@ class ServingModel:
         self.path = str(path)
         self.sha256 = sha256
         self.version = 0            # assigned by the registry at swap time
+        self.model_id = ""          # assigned by the multi-tenant cache
         self.loaded_unix = time.time()
         booster = Booster(model_str=model_str)   # raises on truncation
         check_model_trees(booster._all_trees(),
@@ -69,10 +71,15 @@ class ServingModel:
         # registry from the .quality.json sidecar; None when the sidecar
         # is missing/corrupt/mismatched — drift reports available:false)
         self.quality = None
+        if envelope == "auto":
+            # deterministic rounded-up pack dims: same-family models land
+            # on identical traced shapes with no cross-model coordination
+            from .compiled import shape_envelope
+            envelope = shape_envelope(self._trees)
         try:
             self._compiled: Optional[CompiledPredictor] = CompiledPredictor(
                 self._trees, self.num_class, self.num_features,
-                max_batch=max_batch, buckets=buckets)
+                max_batch=max_batch, buckets=buckets, envelope=envelope)
         except LightGBMError as e:
             log_warning(f"serving model {path!r}: {e}; batches fall back "
                         "to the host predictor")
@@ -133,9 +140,25 @@ class ServingModel:
             return np.zeros((0,) if k == 1 else (0, k), np.float64)
         return self.finish(self.raw_scores(X), raw_score)
 
+    def explain_raw(self, X: np.ndarray) -> np.ndarray:
+        """SHAP contributions for validated float64 rows — the exact
+        ``Booster.predict(pred_contrib=True)`` contract: (n, F+1) per
+        class with the expected value last, multiclass flattened to
+        (n, k*(F+1)).  No averaging/transform tail applies."""
+        from ..shap import predict_contrib
+        return predict_contrib(self._trees, X, self.num_class)
+
+    def device_bytes(self) -> int:
+        """Device residency this version pins (0 for host-fallback
+        models) — the multi-tenant cache's HBM accounting unit."""
+        return (self._compiled.device_bytes()
+                if self._compiled is not None else 0)
+
     def describe(self) -> Dict[str, Any]:
         return {
             "version": self.version,
+            "model_id": self.model_id,
+            "device_bytes": self.device_bytes(),
             "path": self.path,
             "sha256": self.sha256,
             "num_trees": self.num_trees,
@@ -177,7 +200,7 @@ class ModelRegistry:
 
     def __init__(self, path: Optional[str] = None, *,
                  max_batch: int = 256, buckets_spec: str = "",
-                 warmup: bool = True):
+                 warmup: bool = True, envelope=None, model_id: str = ""):
         self._lock = threading.Lock()
         self._current: Optional[ServingModel] = None
         self._version = 0
@@ -185,8 +208,17 @@ class ModelRegistry:
         self._buckets = (bucket_ladder(max_batch, buckets_spec)
                          if buckets_spec else None)
         self._warmup = bool(warmup)
+        self._envelope = envelope
+        self.model_id = str(model_id)
+        self._path = str(path) if path else None
         self.reloads_ok = 0
         self.reloads_failed = 0
+        self.evictions = 0
+        # fleet promotion keying: the (model_id, generation) a replica
+        # last applied for THIS tenant (stamped by the fleet's pointer
+        # watcher; None for standalone registries)
+        self.generation: Optional[int] = None
+        self.seen_generation: Optional[int] = None
         # version -> sha256 for every model this registry ever served:
         # responses stamp both, so a fleet front (or an auditor) can map
         # any response to the exact bytes that scored it even across
@@ -207,7 +239,9 @@ class ModelRegistry:
             sha = _check_manifest(str(path), data)
             model = ServingModel(str(path), data.decode("utf-8"), sha,
                                  max_batch=self._max_batch,
-                                 buckets=self._buckets)
+                                 buckets=self._buckets,
+                                 envelope=self._envelope)
+            model.model_id = self.model_id
             if self._warmup and model._compiled is not None:
                 model._compiled.warmup()
             # quality sidecar rides the model path, so hot-reload and
@@ -231,6 +265,7 @@ class ModelRegistry:
             self._version += 1
             model.version = self._version
             self._current = model
+            self._path = str(path)
             self._sha_by_version[model.version] = sha
             self.reloads_ok += 1
         telemetry.inc("serve/reloads")
@@ -241,12 +276,44 @@ class ModelRegistry:
                  f"{time.perf_counter() - t0:.2f}s incl. warmup)")
         return model
 
-    def current(self) -> ServingModel:
+    def current(self, model_id: Optional[str] = None) -> ServingModel:
+        if model_id and model_id != self.model_id:
+            # single-model registry: any explicit foreign id is a client
+            # routing error, never silently served by the wrong model
+            raise LightGBMError(f"unknown model_id {model_id!r}")
         with self._lock:
             if self._current is None:
                 raise LightGBMError("model registry is empty — load a "
                                     "model before serving")
             return self._current
+
+    def peek(self) -> Optional[ServingModel]:
+        """The resident model WITHOUT readmission side effects (None when
+        empty/evicted) — maintenance loops use this so a 1 Hz tick never
+        thrashes the multi-tenant LRU."""
+        with self._lock:
+            return self._current
+
+    def evict(self) -> Optional[ServingModel]:
+        """Drop the resident model reference (the multi-tenant cache's
+        LRU eviction).  In-flight requests that already pinned the old
+        :class:`ServingModel` drain against it (drain-by-reference);
+        readmission goes back through :meth:`load`, which re-verifies the
+        manifest sha256 and re-attaches the quality sidecar from the
+        file — an evicted entry can never be resurrected from stale
+        state."""
+        with self._lock:
+            model, self._current = self._current, None
+            if model is not None:
+                self.evictions += 1
+        return model
+
+    def readmit(self) -> ServingModel:
+        """Rebuild from the last-served path (manifest-verified, sidecar
+        re-attached, fresh version number)."""
+        if not self._path:
+            raise LightGBMError("model registry has no path to readmit")
+        return self.load(self._path)
 
     @property
     def version(self) -> int:
@@ -261,7 +328,9 @@ class ModelRegistry:
         with self._lock:
             cur = self._current
             out = {"reloads_ok": self.reloads_ok,
-                   "reloads_failed": self.reloads_failed}
+                   "reloads_failed": self.reloads_failed,
+                   "evictions": self.evictions,
+                   "resident": cur is not None}
         if cur is not None:
             out["model"] = cur.describe()
         return out

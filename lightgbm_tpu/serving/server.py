@@ -10,12 +10,21 @@ path.
 Endpoints:
 
   ``POST /predict``  body {"rows": [[...], ...]} or {"row": [...]},
-                     optional "raw_score" (bool) and "fast" (bool — run a
+                     optional "raw_score" (bool), "fast" (bool — run a
                      single row synchronously on the native walk, no
-                     queueing); replies {"predictions", "model_version",
-                     "batched_rows", "latency_ms"}.  A full queue replies
+                     queueing) and "model_id" (multi-tenant routing;
+                     unknown ids reply 400); replies {"predictions",
+                     "model_version", "batched_rows", "latency_ms"} plus
+                     "model_id"/"model_sha256".  A full queue replies
                      503 with the structured overload payload; shape
                      errors reply 400.
+  ``POST /explain``  same body shape (no "fast"); replies per-row SHAP
+                     contributions under "contributions" — exactly the
+                     reference's ``pred_contrib`` layout, k*(n_features
+                     +1) values per row with the expected value last per
+                     class.  Runs on its OWN micro-batcher lane
+                     (``serve_explain_*`` knobs) so heavy explanation
+                     traffic cannot starve the predict path.
   ``GET  /health``   LIVENESS only: is the process up and the batch
                      worker thread alive (503 when the worker died).
   ``GET  /ready``    READINESS: queue depth, active model version +
@@ -126,18 +135,41 @@ class ServingApp:
                  quality_sample: float = 0.01,
                  quality_audit_sample: float = 0.01,
                  drift_threshold: float = 0.2, drift_window_s: float = 60.0,
-                 quality_min_rows: int = 200, quality_topk: int = 5):
+                 quality_min_rows: int = 200, quality_topk: int = 5,
+                 models=None, hbm_budget_mb: float = 0.0,
+                 default_model_id: str = "",
+                 explain_max_batch: int = 16,
+                 explain_queue_size: int = 64,
+                 explain_max_delay_ms: float = 2.0):
         from ..telemetry import AccessLog, TailRing
         from ..telemetry.quality import QualityMonitor
         from .slo import SLOMonitor
 
-        self.registry = ModelRegistry(model_path, max_batch=max_batch,
-                                      buckets_spec=buckets_spec,
-                                      warmup=warmup)
+        # multi-tenant: serve_models roster -> HBM-resident LRU cache of
+        # tenant registries (docs/SERVING.md "Multi-tenant serving");
+        # single-model keeps the flat registry surface unchanged
+        self.multi = bool(models)
+        if self.multi:
+            from .multimodel import MultiModelRegistry
+            self.registry = MultiModelRegistry(
+                models, max_batch=max_batch, buckets_spec=buckets_spec,
+                warmup=warmup, hbm_budget_mb=hbm_budget_mb,
+                default_id=default_model_id or None)
+        else:
+            self.registry = ModelRegistry(model_path, max_batch=max_batch,
+                                          buckets_spec=buckets_spec,
+                                          warmup=warmup)
         self.batcher = MicroBatcher(self.registry, max_batch=max_batch,
                                     max_delay_ms=max_delay_ms,
                                     queue_size=queue_size,
                                     heartbeat_path=heartbeat_path)
+        # the explain lane: its own bounded queue + worker + bucket
+        # ladder, so deadline-bounded SHAP traffic coalesces on device
+        # without starving /predict
+        self.explain_batcher = MicroBatcher(
+            self.registry, max_batch=explain_max_batch,
+            max_delay_ms=explain_max_delay_ms,
+            queue_size=explain_queue_size, mode="explain")
         server_cls = _ReusePortHTTPServer if reuse_port \
             else ThreadingHTTPServer
         self._httpd = server_cls((host, int(port)), _Handler)
@@ -179,17 +211,45 @@ class ServingApp:
                               p99_target_ms=slo_p99_ms,
                               window_s=slo_window_s,
                               burn_threshold=slo_burn)
+        # per-tenant SLO isolation (multi only): one burn monitor per
+        # model_id so one tenant's chaos fires ITS alert while siblings
+        # stay green; the flat self.slo keeps judging the whole replica
+        self.slo_by_model: Dict[str, Any] = {}
+        if self.multi:
+            self.slo_by_model = {
+                mid: SLOMonitor(availability_target=slo_availability,
+                                p99_target_ms=slo_p99_ms,
+                                window_s=slo_window_s,
+                                burn_threshold=slo_burn)
+                for mid in self.registry.model_ids()}
         # data/model quality: drift monitor + shadow audit riding the
         # batcher dispatch path; the sidecar profile follows the registry
-        # model (docs/OBSERVABILITY.md "Data & model quality")
-        self.quality = QualityMonitor(threshold=drift_threshold,
-                                      window_s=drift_window_s,
-                                      sample=quality_sample,
-                                      audit_sample=quality_audit_sample,
-                                      min_rows=quality_min_rows,
-                                      topk=quality_topk)
+        # model (docs/OBSERVABILITY.md "Data & model quality").  Multi-
+        # tenant apps run one monitor per model_id — each tenant's drift
+        # window accumulates only its own traffic — and self.quality
+        # aliases the default tenant's monitor so the flat /drift surface
+        # keeps working
+        self.quality_by_model: Dict[str, Any] = {}
+        if self.multi:
+            for mid in self.registry.model_ids():
+                self.quality_by_model[mid] = QualityMonitor(
+                    threshold=drift_threshold, window_s=drift_window_s,
+                    sample=quality_sample,
+                    audit_sample=quality_audit_sample,
+                    min_rows=quality_min_rows, topk=quality_topk)
+            self.quality = self.quality_by_model[self.registry.default_id]
+        else:
+            self.quality = QualityMonitor(threshold=drift_threshold,
+                                          window_s=drift_window_s,
+                                          sample=quality_sample,
+                                          audit_sample=quality_audit_sample,
+                                          min_rows=quality_min_rows,
+                                          topk=quality_topk)
         if self.quality.enabled:
-            self.batcher.quality = self.quality
+            if self.multi:
+                self.batcher.quality_lookup = self._quality_for
+            else:
+                self.batcher.quality = self.quality
         # per-replica drift snapshot export for the fleet report CLI
         # (set by serving.fleet's replica loop)
         self.drift_export_path: str = ""
@@ -216,13 +276,33 @@ class ServingApp:
     def draining(self) -> bool:
         return self._draining
 
+    def _quality_for(self, model_id: str):
+        """Batcher hook: route quality accumulation to the tenant's own
+        monitor (falls back to the default tenant's for legacy "")."""
+        q = self.quality_by_model.get(model_id) if model_id \
+            else self.quality
+        return q if (q is not None and q.enabled) else None
+
     def _slo_loop(self) -> None:
         while not self._slo_stop.wait(1.0):
+            # per-model monitors tick FIRST so the aggregate's gauges win
+            # the shared slo/* gauge names
+            for mon in self.slo_by_model.values():
+                mon.tick()
             self.slo.tick()
             if self.quality.enabled:
                 try:
-                    self.quality.tick(model=self.registry.current())
-                    self.quality.audit_once()
+                    if self.multi:
+                        for mid, q in self.quality_by_model.items():
+                            # peek, never current(): a 1 Hz tick must not
+                            # readmit evicted tenants or touch the LRU
+                            model = self.registry.peek(mid)
+                            if model is not None:
+                                q.tick(model=model)
+                            q.audit_once()
+                    else:
+                        self.quality.tick(model=self.registry.current())
+                        self.quality.audit_once()
                     if self.drift_export_path:
                         from ..telemetry.quality import write_snapshot
                         write_snapshot(self.drift_export_path,
@@ -233,6 +313,7 @@ class ServingApp:
     def start(self) -> "ServingApp":
         """Non-blocking start (tests, embedding); ``run_server`` blocks."""
         self.batcher.start()
+        self.explain_batcher.start()
         self._slo_thread = threading.Thread(target=self._slo_loop,
                                             name="lgbtpu-serve-slo",
                                             daemon=True)
@@ -259,6 +340,7 @@ class ServingApp:
         self._httpd.shutdown()
         self._httpd.server_close()
         self.batcher.stop(drain=drain)
+        self.explain_batcher.stop(drain=drain)
         if self.binary is not None:
             self.binary.stop()      # after the drain: futures resolved
         if self._thread is not None and self._thread.is_alive():
@@ -283,6 +365,13 @@ class ServingApp:
         drift = self.quality.brief()
         if drift is not None:
             extra["drift"] = drift
+        # per-tenant SLO isolation: the request's model_id (stamped into
+        # the response, error paths included) burns ONLY that model's
+        # window — chaos against tenant A never pages tenant B
+        mid = obj.get("model_id")
+        mon = self.slo_by_model.get(mid) if mid else None
+        if mon is not None:
+            mon.record(status, latency_ms)
         # replicas see single attempts (retries=0); the front stamps
         # real retry counts in ITS log
         note_outcome(ctx=ctx, status=status, latency_ms=latency_ms,
@@ -388,13 +477,14 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = None
         t_req = time.perf_counter()
         deadline_ms = 0.0
+        req_model_id = ""
         try:
             # the body must be consumed on EVERY branch — HTTP/1.1
             # keep-alive leaves unread bytes in rfile and the next request
             # on the connection would parse mid-body
             body = self._read_json()
             chaos.request_hook()
-            if path == "/predict":
+            if path in ("/predict", "/explain"):
                 # trace context: accept the front's (or client's) header,
                 # mint locally otherwise — the head-sampling decision is
                 # taken exactly once per request, at the outermost tier
@@ -408,10 +498,14 @@ class _Handler(BaseHTTPRequestHandler):
                                         or 0.0)
                 except (TypeError, ValueError):
                     deadline_ms = 0.0
+                req_model_id = str(body.get("model_id") or "")
                 with telemetry.request_span(
-                        ctx, "serve/predict",
+                        ctx, "serve" + path,
                         replica=self.app.replica_rank):
-                    code, obj = self._predict(body, ctx)
+                    if path == "/predict":
+                        code, obj = self._predict(body, ctx)
+                    else:
+                        code, obj = self._explain(body, ctx)
             elif path == "/reload":
                 with telemetry.span("serve/reload"):
                     code, obj = self._reload(body)
@@ -435,6 +529,10 @@ class _Handler(BaseHTTPRequestHandler):
             code, obj = 503, {"error": "shutting down"}
         except Exception as e:  # noqa: BLE001 — serving must answer
             code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+        if req_model_id:
+            # error replies carry the routing key too, so per-model SLO
+            # attribution (note_request) sees failures, not just 200s
+            obj.setdefault("model_id", req_model_id)
         if ctx is not None:
             obj.setdefault("trace_id", ctx.trace_id)
             headers[telemetry.TRACE_HEADER] = ctx.header_value()
@@ -447,14 +545,24 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, obj, headers or None)
 
     def _predict(self, body, ctx=None):
+        return self._scored(body, ctx, self.app.batcher, "predictions")
+
+    def _explain(self, body, ctx=None):
+        """Device-batched SHAP on the explain lane — the values are the
+        reference's ``pred_contrib`` contract verbatim."""
+        return self._scored(body, ctx, self.app.explain_batcher,
+                            "contributions")
+
+    def _scored(self, body, ctx, batcher, values_key: str):
         app = self.app
         if app.draining:
-            raise OverloadError(app.batcher.queue_depth(),
-                                app.batcher.queue_size, reason="draining",
+            raise OverloadError(batcher.queue_depth(),
+                                batcher.queue_size, reason="draining",
                                 retry_after_s=1.0)
         rows = body.get("rows", body.get("row"))
         if rows is None:
-            return 400, {"error": 'predict body needs "rows" (matrix) '
+            kind = "predict" if values_key == "predictions" else "explain"
+            return 400, {"error": f'{kind} body needs "rows" (matrix) '
                                   'or "row" (vector)'}
         t0 = time.perf_counter()
         # client budget: body deadline_ms overrides the server default;
@@ -464,10 +572,12 @@ class _Handler(BaseHTTPRequestHandler):
         except (TypeError, ValueError):
             return 400, {"error": "deadline_ms must be a number"}
         deadline = t0 + budget_ms / 1e3 if budget_ms > 0 else None
-        fut = app.batcher.submit(rows,
-                                 raw_score=bool(body.get("raw_score", False)),
-                                 fast=bool(body.get("fast", False)),
-                                 deadline=deadline, trace=ctx)
+        fut = batcher.submit(rows,
+                             raw_score=bool(body.get("raw_score", False)),
+                             fast=bool(body.get("fast", False)),
+                             deadline=deadline, trace=ctx,
+                             model_id=str(body.get("model_id") or "")
+                             or None)
         wait = _REQUEST_TIMEOUT_S if deadline is None else \
             max(deadline - time.perf_counter(), 0.0)
         try:
@@ -476,40 +586,52 @@ class _Handler(BaseHTTPRequestHandler):
             # the wait itself ran out the budget: report it as the same
             # structured deadline shed the batcher would have raised
             fut.cancel()
-            raise DeadlineError(app.batcher.queue_depth(),
-                                app.batcher.queue_size)
-        sha = app.registry.sha_for_version(res.model_version)
+            raise DeadlineError(batcher.queue_depth(),
+                                batcher.queue_size)
+        sha = res.sha256 or app.registry.sha_for_version(res.model_version)
         out = {
-            "predictions": _jsonable(res.values),
+            values_key: _jsonable(res.values),
             "model_version": res.model_version,
             "model_sha256": sha,
             "batched_rows": res.batched_rows,
             "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
         }
+        if res.model_id:
+            out["model_id"] = res.model_id
         if app.replica_rank is not None:
             out["replica"] = app.replica_rank
         return 200, out
 
     def _reload(self, body):
         app = self.app
-        path = str(body.get("path") or app.registry.current().path)
+        mid = str(body.get("model_id") or "")
+        if mid and not app.multi:
+            return 400, {"error": "model_id routing needs serve_models "
+                                  "(multi-tenant serving)"}
+        path = str(body.get("path")
+                   or app.registry.current(mid or None).path)
         if app.promote_fn is not None:
             # fleet replica: validate + advance the shared pointer; every
             # replica (this one included) applies it via its watcher
             try:
-                return 200, app.promote_fn(path)
+                return 200, (app.promote_fn(path, mid) if mid
+                             else app.promote_fn(path))
             except LightGBMError as e:
                 return 409, {"error": str(e),
                              "model_version": app.registry.version}
         try:
-            model = app.registry.load(path)
+            model = (app.registry.load(path, mid) if mid
+                     else app.registry.load(path))
         except LightGBMError as e:
             # the candidate was rejected; the old version keeps serving
             return 409, {"error": str(e),
                          "model_version": app.registry.version}
-        return 200, {"model_version": model.version,
-                     "num_trees": model.num_trees,
-                     "sha256": model.sha256}
+        out = {"model_version": model.version,
+               "num_trees": model.num_trees,
+               "sha256": model.sha256}
+        if mid:
+            out["model_id"] = mid
+        return 200, out
 
     def _health(self):
         """LIVENESS: is this process worth keeping alive?  Deliberately
@@ -579,7 +701,7 @@ class _Handler(BaseHTTPRequestHandler):
             out["slo_alert"] = slo_state["alert"]
             reasons.append(f"slo burn: {slo_state['alert']} error budget "
                            f"burning >= {app.slo.burn_threshold:.1f}x")
-        if app.quality.alerting:
+        if not app.multi and app.quality.alerting:
             # drift is a quality degradation, not an outage: the replica
             # keeps serving (stale != broken), the reason surfaces here
             # and the refit pipeline keys off the drift/* gauges
@@ -587,6 +709,39 @@ class _Handler(BaseHTTPRequestHandler):
             reasons.append(f"data drift: PSI >= "
                            f"{app.quality.threshold:g} vs training "
                            "reference (see /drift)")
+        if app.multi:
+            # per-tenant readiness: each model's version/sha/residency
+            # and ITS OWN alert state — one tenant's burn or drift names
+            # only that tenant in the degraded reason, siblings stay
+            # green (the isolation contract)
+            models_out: Dict[str, Any] = {}
+            for mid in app.registry.model_ids():
+                reg = app.registry.tenant(mid)
+                resident = reg.peek()
+                m: Dict[str, Any] = {
+                    "version": reg.version,
+                    "resident": resident is not None,
+                }
+                if resident is not None:
+                    m["sha256"] = resident.sha256
+                if reg.generation is not None:
+                    m["generation"] = reg.generation
+                if reg.seen_generation is not None:
+                    m["seen_generation"] = reg.seen_generation
+                mon = app.slo_by_model.get(mid)
+                if mon is not None:
+                    mstate = mon.state()
+                    if mstate["alerting"]:
+                        m["slo_alert"] = mstate["alert"]
+                        reasons.append(
+                            f"model {mid}: slo burn {mstate['alert']}")
+                q = app.quality_by_model.get(mid)
+                if q is not None and q.alerting:
+                    m["drift_alert"] = True
+                    reasons.append(f"model {mid}: data drift (PSI >= "
+                                   f"{q.threshold:g})")
+                models_out[mid] = m
+            out["models"] = models_out
         if reasons:
             out["degraded"] = "; ".join(reasons)
         if b.heartbeat_path:
@@ -599,7 +754,7 @@ class _Handler(BaseHTTPRequestHandler):
         from .. import telemetry
 
         app = self.app
-        return {
+        out = {
             "uptime_s": round(time.time() - app.t0, 3),
             "registry": app.registry.stats(),
             "queue_depth": app.batcher.queue_depth(),
@@ -607,6 +762,15 @@ class _Handler(BaseHTTPRequestHandler):
             "batches": app.batcher.batches,
             "rejected": app.batcher.rejected,
             "deadline_expired": app.batcher.expired,
+            "explain": {
+                "served": app.explain_batcher.served,
+                "batches": app.explain_batcher.batches,
+                "rejected": app.explain_batcher.rejected,
+                "deadline_expired": app.explain_batcher.expired,
+                "queue_depth": app.explain_batcher.queue_depth(),
+                "dispatch": telemetry.quantiles(
+                    "serve/explain/dispatch_s"),
+            },
             "degraded": app.degraded,
             "generation": app.generation,
             "latency": telemetry.quantiles("serve/latency_s"),
@@ -631,6 +795,15 @@ class _Handler(BaseHTTPRequestHandler):
             "binary": (app.binary.stats() if app.binary is not None
                        else None),
         }
+        if app.multi:
+            out["slo_models"] = {
+                mid: {"alerting": mon.state()["alerting"],
+                      "alert": mon.state()["alert"]}
+                for mid, mon in app.slo_by_model.items()}
+            out["quality_models"] = {
+                mid: {"alerting": q.alerting}
+                for mid, q in app.quality_by_model.items()}
+        return out
 
 
 def serve_from_params(params: Dict[str, Any]) -> ServingApp:
@@ -639,10 +812,17 @@ def serve_from_params(params: Dict[str, Any]) -> ServingApp:
 
     cfg = Config.from_params(params)
     model_path = str(params.get("input_model", "") or "")
-    if not model_path:
-        raise LightGBMError("task=serve requires input_model=<model file>")
+    if not model_path and not cfg.serve_models:
+        raise LightGBMError("task=serve requires input_model=<model file> "
+                            "or serve_models=<id=path,...>")
     return ServingApp(
         model_path,
+        models=cfg.serve_models or None,
+        hbm_budget_mb=cfg.serve_hbm_budget_mb,
+        default_model_id=cfg.serve_default_model,
+        explain_max_batch=cfg.serve_explain_max_batch,
+        explain_queue_size=cfg.serve_explain_queue_size,
+        explain_max_delay_ms=cfg.serve_explain_max_delay_ms,
         host=cfg.serve_host, port=cfg.serve_port,
         max_batch=cfg.serve_max_batch,
         max_delay_ms=cfg.serve_max_delay_ms,

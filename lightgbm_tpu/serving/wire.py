@@ -13,15 +13,24 @@ any number of frames in flight and responses match on ``request_id``
 Frame layout (all little-endian; docs/SERVING.md "Binary wire protocol"):
 
   handshake  client->server then server->client, 8 bytes each:
-             ``b"LGBW"`` + u8 version (1) + 3 reserved zero bytes.
+             ``b"LGBW"`` + u8 version + 3 reserved zero bytes.  The
+             client sends the highest version it speaks; the server
+             echoes the NEGOTIATED version ``min(client, server)`` and
+             the rest of the connection runs at it.  A hello the server
+             cannot negotiate down (version 0) draws a structured rid-0
+             bad_request refusal frame, then a close; a wrong-magic
+             hello is not our protocol at all and closes silently.  A
+             v1-only server silently closes a v2 hello — clients
+             downgrade-retry on a fresh connection with a v1 hello.
 
   request    u32 length            bytes AFTER this field
              u32 request_id        echoed verbatim in the response
-             u8  op                1 = predict
+             u8  op                1 = predict | 2 = explain (v2)
              u8  flags             1 raw_score | 2 fast | 4 trace attached
              u16 n_cols
              u32 n_rows
              f32 deadline_ms       0 = server default (serve_deadline_ms)
+             [u8 model_len + ascii model_id]   v2 only; len 0 = default
              f32 x n_rows*n_cols   row-major feature values
              [u8 trace_len + trace bytes]   iff flags & 4 — the same
              ``<trace_id>[;s=0|1]`` context the X-LGBTPU-Trace header
@@ -37,6 +46,7 @@ Frame layout (all little-endian; docs/SERVING.md "Binary wire protocol"):
              u32 n_rows            (ok), else 0
              u32 model_version
              f32 retry_after_s     backoff hint on sheds, else 0
+             [u8 model_len + ascii model_id]   v2 only (every status)
              [sha_len sha hex bytes][f64 x n_rows*k predictions]   (ok)
              [u16 msg_len + utf8 message]                          (error)
 
@@ -73,14 +83,21 @@ from ..utils.log import LightGBMError, log_debug, log_info
 from .batcher import DeadlineError, OverloadError
 
 MAGIC = b"LGBW"
-VERSION = 1
+VERSION = 2                      # current: model-id routing + explain op
+VERSION_MIN = 1                  # still negotiated for pre-v2 clients
 HANDSHAKE = MAGIC + bytes([VERSION, 0, 0, 0])
+HANDSHAKE_V1 = MAGIC + bytes([1, 0, 0, 0])
+
+
+def handshake(version: int = VERSION) -> bytes:
+    return MAGIC + bytes([version, 0, 0, 0])
 MAX_FRAME = 8 * 2 ** 20          # request bytes after the length prefix
 # responses can legally outgrow requests (f32 rows in, f64 x num_class
 # predictions out), so the client-side bound is wider: 2x for the dtype
 # plus headroom for num_class > n_cols models and the sha/header tail
 MAX_RESP_FRAME = 8 * MAX_FRAME
 OP_PREDICT = 1
+OP_EXPLAIN = 2                   # v2: device-batched SHAP contributions
 
 FLAG_RAW = 1
 FLAG_FAST = 2
@@ -108,11 +125,21 @@ class WireError(LightGBMError):
 # codec
 # ---------------------------------------------------------------------------
 
+def _model_field(model_id: str) -> bytes:
+    mb = str(model_id or "").encode("ascii", errors="replace")[:255]
+    return bytes([len(mb)]) + mb
+
+
 def encode_request(request_id: int, rows: np.ndarray, *,
                    raw_score: bool = False, fast: bool = False,
                    deadline_ms: float = 0.0,
-                   trace: Optional[str] = None) -> bytes:
+                   trace: Optional[str] = None,
+                   model_id: str = "", op: int = OP_PREDICT,
+                   version: int = VERSION) -> bytes:
     """One request frame (length prefix included)."""
+    if version < 2 and (model_id or op != OP_PREDICT):
+        raise WireError(
+            "model_id / explain need wire v2; connection negotiated v1")
     rows = np.ascontiguousarray(rows, dtype="<f4")
     if rows.ndim == 1:
         rows = rows.reshape(1, -1)
@@ -123,24 +150,38 @@ def encode_request(request_id: int, rows: np.ndarray, *,
         tb = str(trace).encode("utf-8")[:255]
         tail = bytes([len(tb)]) + tb
         flags |= FLAG_TRACE
-    body = (_REQ_HEAD.pack(request_id & 0xFFFFFFFF, OP_PREDICT, flags,
+    mid = _model_field(model_id) if version >= 2 else b""
+    body = (_REQ_HEAD.pack(request_id & 0xFFFFFFFF, op, flags,
                            c, n, float(deadline_ms))
-            + rows.tobytes() + tail)
+            + mid + rows.tobytes() + tail)
     return _LEN.pack(len(body)) + body
 
 
-def parse_request(payload: bytes) -> Dict[str, Any]:
-    """Decode a request frame body (everything after the length prefix).
-    Raises :class:`WireError` on any malformation."""
+def parse_request(payload: bytes, version: int = VERSION) -> Dict[str, Any]:
+    """Decode a request frame body (everything after the length prefix)
+    at the connection's negotiated ``version``.  Raises
+    :class:`WireError` on any malformation."""
     if len(payload) < _REQ_HEAD.size:
         raise WireError(f"request frame too short ({len(payload)} < "
                         f"{_REQ_HEAD.size} header bytes)")
     req_id, op, flags, ncols, nrows, deadline_ms = _REQ_HEAD.unpack_from(
         payload)
-    if op != OP_PREDICT:
+    if op not in (OP_PREDICT, OP_EXPLAIN):
         raise WireError(f"unknown wire op {op}")
-    want = nrows * ncols * 4
+    if op == OP_EXPLAIN and version < 2:
+        raise WireError("explain op needs wire v2")
     off = _REQ_HEAD.size
+    model_id = ""
+    if version >= 2:
+        if len(payload) < off + 1:
+            raise WireError("v2 frame missing the model-id field")
+        ml = payload[off]
+        if len(payload) < off + 1 + ml:
+            raise WireError("model-id bytes truncated")
+        model_id = payload[off + 1:off + 1 + ml].decode("ascii",
+                                                        errors="replace")
+        off += 1 + ml
+    want = nrows * ncols * 4
     if len(payload) < off + want:
         raise WireError(
             f"request frame payload short: {nrows}x{ncols} f32 rows need "
@@ -157,14 +198,17 @@ def parse_request(payload: bytes) -> Dict[str, Any]:
             raise WireError("trace bytes truncated")
         trace = payload[off + 1:off + 1 + tl].decode("utf-8",
                                                      errors="replace")
-    return {"request_id": req_id, "rows": rows,
+    return {"request_id": req_id, "rows": rows, "op": op,
             "raw_score": bool(flags & FLAG_RAW),
             "fast": bool(flags & FLAG_FAST),
-            "deadline_ms": float(deadline_ms), "trace": trace}
+            "deadline_ms": float(deadline_ms), "trace": trace,
+            "model_id": model_id}
 
 
 def encode_response_ok(request_id: int, values: np.ndarray,
-                       model_version: int, sha256: str) -> bytes:
+                       model_version: int, sha256: str,
+                       model_id: str = "",
+                       version: int = VERSION) -> bytes:
     v = np.ascontiguousarray(values, dtype="<f8")
     if v.ndim == 1:
         n, k = v.shape[0], 1
@@ -173,30 +217,43 @@ def encode_response_ok(request_id: int, values: np.ndarray,
     if k > 0xFFFF:
         raise WireError(f"num_class {k} exceeds the wire's u16 field")
     sha_b = (sha256 or "").encode("ascii")[:255]
+    mid = _model_field(model_id) if version >= 2 else b""
     body = (_RESP_HEAD.pack(request_id & 0xFFFFFFFF, ST_OK, len(sha_b), k,
                             n, int(model_version), 0.0)
-            + sha_b + v.tobytes())
+            + mid + sha_b + v.tobytes())
     return _LEN.pack(len(body)) + body
 
 
 def encode_response_error(request_id: int, status: int, message: str,
-                          retry_after_s: float = 0.0) -> bytes:
+                          retry_after_s: float = 0.0,
+                          model_id: str = "",
+                          version: int = VERSION) -> bytes:
     mb = str(message).encode("utf-8")[:2048]
+    mid = _model_field(model_id) if version >= 2 else b""
     body = (_RESP_HEAD.pack(request_id & 0xFFFFFFFF, status, 0, 0, 0, 0,
                             float(retry_after_s))
-            + struct.pack("<H", len(mb)) + mb)
+            + mid + struct.pack("<H", len(mb)) + mb)
     return _LEN.pack(len(body)) + body
 
 
-def parse_response(payload: bytes) -> Dict[str, Any]:
+def parse_response(payload: bytes, version: int = VERSION) -> Dict[str, Any]:
     if len(payload) < _RESP_HEAD.size:
         raise WireError(f"response frame too short ({len(payload)})")
-    (req_id, status, sha_len, k, nrows, version,
+    (req_id, status, sha_len, k, nrows, version_m,
      retry_after) = _RESP_HEAD.unpack_from(payload)
     off = _RESP_HEAD.size
     out: Dict[str, Any] = {"request_id": req_id, "status": status,
-                           "model_version": version,
-                           "retry_after_s": retry_after}
+                           "model_version": version_m,
+                           "retry_after_s": retry_after, "model_id": ""}
+    if version >= 2:
+        if len(payload) < off + 1:
+            raise WireError("v2 response missing the model-id field")
+        ml = payload[off]
+        if len(payload) < off + 1 + ml:
+            raise WireError("response model-id bytes truncated")
+        out["model_id"] = payload[off + 1:off + 1 + ml].decode(
+            "ascii", errors="replace")
+        off += 1 + ml
     if status == ST_OK:
         if len(payload) < off + sha_len + nrows * k * 8:
             raise WireError("ok response frame truncated")
@@ -370,11 +427,28 @@ class BinaryServer:
             self.connections += 1
         telemetry.inc("serve/bin_connections")
         f = sock.makefile("rb", buffering=256 * 1024)
+        ver = VERSION
         try:
             hello = _read_exact(f, len(HANDSHAKE))
-            if hello is None or hello[:4] != MAGIC or hello[4] != VERSION:
-                return     # not our protocol (or wrong version): close
-            sock.sendall(HANDSHAKE)
+            if hello is None or hello[:4] != MAGIC:
+                return     # not our protocol at all: silent close
+            if hello[4] < VERSION_MIN:
+                # correct magic, a version we cannot negotiate down to:
+                # a STRUCTURED refusal (satellite contract — old/broken
+                # peers learn why), then close
+                with self._lock:
+                    self.bad_frames += 1
+                telemetry.inc("serve/bin_bad_frames")
+                conn.send(encode_response_error(
+                    0, ST_BAD_REQUEST,
+                    f"unsupported wire version {hello[4]} "
+                    f"(supported {VERSION_MIN}..{VERSION})",
+                    version=VERSION_MIN))
+                return
+            # negotiate: run the connection at min(client, server) and
+            # echo that version so the client knows what it got
+            ver = min(int(hello[4]), VERSION)
+            sock.sendall(handshake(ver))
             while not conn.closed:
                 head = f.read(_LEN.size)
                 if not head:
@@ -391,12 +465,13 @@ class BinaryServer:
                     conn.send(encode_response_error(
                         0, ST_BAD_REQUEST,
                         f"frame length {length} outside "
-                        f"[{_REQ_HEAD.size}, {self.max_frame}]"))
+                        f"[{_REQ_HEAD.size}, {self.max_frame}]",
+                        version=ver))
                     return
                 payload = _read_exact(f, length)
                 if payload is None:
                     raise WireError("connection closed after length prefix")
-                self._handle_frame(conn, payload)
+                self._handle_frame(conn, payload, ver)
         except WireError as e:
             with self._lock:
                 self.bad_frames += 1
@@ -418,26 +493,39 @@ class BinaryServer:
                 except ValueError:
                     pass
 
-    def _handle_frame(self, conn: _Conn, payload: bytes) -> None:
+    def _handle_frame(self, conn: _Conn, payload: bytes,
+                      ver: int = VERSION) -> None:
         from .. import telemetry
 
         try:
-            req = parse_request(payload)
+            req = parse_request(payload, version=ver)
         except WireError as e:
             with self._lock:
                 self.bad_frames += 1
             telemetry.inc("serve/bin_bad_frames")
-            conn.send(encode_response_error(0, ST_BAD_REQUEST, str(e)))
+            conn.send(encode_response_error(0, ST_BAD_REQUEST, str(e),
+                                            version=ver))
             return
         rid = req["request_id"]
+        mid = req["model_id"]
         with self._lock:
             self.requests += 1
         chaos.request_hook()     # may raise DropConnection (handled above)
         app = self.app
         if app.draining:
             conn.send(encode_response_error(rid, ST_DRAINING,
-                                            "shutting down", 1.0))
+                                            "shutting down", 1.0,
+                                            model_id=mid, version=ver))
             return
+        batcher = app.batcher
+        if req["op"] == OP_EXPLAIN:
+            batcher = getattr(app, "explain_batcher", None)
+            if batcher is None:
+                conn.send(encode_response_error(
+                    rid, ST_BAD_REQUEST,
+                    "explain is not enabled on this server",
+                    model_id=mid, version=ver))
+                return
         ctx = None
         if req["trace"]:
             ctx = telemetry.TraceContext.from_header(req["trace"])
@@ -446,25 +534,31 @@ class BinaryServer:
                     if budget_ms and budget_ms > 0 else None)
         rows = np.asarray(req["rows"], np.float64)
         try:
-            fut = app.batcher.submit(
+            fut = batcher.submit(
                 rows, raw_score=req["raw_score"],
                 fast=req["fast"] and rows.shape[0] == 1,
-                deadline=deadline, trace=ctx)
+                deadline=deadline, trace=ctx,
+                model_id=mid or None)
         except DeadlineError as e:
             conn.send(encode_response_error(rid, ST_DEADLINE, str(e),
-                                            e.retry_after_s))
+                                            e.retry_after_s,
+                                            model_id=mid, version=ver))
             return
         except OverloadError as e:
             conn.send(encode_response_error(rid, ST_OVERLOAD, str(e),
-                                            e.retry_after_s))
+                                            e.retry_after_s,
+                                            model_id=mid, version=ver))
             return
         except LightGBMError as e:
-            conn.send(encode_response_error(rid, ST_BAD_REQUEST, str(e)))
+            conn.send(encode_response_error(rid, ST_BAD_REQUEST, str(e),
+                                            model_id=mid, version=ver))
             return
         fut.add_done_callback(
-            lambda fu, c=conn, r=rid: self._reply(c, r, fu))
+            lambda fu, c=conn, r=rid, m=mid, v=ver:
+            self._reply(c, r, fu, m, v))
 
-    def _reply(self, conn: _Conn, rid: int, fut) -> None:
+    def _reply(self, conn: _Conn, rid: int, fut, mid: str = "",
+               ver: int = VERSION) -> None:
         """Resolve one future into a response frame (runs on whichever
         thread resolved the future — encode is microseconds, the send is
         a bounded-queue handoff)."""
@@ -472,23 +566,31 @@ class BinaryServer:
 
         try:
             res = fut.result(timeout=0)
-            sha = self.app.registry.sha_for_version(res.model_version) or ""
+            sha = (res.sha256
+                   or self.app.registry.sha_for_version(res.model_version)
+                   or "")
             frame = encode_response_ok(rid, res.values, res.model_version,
-                                       sha)
+                                       sha, model_id=res.model_id or mid,
+                                       version=ver)
         except DeadlineError as e:
             frame = encode_response_error(rid, ST_DEADLINE, str(e),
-                                          e.retry_after_s)
+                                          e.retry_after_s,
+                                          model_id=mid, version=ver)
         except OverloadError as e:
             frame = encode_response_error(rid, ST_OVERLOAD, str(e),
-                                          e.retry_after_s)
+                                          e.retry_after_s,
+                                          model_id=mid, version=ver)
         except LightGBMError as e:
-            frame = encode_response_error(rid, ST_BAD_REQUEST, str(e))
+            frame = encode_response_error(rid, ST_BAD_REQUEST, str(e),
+                                          model_id=mid, version=ver)
         except CancelledError:
             frame = encode_response_error(rid, ST_DRAINING,
-                                          "shutting down", 1.0)
+                                          "shutting down", 1.0,
+                                          model_id=mid, version=ver)
         except Exception as e:  # noqa: BLE001 — the wire must answer
             frame = encode_response_error(rid, ST_ERROR,
-                                          f"{type(e).__name__}: {e}")
+                                          f"{type(e).__name__}: {e}",
+                                          model_id=mid, version=ver)
             telemetry.inc("serve/bin_errors")
         conn.send(frame)
 
@@ -504,16 +606,54 @@ class BinaryClient:
     of requests before reading any response — the shape that saturates
     the micro-batcher (responses are matched back by request_id)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, int(port)),
-                                             timeout=timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.sock.sendall(HANDSHAKE)
-        self._f = self.sock.makefile("rb", buffering=256 * 1024)
-        hello = _read_exact(self._f, len(HANDSHAKE))
-        if hello is None or hello[:4] != MAGIC:
-            raise WireError("server did not answer the wire handshake")
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 version: int = VERSION):
+        self._host, self._port, self._timeout = host, int(port), timeout
+        self.version = int(version)
+        try:
+            self._connect(self.version)
+        except (OSError, WireError):
+            if self.version <= VERSION_MIN:
+                raise
+            # downgrade retry: a v1-only server silently closes an
+            # unknown-version hello — reconnect speaking v1
+            self.version = VERSION_MIN
+            self._connect(self.version)
         self._next_id = 0
+
+    def _connect(self, version: int) -> None:
+        self.sock = socket.create_connection((self._host, self._port),
+                                             timeout=self._timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.sendall(handshake(version))
+        self._f = self.sock.makefile("rb", buffering=256 * 1024)
+        hello = None
+        try:
+            hello = _read_exact(self._f, len(HANDSHAKE))
+        except WireError:
+            pass
+        if hello is None or len(hello) < len(HANDSHAKE):
+            self.close()
+            raise WireError("server closed the wire handshake "
+                            f"(no v{version} support?)")
+        if hello[:4] != MAGIC:
+            # maybe a structured rid-0 refusal frame: its first 4 bytes
+            # are a length prefix — try to surface the server's reason
+            msg = "server did not answer the wire handshake"
+            try:
+                (length,) = _LEN.unpack(hello[:4])
+                if _RESP_HEAD.size <= length <= MAX_RESP_FRAME:
+                    rest = _read_exact(self._f, length - 4)
+                    resp = parse_response(hello[4:] + (rest or b""),
+                                          version=VERSION_MIN)
+                    if resp.get("error"):
+                        msg = f"server refused handshake: {resp['error']}"
+            except (WireError, struct.error):
+                pass
+            self.close()
+            raise WireError(msg)
+        # the server echoes the NEGOTIATED version; run the codec at it
+        self.version = min(int(hello[4]) or VERSION_MIN, version)
 
     def close(self) -> None:
         try:
@@ -533,13 +673,15 @@ class BinaryClient:
 
     def send_request(self, rows, *, raw_score: bool = False,
                      fast: bool = False, deadline_ms: float = 0.0,
-                     trace: Optional[str] = None) -> int:
+                     trace: Optional[str] = None, model_id: str = "",
+                     op: int = OP_PREDICT) -> int:
         self._next_id = (self._next_id + 1) & 0xFFFFFFFF
         rid = self._next_id
         self.sock.sendall(encode_request(rid, np.asarray(rows),
                                          raw_score=raw_score, fast=fast,
                                          deadline_ms=deadline_ms,
-                                         trace=trace))
+                                         trace=trace, model_id=model_id,
+                                         op=op, version=self.version))
         return rid
 
     def read_response(self) -> Dict[str, Any]:
@@ -552,21 +694,31 @@ class BinaryClient:
         payload = _read_exact(self._f, length)
         if payload is None:
             raise WireError("response frame truncated")
-        return parse_response(payload)
+        return parse_response(payload, version=self.version)
 
     def request(self, rows, *, raw_score: bool = False, fast: bool = False,
                 deadline_ms: float = 0.0,
-                trace: Optional[str] = None) -> Dict[str, Any]:
+                trace: Optional[str] = None, model_id: str = "",
+                op: int = OP_PREDICT) -> Dict[str, Any]:
         rid = self.send_request(rows, raw_score=raw_score, fast=fast,
-                                deadline_ms=deadline_ms, trace=trace)
+                                deadline_ms=deadline_ms, trace=trace,
+                                model_id=model_id, op=op)
         while True:
             resp = self.read_response()
             if resp["request_id"] == rid or resp["request_id"] == 0:
                 return resp
 
+    def explain(self, rows, *, deadline_ms: float = 0.0,
+                model_id: str = "") -> Dict[str, Any]:
+        """SHAP contributions over the wire (v2 ``op=explain``) — the
+        ``pred_contrib`` contract, k*(n_features+1) values per row."""
+        return self.request(rows, deadline_ms=deadline_ms,
+                            model_id=model_id, op=OP_EXPLAIN)
+
     def pipeline(self, bodies: List[np.ndarray], *,
                  raw_score: bool = False,
-                 deadline_ms: float = 0.0) -> List[Dict[str, Any]]:
+                 deadline_ms: float = 0.0,
+                 model_id: str = "") -> List[Dict[str, Any]]:
         """Send every body back to back, then collect every response
         (responses may arrive out of order; returned in request order)."""
         ids = []
@@ -576,7 +728,9 @@ class BinaryClient:
             ids.append(self._next_id)
             frames.append(encode_request(self._next_id, np.asarray(rows),
                                          raw_score=raw_score,
-                                         deadline_ms=deadline_ms))
+                                         deadline_ms=deadline_ms,
+                                         model_id=model_id,
+                                         version=self.version))
         self.sock.sendall(b"".join(frames))
         got: Dict[int, Dict[str, Any]] = {}
         want = set(ids)
@@ -661,11 +815,14 @@ class FleetBinaryClient:
         return c
 
     def request(self, rows, *, raw_score: bool = False,
-                deadline_ms: float = 2000.0) -> Dict[str, Any]:
+                deadline_ms: float = 2000.0,
+                model_id: str = "") -> Dict[str, Any]:
         """Returns the wire response dict; transport failures surface as
         ``{"status": ST_OVERLOAD, "error": "retries_exhausted"}`` after
         the bounded route-around (the HTTP front's structured-503
-        analog)."""
+        analog).  ``model_id`` routes to a tenant on v2 replicas; a v1
+        replica that negotiated down refuses it with a WireError, which
+        the route-around treats as a transport failure and diverts."""
         t_end = time.perf_counter() + deadline_ms / 1e3
         tried: set = set()
         last: Optional[Dict[str, Any]] = None
@@ -690,7 +847,8 @@ class FleetBinaryClient:
             try:
                 c = self._conn(rank, eps[rank], per_timeout)
                 resp = c.request(rows, raw_score=raw_score,
-                                 deadline_ms=remaining * 1e3)
+                                 deadline_ms=remaining * 1e3,
+                                 model_id=model_id)
             except (OSError, WireError):
                 # killed/hung/reset replica: drop the conn (a late reply
                 # would desync it), cool the replica down, go elsewhere

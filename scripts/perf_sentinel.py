@@ -100,13 +100,27 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             os.environ.pop("LGBTPU_INGEST_SHIP", None)
         else:
             os.environ["LGBTPU_INGEST_SHIP"] = ship_env
-    # serving entry: the bucketed compiled predictor (serve_predict)
+    # serving entries: the bucketed compiled predictor (serve_predict)
+    # and the stacked multi-tenant dispatch (serve_predict_multi) — two
+    # same-shape tenants through ONE grouped window, so the stacked
+    # program's cost is attributable on the same fixed workload
     with tempfile.TemporaryDirectory(prefix="lgb_sentinel_") as td:
         path = os.path.join(td, "model.txt")
         bst.save_model(path)
         from lightgbm_tpu.serving.registry import ModelRegistry
         reg = ModelRegistry(path, max_batch=64)
         reg.current().predict(X[:8], raw_score=True)
+        import shutil
+        from lightgbm_tpu.serving.multimodel import MultiModelRegistry
+        path_b = os.path.join(td, "model_b.txt")
+        shutil.copy(path, path_b)
+        sidecar = path + ".quality.json"
+        if os.path.exists(sidecar):
+            shutil.copy(sidecar, path_b + ".quality.json")
+        mreg = MultiModelRegistry({"a": path, "b": path_b},
+                                  max_batch=64, warmup=False)
+        mreg.raw_scores_grouped([(mreg.current("a"), X[:8]),
+                                 (mreg.current("b"), X[:8])])
     from lightgbm_tpu.telemetry import global_registry
     recs = [r for r in global_registry.records
             if r.get("event") == "iteration" and "launches" in r]

@@ -38,6 +38,14 @@ python -m pytest tests/test_serving.py tests/test_wire.py -x -q -m 'not slow'
 # poisoned-candidate fleet-wide reload (docs/SERVING.md fleet section)
 echo "=== stage: serving fleet fast tier ==="
 python -m pytest tests/test_fleet.py -x -q -m 'not slow'
+# multi-tenant serving fast tier: the HBM-resident multi-model cache
+# (LRU evict / manifest-verified readmit, evict-path in-flight drain),
+# per-tenant routing bitwise over HTTP + stacked dispatch with zero
+# fresh traces, per-model SLO/drift isolation, /explain pred_contrib
+# contract, and per-tenant promotion pointer keying
+# (docs/SERVING.md "Multi-tenant serving")
+echo "=== stage: multi-tenant serving fast tier ==="
+python -m pytest tests/test_multimodel.py -x -q -m 'not slow'
 # data/model quality fast tier: the train-time quality sidecar (binned
 # feature profile + score histogram), the PSI/JS drift monitor's
 # fire/clear state machine, the bitwise train-vs-serve shadow audit, and
@@ -198,6 +206,18 @@ BENCH_FLEET_SECS="${BENCH_FLEET_SECS:-8}" \
 echo "=== stage: pipeline chaos bench smoke (BENCH_TASK=pipeline) ==="
 BENCH_TASK=pipeline \
 BENCH_PIPELINE_SMOKE=1 \
+BENCH_HISTORY=0 \
+    python bench.py
+# multi-tenant serving bench (reduced-size smoke): N same-shape tenants
+# take mixed wire-v2 + /explain traffic bitwise-checked per tenant with
+# ZERO fresh traces after warmup, the cache budget squeeze churns LRU
+# evict/readmit under load with zero non-503 errors, and ONE
+# pipeline_model_id promotion (+ a refused poisoned candidate) leaves
+# the sibling tenant bitwise; BENCH_MULTIMODEL_SMOKE=1 never clobbers
+# the committed BENCH_MULTIMODEL.json artifact
+echo "=== stage: multi-tenant bench smoke (BENCH_TASK=multimodel) ==="
+BENCH_TASK=multimodel \
+BENCH_MULTIMODEL_SMOKE=1 \
 BENCH_HISTORY=0 \
     python bench.py
 # native sanitizer tier: builds native/binner.cpp under ASan/UBSan and

@@ -340,3 +340,145 @@ def test_binary_draining_refusal(tmp_path):
         c.close()
         app.shutdown(drain=True)
         time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# v2 negotiation + model-id routing fuzz (multi-tenant wire)
+# ---------------------------------------------------------------------------
+
+def test_v2_model_id_codec_roundtrip():
+    rows = np.arange(8, dtype=np.float64).reshape(2, 4)
+    frame = wire.encode_request(7, rows, model_id="tenant-a",
+                                op=wire.OP_EXPLAIN)
+    req = wire.parse_request(frame[4:])
+    assert req["model_id"] == "tenant-a"
+    assert req["op"] == wire.OP_EXPLAIN
+    ok = wire.encode_response_ok(7, np.zeros(2), 3, "a" * 64,
+                                 model_id="tenant-a")
+    (length,) = struct.unpack_from("<I", ok)
+    resp = wire.parse_response(ok[4:4 + length])
+    assert resp["model_id"] == "tenant-a"
+    err = wire.encode_response_error(7, wire.ST_OVERLOAD, "busy",
+                                     retry_after_s=0.5, model_id="t")
+    (length,) = struct.unpack_from("<I", err)
+    assert wire.parse_response(err[4:4 + length])["model_id"] == "t"
+
+
+def test_v1_codec_refuses_v2_features():
+    rows = np.ones((1, 3))
+    with pytest.raises(WireError, match="wire v2"):
+        wire.encode_request(1, rows, model_id="a", version=1)
+    with pytest.raises(WireError, match="wire v2"):
+        wire.encode_request(1, rows, op=wire.OP_EXPLAIN, version=1)
+    # v1 frames carry no model field and still roundtrip
+    frame = wire.encode_request(1, rows, version=1)
+    req = wire.parse_request(frame[4:], version=1)
+    assert req["model_id"] == "" and req["op"] == wire.OP_PREDICT
+
+
+def test_version0_hello_structured_refusal(servebin):
+    """A hello below VERSION_MIN draws a structured rid-0 refusal frame
+    (not a silent close): the client can surface WHY it was refused."""
+    app, X, _ = servebin
+    s = socket.create_connection((app.host, app.binary_port), timeout=10)
+    s.sendall(wire.MAGIC + bytes([0, 0, 0, 0]))
+    f = s.makefile("rb")
+    head = f.read(4)
+    assert len(head) == 4, "server closed without a refusal frame"
+    (length,) = struct.unpack("<I", head)
+    resp = wire.parse_response(f.read(length), version=1)
+    assert resp["request_id"] == 0
+    assert resp["status"] == wire.ST_BAD_REQUEST
+    assert "version" in resp["error"]
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_v1_client_on_v2_server(servebin):
+    """Explicit v1 clients negotiate down and keep working unchanged."""
+    app, X, ref = servebin
+    with BinaryClient(app.host, app.binary_port, version=1) as c:
+        assert c.version == 1
+        resp = c.request(X[:5], raw_score=True)
+        assert resp["status"] == wire.ST_OK
+        assert np.array_equal(resp["predictions"],
+                              ref.predict(X[:5], raw_score=True))
+
+
+def test_v2_client_downgrades_to_v1_only_server():
+    """A pre-v2 replica silently closes an unknown hello; the client
+    must retry the handshake at v1 on a fresh connection, not fail."""
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                hello = conn.recv(8)
+                if len(hello) < 8 or hello[4] != 1:
+                    conn.close()      # v1-only server: unknown hello
+                    continue
+                conn.sendall(wire.handshake(1))
+                f = conn.makefile("rb")
+                head = f.read(4)
+                (length,) = struct.unpack("<I", head)
+                req = wire.parse_request(f.read(length), version=1)
+                conn.sendall(wire.encode_response_ok(
+                    req["request_id"], np.zeros(req["rows"].shape[0]),
+                    1, "f" * 64, version=1))
+            except (OSError, WireError):
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        c = BinaryClient("127.0.0.1", port)
+        assert c.version == 1        # downgraded after the silent close
+        resp = c.request(np.ones((3, 2)))
+        assert resp["status"] == wire.ST_OK
+        assert resp["model_version"] == 1
+        c.close()
+    finally:
+        stop.set()
+        srv.close()
+        t.join(2)
+
+
+def test_fuzz_truncated_model_field(servebin):
+    """A v2 frame whose model-id length byte overruns the payload is a
+    structured bad-request, never a wedged or crashed worker."""
+    app, X, _ = servebin
+    s = _raw_conn(app)
+    rows = np.ascontiguousarray(X[:2], dtype="<f4")
+    head = struct.pack("<IBBHIf", 9, wire.OP_PREDICT, 0,
+                       rows.shape[1], rows.shape[0], 0.0)
+    payload = head + bytes([200]) + b"ab"   # claims 200 bytes, has 2
+    s.sendall(struct.pack("<I", len(payload)) + payload)
+    f = s.makefile("rb")
+    (length,) = struct.unpack("<I", f.read(4))
+    resp = wire.parse_response(f.read(length))
+    assert resp["status"] == wire.ST_BAD_REQUEST
+    s.close()
+    _assert_still_serving(app, X)
+
+
+def test_wire_unknown_model_id_refusal(servebin):
+    """model_id routing on a single-model server: a structured refusal
+    naming the unknown tenant, and the connection stays usable."""
+    app, X, _ = servebin
+    with BinaryClient(app.host, app.binary_port) as c:
+        resp = c.request(X[:2], model_id="no-such-tenant")
+        assert resp["status"] == wire.ST_BAD_REQUEST
+        assert "model_id" in resp["error"]
+        assert c.request(X[:2])["status"] == wire.ST_OK
