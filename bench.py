@@ -1330,7 +1330,16 @@ def _multichip_child() -> bool:
         "use_quantized_grad": True, "num_grad_quant_bins": 64,
         "hist_backend": "stream", "telemetry": True,
     }
-    if n_dev > 1:
+    mesh2d = os.environ.get("BENCH_MC_MESH", "")
+    if mesh2d:
+        # 2D rows x feature-groups arm (BENCH_MULTICHIP_MESH=2x2,2x4):
+        # contraction backend — the stream kernel cannot slice its packed
+        # row-major group words over the feature axis
+        r2, f2 = (int(v) for v in mesh2d.lower().split("x"))
+        params.update({"tree_learner": "data",
+                       "mesh_shape": f"data:{r2},feature:{f2}",
+                       "hist_backend": "auto"})
+    elif n_dev > 1:
         # mesh_shape pins the mesh to the first n devices, so the 1-device
         # baseline and the full-mesh runs share one process environment
         params.update({"tree_learner": "data",
@@ -1384,9 +1393,12 @@ def run_multichip_bench() -> bool:
     """BENCH_MULTICHIP=1: MEASURED data-parallel training — s/tree at 1 vs
     D devices, the scaling-efficiency trajectory over a device sweep
     (BENCH_MULTICHIP_SWEEP, default 4,8,16), launches/round for the fused
-    vs unfused iteration (LGBTPU_FUSE_ITER A/B), and per-round histogram
-    comms bytes for both hist_comms modes (docs/DISTRIBUTED.md), AUC-gated
-    like the main HIGGS run.  Each configuration runs in a subprocess so
+    vs unfused iteration (LGBTPU_FUSE_ITER A/B), per-round histogram
+    comms bytes for both hist_comms modes (docs/DISTRIBUTED.md), and —
+    when BENCH_MULTICHIP_MESH=2x2,2x4 names RxF shapes — the 2D rows x
+    feature-groups arms with scaling efficiency vs the 1D arms, AUC-gated
+    like the main HIGGS run (BENCH_MULTICHIP.json is only written on a
+    passing gate; history always records the run).  Each configuration runs in a subprocess so
     the platform can be (re)configured; on hosts without enough
     accelerators a virtual CPU platform is forced (measured numbers then
     characterize the comms/dispatch path on time-sliced virtual devices,
@@ -1430,11 +1442,15 @@ def run_multichip_bench() -> bool:
         sweep = [d for d in sweep if d <= visible]
         max_dev = max(sweep)
 
-    def child(n_dev, mode, fuse=None):
+    def child(n_dev, mode, fuse=None, mesh=None):
         env = dict(os.environ)
         env.update({"_BENCH_MC_CHILD": "1", "BENCH_MC_DEV": str(n_dev),
                     "BENCH_MC_MODE": mode, "BENCH_MC_ROWS": str(rows),
                     "BENCH_MC_ITERS": str(iters)})
+        if mesh is not None:
+            env["BENCH_MC_MESH"] = mesh
+        else:
+            env.pop("BENCH_MC_MESH", None)
         if fuse is not None:
             env["LGBTPU_FUSE_ITER"] = fuse
         else:
@@ -1444,8 +1460,8 @@ def run_multichip_bench() -> bool:
             flags = [f for f in env.get("XLA_FLAGS", "").split() if not
                      f.startswith("--xla_force_host_platform_device_count")]
             env["XLA_FLAGS"] = " ".join(
-                flags
-                + [f"--xla_force_host_platform_device_count={max_dev}"])
+                flags + ["--xla_force_host_platform_device_count="
+                         f"{max(max_dev, n_dev)}"])
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, text=True,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -1477,11 +1493,42 @@ def run_multichip_bench() -> bool:
                 r1["s_per_tree"] / max(rd["s_per_tree"], 1e-12) / d, 3),
             "launches_per_round": rd["launches_per_round"],
         }
+    # 2D rows x feature-groups arms (BENCH_MULTICHIP_MESH=2x2,2x4): each
+    # RxF mesh trains the same protocol; the arm reports s/tree,
+    # analytic bytes/round, launches/iter and scaling efficiency against
+    # BOTH the 1-device baseline and the 1D arm at the same device count
+    mesh_specs = [s.strip() for s in
+                  os.environ.get("BENCH_MULTICHIP_MESH", "").split(",")
+                  if s.strip()]
+    mesh2d = {}
+    for spec in mesh_specs:
+        r2, f2 = (int(v) for v in spec.lower().split("x"))
+        nd = r2 * f2
+        if not forced_cpu and nd > visible:
+            print(f"BENCH_MULTICHIP: dropping 2D mesh {spec} "
+                  f"(needs {nd} devices, {visible} visible)", flush=True)
+            continue
+        r2d = child(nd, "2d", mesh=spec)
+        arm = {
+            "s_per_tree": r2d["s_per_tree"],
+            "bytes_per_round": r2d["bytes_per_round"],
+            "launches_per_iter": r2d["launches_per_iter"],
+            "launches_per_round": r2d["launches_per_round"],
+            "scaling_efficiency": round(
+                r1["s_per_tree"] / max(r2d["s_per_tree"], 1e-12) / nd, 3),
+            "auc": r2d["auc"], "fused": r2d["fused"],
+        }
+        if str(nd) in trajectory:
+            arm["vs_1d_same_devices"] = round(
+                trajectory[str(nd)]["s_per_tree"]
+                / max(r2d["s_per_tree"], 1e-12), 3)
+        mesh2d[spec] = arm
     speedup = r1["s_per_tree"] / max(rr["s_per_tree"], 1e-12)
     eff = speedup / D
     launch_drop = (ru["launches_per_round"]
                    / max(rr["launches_per_round"], 1e-9))
-    auc = min(rp["auc"], rr["auc"], ru["auc"])
+    auc = min([rp["auc"], rr["auc"], ru["auc"]]
+              + [a["auc"] for a in mesh2d.values()])
     ok = auc >= AUC_GATE
     plat = "forced-CPU virtual devices" if rr["forced_cpu"] else "accelerators"
     record = {
@@ -1519,13 +1566,19 @@ def run_multichip_bench() -> bool:
                             "reduce_scatter": rr["bytes_per_round"]},
         "auc": {"psum": rp["auc"], "reduce_scatter": rr["auc"]},
     }
+    if mesh2d:
+        record["mesh2d"] = mesh2d
     print(json.dumps(record), flush=True)
     _append_history(record)
-    from lightgbm_tpu.robustness.checkpoint import atomic_open
-    with atomic_open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                  "BENCH_MULTICHIP.json"), "w") as fh:
-        json.dump(record, fh, indent=2)
-        fh.write("\n")
+    if ok:
+        # BENCH_MULTICHIP.json holds the last PASSING run only (a failed
+        # AUC gate still prints + lands in BENCH_HISTORY.jsonl above)
+        from lightgbm_tpu.robustness.checkpoint import atomic_open
+        with atomic_open(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_MULTICHIP.json"), "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
     return ok
 
 

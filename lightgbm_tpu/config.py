@@ -564,6 +564,12 @@ class Config:
     # bit-identical either way — LGBTPU_MULTICLASS_BATCHED=1/0 forces the
     # choice for A/B experiments.
     multiclass_batched: bool = True
+    # device mesh spec "axis:size[,axis:size]" (docs/DISTRIBUTED.md):
+    # "data:D" shards rows (tree_learner=data) or histogram slots
+    # (voting), "feature:D" shards feature groups (tree_learner=feature),
+    # "data:R,feature:F" is the 2D rows x feature-groups mesh for the
+    # both-huge regime (tree_learner=data only; docs/DISTRIBUTED.md
+    # "2D mesh"). Empty = single-device.
     mesh_shape: str = ""
     # data-parallel histogram collective (docs/DISTRIBUTED.md): psum
     # all-reduces the full histogram block to every device each round;

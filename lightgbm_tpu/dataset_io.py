@@ -76,6 +76,62 @@ def shard_byte_range(path: str, rank: int, num_machines: int,
     return start, end, start_row
 
 
+def query_aligned_byte_range(path: str, qg: np.ndarray, rank: int,
+                             num_machines: int, skip_header: bool = False
+                             ) -> Tuple[int, int, int, np.ndarray]:
+    """Byte range of this rank's row shard cut on QUERY boundaries, for
+    streamed ranking ingest: no query may straddle a shard (the reference
+    partitions ranking data at query granularity — Metadata::
+    CheckOrPartition keeps groups together).  Query cuts land on the
+    cumulative-row boundaries nearest rows*i/num_machines, then ONE byte
+    scan converts the two row cuts to byte offsets counting DATA lines
+    (blank/'#'-comment lines are skipped by every parser, exactly like
+    :func:`shard_byte_range`'s start_row accounting).
+
+    Returns (byte_start, byte_end, start_row, group_sizes) where
+    group_sizes is this rank's slice of ``qg`` (sums to the shard's row
+    count by construction)."""
+    qg = np.asarray(qg, np.int64)
+    bounds = np.concatenate([[0], np.cumsum(qg)]).astype(np.int64)
+    total = int(bounds[-1])
+    targets = [int(round(total * r / num_machines))
+               for r in range(num_machines + 1)]
+    qsplit = np.searchsorted(bounds, targets, side="left")
+    qsplit[0], qsplit[-1] = 0, len(qg)
+    qsplit = np.maximum.accumulate(qsplit)
+    q0, q1 = int(qsplit[rank]), int(qsplit[rank + 1])
+    row0, row1 = int(bounds[q0]), int(bounds[q1])
+    if row0 == row1:        # a rank with zero queries reads zero bytes
+        return 0, 0, row0, qg[q0:q1]
+    start = end = None
+    with open(path, "rb") as f:
+        if skip_header:
+            f.readline()
+        pos = f.tell()
+        if row0 == 0:
+            start = pos
+        seen = 0
+        for ln in f:
+            nxt = pos + len(ln)
+            if ln.strip() and not ln.lstrip().startswith(b"#"):
+                seen += 1
+                if seen == row0:
+                    start = nxt
+                if seen == row1:
+                    end = nxt
+                    break
+            pos = nxt
+        if end is None:
+            end = os.path.getsize(path)
+            if seen < row1:
+                raise LightGBMError(
+                    f"{path} has {seen} data rows but its .query file "
+                    f"accounts for {total}; the sidecar is stale")
+        if start is None:
+            start = end
+    return start, end, row0, qg[q0:q1]
+
+
 def load_data_file(path: str, params: Dict[str, Any],
                    rank: Optional[int] = None,
                    num_machines: Optional[int] = None

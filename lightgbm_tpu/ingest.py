@@ -391,21 +391,31 @@ class _ArrowSource:
 
 class _FileSource:
     """CSV/TSV chunk source; under a distributed run it reads only this
-    rank's byte shard (cut at line boundaries)."""
+    rank's byte shard (cut at line boundaries — or at QUERY boundaries
+    when a .query sidecar rides along, so no query straddles a shard and
+    the streamed rank keeps an exact group slice)."""
 
     def __init__(self, path: str, params: Dict[str, Any], chunk_rows: int,
                  rank: Optional[int] = None, nproc: Optional[int] = None):
-        from .dataset_io import shard_byte_range
+        from .dataset_io import (load_query_file, query_aligned_byte_range,
+                                 shard_byte_range)
         self.path = str(path)
         self.params = params
         self.chunk = max(int(chunk_rows), 1)
         self.byte_start = self.byte_end = None
         self.start_row = 0
+        self.group_slice = None
         if rank is not None and nproc is not None and nproc > 1:
-            self.byte_start, self.byte_end, self.start_row = \
-                shard_byte_range(self.path, rank, nproc,
-                                 skip_header=bool(params.get("header",
-                                                             False)))
+            hdr = bool(params.get("header", False))
+            qg = load_query_file(self.path)
+            if qg is not None:
+                (self.byte_start, self.byte_end, self.start_row,
+                 self.group_slice) = query_aligned_byte_range(
+                    self.path, qg, rank, nproc, skip_header=hdr)
+            else:
+                self.byte_start, self.byte_end, self.start_row = \
+                    shard_byte_range(self.path, rank, nproc,
+                                     skip_header=hdr)
             self.bytes_total = self.byte_end - self.byte_start
         else:
             self.bytes_total = os.path.getsize(self.path)
@@ -795,11 +805,21 @@ def stream_construct(ds, cfg) -> None:
             qg = load_query_file(info["path"])
             if qg is not None:
                 if dist is not None:
-                    raise LightGBMError(
-                        "streaming ingest does not yet shard ranking "
-                        "data on query boundaries; use ingest_mode=inmem "
-                        "for distributed .query files")
-                ds.group = qg
+                    # this rank's byte shard was cut ON query boundaries
+                    # (_FileSource + dataset_io.query_aligned_byte_range),
+                    # so its group slice is exact — no query straddles a
+                    # shard; _finalize_distributed cross-checks the slice
+                    # row sum against the shard's parsed rows
+                    g = getattr(source, "group_slice", None)
+                    if g is None:
+                        raise LightGBMError(
+                            "streamed distributed ranking needs a file "
+                            "source sharded on query boundaries; this "
+                            "source type cannot align its chunks to "
+                            ".query groups — use ingest_mode=inmem")
+                    ds.group = np.asarray(g, np.int64)
+                else:
+                    ds.group = qg
     labels = []
     ds.num_data_ = rows
 

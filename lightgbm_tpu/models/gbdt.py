@@ -118,6 +118,10 @@ class GBDT:
         # fully replicated (docs/DISTRIBUTED.md "feature-parallel")
         self._feature_mode = False
         self._feature_axis = None
+        # 2D mesh (tree_learner=data over data x feature axes): bins sharded
+        # over BOTH axes, per-row arrays sharded over rows and replicated
+        # over the feature axis (docs/DISTRIBUTED.md "2D mesh")
+        self._mesh_2d = False
         self._replicated_sharding = None
         # voting replaces the grow fn with its own shard_map learner, which
         # never reads the packed stream layout — keep stream (and its packed
@@ -185,11 +189,18 @@ class GBDT:
             if n_pad != bins.shape[0]:
                 bins = jnp.pad(bins, ((0, n_pad - bins.shape[0]), (0, 0)))
             sh = bins_sharding(self.mesh, config.tree_learner)
+            self._mesh_2d = (config.tree_learner == "data"
+                             and len(sh.spec) > 1 and sh.spec[1] is not None)
             # feature sharding needs the group axis divisible by the mesh
             # axis; padded groups hold bin 0 for every row and are never
-            # gathered by any feature (layout.gather_idx ignores them)
+            # gathered by any feature (layout.gather_idx ignores them). On
+            # the 2D mesh the feature-local block is further psum_scattered
+            # over the row axis at the group dim, so groups pad to a
+            # multiple of D_rows * D_feat.
             if len(sh.spec) > 1 and sh.spec[1] is not None:
                 ax = int(self.mesh.shape[sh.spec[1]])
+                if self._mesh_2d:
+                    ax *= int(self.mesh.shape[sh.spec[0]])
                 g = bins.shape[1]
                 g_pad = -(-g // ax) * ax
                 if g_pad != g:
@@ -203,6 +214,8 @@ class GBDT:
                 # in-process collectives)
                 self._row_sharding = data_sharding(self.mesh)
                 self._row_axis = self._row_sharding.spec[0]
+                if self._mesh_2d:
+                    self._feature_axis = sh.spec[1]
             else:
                 # feature sharding: rows stay whole on every device — pin
                 # the per-row arrays (score, grad, hess, bagging mask)
@@ -244,23 +257,27 @@ class GBDT:
 
         self._check_unsupported_params()
         self._grow_params = self._make_grow_params()
-        if self._feature_mode and (
+        if (self._feature_mode or self._mesh_2d) and (
                 not self._grow_params.plain_growth
                 or self._parse_forced_splits() is not None
                 or config.linear_tree):
+            _mode = ("the 2D data x feature mesh" if self._mesh_2d
+                     else "tree_learner=feature")
             raise LightGBMError(
-                "tree_learner=feature does not support monotone/"
+                f"{_mode} does not support monotone/"
                 "interaction constraints, forced splits, path smoothing, "
                 "extra_trees, feature_fraction_bynode, cegb_*, or "
                 "linear_tree; remove those parameters or use "
-                "tree_learner=data")
-        if self._feature_mode and \
+                "a rows-only mesh (tree_learner=data, mesh_shape=data:D)")
+        if (self._feature_mode or self._mesh_2d) and \
                 self._grow_params.hist_backend not in ("segsum", "onehot"):
             # checked here (not just in grow_tree) so the engine never
             # pre-packs a pallas bin copy of the group-sharded matrix —
             # pack_bins would replicate the full (N, G) block per device
+            _mode = ("the 2D data x feature mesh" if self._mesh_2d
+                     else "tree_learner=feature")
             raise LightGBMError(
-                f"tree_learner=feature needs hist_backend=segsum or "
+                f"{_mode} needs hist_backend=segsum or "
                 f"onehot (got {self._grow_params.hist_backend!r}: the "
                 "stream/pallas kernels pack row-major group words, which "
                 "group sharding cannot slice)")
@@ -300,7 +317,8 @@ class GBDT:
             forced=self._parse_forced_splits(),
             cegb_coupled=self._cegb_coupled_array(),
             cegb_lazy_pen=self._cegb_lazy_pen_array(),
-            mesh=(self.mesh if (self._mesh_stream or self._feature_mode)
+            mesh=(self.mesh if (self._mesh_stream or self._feature_mode
+                                or self._mesh_2d)
                   else None),
             row_axis=self._row_axis,
             feature_axis=self._feature_axis)
@@ -623,6 +641,31 @@ class GBDT:
             return self._comms_model_cache
         if self._row_sharding is None:
             return None
+        if self._mesh_2d:
+            # 2D data x feature mesh: the feature axis moves ZERO histogram
+            # bytes (shard-local builds); the row axis psum_scatters each
+            # device's G/D_feat block down to G/(D_rows*D_feat) groups.
+            # Contraction backends only, so the wire is always 4-byte f32
+            # (hist_packed_width / bf16_pair ride the int-stream wire,
+            # which 2D cannot use — documented in docs/DISTRIBUTED.md).
+            from ..parallel.comms import hist_comms_bytes_per_round
+            d_r = int(self.mesh.shape[self._row_axis])
+            d_f = int(self.mesh.shape[self._feature_axis])
+            S = S2 // 2
+            kb = k_all if (k_all > 1 and self._use_batched_multiclass()) \
+                else 1
+            per_round = hist_comms_bytes_per_round(
+                S, self.dd.num_groups, self.dd.max_bins, d_r,
+                "reduce_scatter", "f32", num_class=kb, packed_width=32,
+                d_feat=d_f)
+            self._comms_model_cache = {
+                "mode": "2d", "dtype": "f32",
+                "devices": d_r * d_f, "d_rows": d_r, "d_feat": d_f,
+                "per_round_bytes": per_round,
+                "packed_width": 32,
+                "hist_block_bytes": per_round,
+                "per_iter_bytes": per_round * rounds2 * (k_all // kb)}
+            return self._comms_model_cache
         # row-sharded data-parallel: stream runs the explicit shard_map
         # psum/reduce_scatter; non-stream backends get the SAME payload
         # via GSPMD's automatic histogram all-reduce, so the analytic
@@ -728,8 +771,9 @@ class GBDT:
                 if not rows_only:
                     raise LightGBMError(
                         "hist_backend=stream under a mesh needs row-only "
-                        "sharding (tree_learner=data); feature sharding "
-                        "cannot stream packed group words")
+                        "sharding (tree_learner=data on a data-only mesh); "
+                        "feature/2D sharding cannot stream packed group "
+                        "words — use hist_backend=segsum or onehot")
                 return "stream"
             if b != "auto":
                 return b
@@ -1464,8 +1508,10 @@ class GBDT:
             from ..ops.grow import grow_tree_k
             dd = self.dd
             gp = self._grow_params
-            mesh = self.mesh if self._mesh_stream else None
+            mesh = (self.mesh if (self._mesh_stream or self._mesh_2d)
+                    else None)
             row_axis = self._row_axis
+            feature_axis = self._feature_axis if self._mesh_2d else None
 
             def _fn(bins, grad2, hess2, mask, colm, packed, scales,
                     compact_rows=0):
@@ -1474,6 +1520,7 @@ class GBDT:
                                    params=gp, packed=packed,
                                    gh_scales=scales, mesh=mesh,
                                    row_axis=row_axis,
+                                   feature_axis=feature_axis,
                                    compact_rows=compact_rows)
 
             self._grow_fn_kb = watched_jit(_fn, name="grow_tree_k",
@@ -1575,7 +1622,7 @@ class GBDT:
                 or jax.default_backend() in ("tpu", "axon")
                 or (self.mesh is not None
                     and (self._mesh_stream or self._voting
-                         or self._feature_mode)))
+                         or self._feature_mode or self._mesh_2d)))
 
     # ------------------------------------------------------------------
     def _shard_leaf_array(self, a):
@@ -1711,8 +1758,10 @@ class GBDT:
             qbins = self.config.num_grad_quant_bins
             qstoch = self.config.stochastic_rounding
             dd, gp = self.dd, self._grow_params
-            mesh = self.mesh if self._mesh_stream else None
+            mesh = (self.mesh if (self._mesh_stream or self._mesh_2d)
+                    else None)
             row_axis = self._row_axis
+            feature_axis = self._feature_axis if self._mesh_2d else None
             # per-shard overflow detection wherever rows are sharded
             # (stream data-parallel AND voting); feature mode replicates
             # rows, so its one "shard" is the full row count
@@ -1787,6 +1836,7 @@ class GBDT:
                         bins, gq.T, hq.T, mask, colm, layout=dd.layout,
                         routing=dd.routing, params=gp, packed=packed,
                         gh_scales=scales, mesh=mesh, row_axis=row_axis,
+                        feature_axis=feature_axis,
                         compact_rows=compact_rows)
                     # stacked score add — same arithmetic as score_add_k
                     Lk = arrays.leaf_value.shape[1]

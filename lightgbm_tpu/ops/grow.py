@@ -383,7 +383,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     global feature id) tie-break — ZERO histogram bytes cross the wire
     (the reference Allreduces SplitInfo records only,
     feature_parallel_tree_learner.cpp:25-83).  Trees are bit-identical to
-    the serial learner.  Mutually exclusive with row_axis.
+    the serial learner.
+    mesh + row_axis + feature_axis TOGETHER: the 2D (rows x
+    feature-groups) mesh (docs/DISTRIBUTED.md "2D mesh") — bins is
+    sharded over BOTH axes, histograms build shard-locally over the
+    feature axis and psum_scatter over the row axis, the split scan runs
+    on each device's G/(D_rows*D_feat) slice through the same ShardPlan
+    machinery keyed by the compound (feature, data) axis, and best-split
+    records all_gather over both axes with the exact tie-break.  Per-row
+    arrays stay sharded over rows only (replicated over feature).
     compact_rows: static PER-SHARD row capacity for GOSS/bagging row
     compaction (0 = off).  One stable partition per tree (ops/compact.
     plan_sample_rows) gathers the in-bag rows to the front and every
@@ -480,14 +488,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     # ---- root ----
     use_stream = params.hist_backend == "stream"
     use_fp = mesh is not None and feature_axis is not None
+    # 2D rows x feature-groups mesh: the fp machinery keyed by the
+    # COMPOUND (feature, data) axis + a row-axis psum_scatter in the build
+    use_2d = use_fp and row_axis is not None
     use_compact = compact_rows > 0
     if use_compact:
         from .compact import check_compact_supported
         # feature-parallel replicates rows, so its compaction is the
         # single-device stable partition (bins' sharded GROUP axis is
-        # untouched by the row gather)
+        # untouched by the row gather); the 2D mesh shards rows too, so
+        # it keeps the mesh check (compaction unsupported there — GOSS/
+        # bagging run via exact zero-weight masking)
         check_compact_supported(params.hist_backend,
-                                None if use_fp else mesh)
+                                None if (use_fp and not use_2d) else mesh)
     bins_packed = None
     fuse, R_buf = False, 1   # GOSS+stream fusion (resolved in the stream block)
     Bpad = -(-Bmax // 8) * 8
@@ -519,30 +532,45 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     if use_fp:
         if params.hist_backend not in ("segsum", "onehot"):
             raise ValueError(
-                "tree_learner=feature needs a contraction/segsum histogram "
-                "backend (the stream/pallas kernels pack row-major group "
-                "words, which group sharding cannot slice)")
+                "feature-sharded growth (tree_learner=feature or the 2D "
+                "mesh) needs a contraction/segsum histogram backend (the "
+                "stream/pallas kernels pack row-major group words, which "
+                "group sharding cannot slice)")
         if not params.plain_growth or forced:
             raise ValueError(
-                "tree_learner=feature supports the plain feature set only "
-                "(no monotone/interaction constraints, CEGB, forced "
-                "splits, path smoothing, extra_trees, or "
-                "feature_fraction_bynode)")
+                "feature-sharded growth (tree_learner=feature or the 2D "
+                "mesh) supports the plain feature set only (no monotone/"
+                "interaction constraints, CEGB, forced splits, path "
+                "smoothing, extra_trees, or feature_fraction_bynode)")
         from ..parallel.comms import (make_rs_context, make_sharded_hist,
-                                      make_sharded_bin_gather)
+                                      make_sharded_hist_2d,
+                                      make_sharded_bin_gather,
+                                      make_sharded_bin_gather_2d)
+        fp_axis = (feature_axis, row_axis) if use_2d else feature_axis
         fp_plan, fp_split, fp_bitset = make_rs_context(
-            mesh, feature_axis, layout, routing, G, Bmax, params)
+            mesh, fp_axis, layout, routing, G, Bmax, params)
         if fp_plan.g_pad != G:
             raise ValueError(
-                f"feature-parallel bins must arrive group-padded to a "
-                f"multiple of the mesh feature axis (got {G} groups, need "
+                f"feature-sharded bins must arrive group-padded to a "
+                f"multiple of the mesh shard count (got {G} groups, need "
                 f"{fp_plan.g_pad}); the engine pads at construction")
         G_h = G
-        fp_hist_1 = make_sharded_hist(mesh, feature_axis,
-                                      params.hist_backend, 1, Bmax, hdt)
-        fp_hist_S = make_sharded_hist(mesh, feature_axis,
-                                      params.hist_backend, S, Bmax, hdt)
-        fp_bin = make_sharded_bin_gather(mesh, feature_axis, fp_plan.gs)
+        if use_2d:
+            d_feat = int(mesh.shape[feature_axis])
+            fp_hist_1 = make_sharded_hist_2d(mesh, row_axis, feature_axis,
+                                             params.hist_backend, 1, Bmax,
+                                             hdt)
+            fp_hist_S = make_sharded_hist_2d(mesh, row_axis, feature_axis,
+                                             params.hist_backend, S, Bmax,
+                                             hdt)
+            fp_bin = make_sharded_bin_gather_2d(mesh, row_axis,
+                                                feature_axis, G // d_feat)
+        else:
+            fp_hist_1 = make_sharded_hist(mesh, feature_axis,
+                                          params.hist_backend, 1, Bmax, hdt)
+            fp_hist_S = make_sharded_hist(mesh, feature_axis,
+                                          params.hist_backend, S, Bmax, hdt)
+            fp_bin = make_sharded_bin_gather(mesh, feature_axis, fp_plan.gs)
     if use_stream:
         from ..pallas.stream_kernel import (NUM_TAB, build_route_tables,
                                             pack_bins_T, route_and_hist,
@@ -760,9 +788,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, cnt_w: jax.Arra
     if use_fp:
         # pin the histogram STATE to the group sharding for the whole
         # while_loop: every per-round build/subtract then stays shard-local
+        # (the 2D mesh pins the COMPOUND (feature, data) group spec so the
+        # state matches the post-psum_scatter slice ownership)
         from jax.sharding import NamedSharding, PartitionSpec as _P
+        g_spec = (feature_axis, row_axis) if use_2d else feature_axis
         hist = jax.lax.with_sharding_constraint(
-            hist, NamedSharding(mesh, _P(None, feature_axis, None, None)))
+            hist, NamedSharding(mesh, _P(None, g_spec, None, None)))
     state = _GrowState(
         leaf_id=leaf_id,
         leaf_id_c=leaf_id_c,
@@ -1622,6 +1653,7 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 params: GrowParams,
                 packed=None, gh_scales: Optional[jax.Array] = None,
                 mesh=None, row_axis: Optional[str] = None,
+                feature_axis: Optional[str] = None,
                 compact_rows: int = 0,
                 ) -> Tuple[TreeArrays, jax.Array]:
     """Grow K class trees in LOCKSTEP inside one widened XLA program
@@ -1650,6 +1682,12 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     Only the plain feature set is supported (no monotone/interaction/CEGB/
     forced splits/path smoothing/extra_trees/bynode sampling); the caller
     falls back to the per-class scan otherwise.
+
+    mesh + row_axis + feature_axis: the 2D (rows x feature-groups) mesh —
+    the widened (K, S, G, Bmax, 3) block builds shard-locally over the
+    feature axis, psum_scatters over the row axis, and the K*2S-slot scan
+    runs on each device's G/(D_rows*D_feat) slice (docs/DISTRIBUTED.md
+    "2D mesh"); feature_axis without row_axis is not supported here.
     """
     if (params.has_monotone or params.has_interaction or params.has_cegb
             or params.extra_trees or params.bynode_fraction < 1.0
@@ -1697,12 +1735,46 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # (K, S, G, Bmax, 2) block and scanning K*2S slots shard-locally
     use_rs = (mesh is not None and use_stream
               and params.hist_comms == "reduce_scatter")
+    use_fp = mesh is not None and feature_axis is not None
+    if use_fp and (row_axis is None or use_stream):
+        raise ValueError(
+            "grow_tree_k shards the feature axis only as part of the 2D "
+            "data x feature mesh with a contraction/segsum backend; use "
+            "the per-class grow_tree scan for tree_learner=feature")
     G_h = G
     if use_rs:
         from ..parallel.comms import make_rs_context, reduce_hist
         plan, rs_split, rs_bitset = make_rs_context(
             mesh, row_axis, layout, routing, G, Bmax, params)
         G_h = plan.g_pad
+    if use_fp:
+        # 2D mesh: same ShardPlan machinery as grow_tree's, keyed by the
+        # compound (feature, data) axis; the K-class build is the widened
+        # variant of make_sharded_hist_2d
+        if params.hist_backend not in ("segsum", "onehot"):
+            raise ValueError(
+                "the 2D mesh needs a contraction/segsum histogram backend "
+                "(the stream/pallas kernels pack row-major group words, "
+                "which group sharding cannot slice)")
+        from ..parallel.comms import (make_rs_context, make_sharded_hist_2d,
+                                      make_sharded_bin_gather_2d)
+        fp_plan, fp_split, fp_bitset = make_rs_context(
+            mesh, (feature_axis, row_axis), layout, routing, G, Bmax,
+            params)
+        if fp_plan.g_pad != G:
+            raise ValueError(
+                f"2D-mesh bins must arrive group-padded to a multiple of "
+                f"the mesh shard count (got {G} groups, need "
+                f"{fp_plan.g_pad}); the engine pads at construction")
+        d_feat = int(mesh.shape[feature_axis])
+        fp_hist_1 = make_sharded_hist_2d(mesh, row_axis, feature_axis,
+                                         params.hist_backend, 1, Bmax, hdt,
+                                         k_classes=K)
+        fp_hist_S = make_sharded_hist_2d(mesh, row_axis, feature_axis,
+                                         params.hist_backend, S, Bmax, hdt,
+                                         k_classes=K)
+        fp_bin = make_sharded_bin_gather_2d(mesh, row_axis, feature_axis,
+                                            G // d_feat, batched=True)
     if use_stream:
         from ..pallas.stream_kernel import (build_route_tables, pack_bins_T,
                                             route_and_hist,
@@ -1814,6 +1886,9 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins_c, jnp.zeros((K, compact_rows), i32), grad_c, hess_c,
                 cnt_c, K, 1, Bmax, backend=params.hist_backend,
                 bins_packed=None, acc_dtype=hdt)[..., :2]
+        elif use_fp:
+            root_hist = fp_hist_1(bins, leaf_id, grad, hess,
+                                  cnt_w)[..., :2]
         else:
             root_hist = build_histograms_k(
                 bins, leaf_id, grad, hess, cnt_w, K, 1, Bmax,
@@ -1823,15 +1898,24 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     root_h = jnp.sum(hess, axis=1, dtype=hdt)
     root_c = jnp.broadcast_to(jnp.sum(cnt_w, dtype=hdt), (K,))
     cm_root = jnp.broadcast_to(col_mask[None, :], (K, F))
-    if use_rs:
-        root_split = rs_split(root_hist.reshape(K, G_h, Bmax, 2),
-                              root_g, root_h, root_c, col_mask)
+    if use_rs or use_fp:
+        root_split = (rs_split if use_rs else fp_split)(
+            root_hist.reshape(K, G_h, Bmax, 2),
+            root_g, root_h, root_c, col_mask)
     else:
         root_split = find_splits(root_hist.reshape(K, G_h, Bmax, 2),
                                  root_g, root_h, root_c, col_mask=cm_root)
 
     hist = jnp.zeros((K, L, G_h, Bmax, 2), hdt).at[:, 0].set(
         root_hist.reshape(K, G_h, Bmax, 2))
+    if use_fp:
+        # pin the histogram STATE to the compound group sharding for the
+        # whole while_loop (see grow_tree's fp pin)
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        hist = jax.lax.with_sharding_constraint(
+            hist, NamedSharding(
+                mesh, _P(None, None, (feature_axis, row_axis), None,
+                         None)))
     state = _GrowStateK(
         leaf_id=leaf_id,
         leaf_id_c=leaf_id_c,
@@ -1930,8 +2014,8 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
             # ---- categorical bitsets (rows are class x slot) ----
             parent_hist = st.hist[kI[:, None], pair_old]     # (K, S, G, B, 2)
-            if params.has_categorical and use_rs:
-                bitset = rs_bitset(
+            if params.has_categorical and (use_rs or use_fp):
+                bitset = (rs_bitset if use_rs else fp_bitset)(
                     parent_hist.reshape(K * S, G_h, Bmax, 2),
                     feat.reshape(-1), thr.reshape(-1), dirf.reshape(-1),
                     pg.reshape(-1), ph.reshape(-1), pc.reshape(-1)
@@ -2050,8 +2134,13 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 r_chosen = ta(leaf_chosen, lid)
                 r_feat = ta(leaf_feat, lid)
                 r_grp = routing.feat_group[r_feat]           # (K, N)
-                gb = jnp.take_along_axis(
-                    bins, r_grp.T.astype(jnp.int32), axis=1).T
+                if use_fp:
+                    # owner-feature-shard column read + feature-axis psum
+                    # (the row axis never communicates)
+                    gb = fp_bin(bins, r_grp)
+                else:
+                    gb = jnp.take_along_axis(
+                        bins, r_grp.T.astype(jnp.int32), axis=1).T
                 fb = feature_local_bin(gb, r_feat, routing)
                 r_thr = ta(leaf_thr, lid)
                 r_dir = ta(leaf_dir, lid)
@@ -2084,6 +2173,8 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         hess_c, cnt_c, K, S, Bmax,
                         backend=params.hist_backend, bins_packed=None,
                         acc_dtype=hdt)
+                elif use_fp:
+                    hist3 = fp_hist_S(bins, slot, grad, hess, cnt_w)
                 else:
                     hist3 = build_histograms_k(
                         bins, slot, grad, hess, cnt_w, K, S, Bmax,
@@ -2132,11 +2223,12 @@ def grow_tree_k(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist2 = new_hist[k2, ids2]
             cm2 = jnp.broadcast_to(col_mask[None, :], (K * 2 * S, F))
             with jax.named_scope("find_splits_k"):
-                if use_rs:
-                    res = rs_split(hist2.reshape(K * 2 * S, G_h, Bmax, 2),
-                                   ta(st2.sum_g, ids2).reshape(-1),
-                                   ta(st2.sum_h, ids2).reshape(-1),
-                                   ta(st2.cnt, ids2).reshape(-1), col_mask)
+                if use_rs or use_fp:
+                    res = (rs_split if use_rs else fp_split)(
+                        hist2.reshape(K * 2 * S, G_h, Bmax, 2),
+                        ta(st2.sum_g, ids2).reshape(-1),
+                        ta(st2.sum_h, ids2).reshape(-1),
+                        ta(st2.cnt, ids2).reshape(-1), col_mask)
                 else:
                     res = find_splits(hist2.reshape(K * 2 * S, G_h, Bmax, 2),
                                       ta(st2.sum_g, ids2).reshape(-1),

@@ -409,6 +409,89 @@ def make_sharded_hist(mesh, axis: str, backend: str, num_slots: int,
         P(None, axis, None, None))
 
 
+def make_sharded_hist_2d(mesh, row_axis: str, feature_axis: str,
+                         backend: str, num_slots: int, bmax: int,
+                         acc_dtype, k_classes: int = 0):
+    """shard_map-wrapped histogram build for the 2D (rows x feature-groups)
+    mesh: bins is sharded over BOTH axes, so device (f, r) holds an
+    (N / D_rows, G / D_feat) block.  Each device builds the full local
+    block — ZERO feature-axis collective, exactly the feature-parallel
+    build of :func:`make_sharded_hist` — and ONE ``psum_scatter`` over the
+    ROW axis (PR 5's reduce, data_parallel_tree_learner.cpp:285-299)
+    delivers its G / (D_rows * D_feat) group slice.  The feature-local
+    group count is gs * D_rows by construction (the engine pads groups to
+    a multiple of D_rows * D_feat), so the tiled scatter needs no
+    in-kernel padding, and flat shard s = f * D_rows + r holds groups
+    [s * gs, (s+1) * gs) — the ShardPlan's contiguous-slice convention
+    under the compound ``(feature, data)`` spec.
+
+    ``k_classes`` > 0 builds the batched-multiclass (K, S, G, Bmax, 3)
+    block instead (slot/grad/hess are (K, N); cnt stays (N,))."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_rows
+    from ..ops.histogram import build_histograms, build_histograms_k
+
+    k_mode = k_classes > 0
+    g_dim = 2 if k_mode else 1
+
+    def _local(bins_s, slot, grad, hess, cnt):
+        with jax.named_scope("hist_2d_local"):
+            if k_mode:
+                h = build_histograms_k(bins_s, slot, grad, hess, cnt,
+                                       k_classes, num_slots, bmax,
+                                       backend=backend,
+                                       acc_dtype=acc_dtype)
+            else:
+                h = build_histograms(bins_s, slot, grad, hess, cnt,
+                                     num_slots, bmax, backend=backend,
+                                     acc_dtype=acc_dtype)
+        with jax.named_scope("hist_2d_row_scatter"):
+            return jax.lax.psum_scatter(h, row_axis,
+                                        scatter_dimension=g_dim,
+                                        tiled=True)
+
+    row = P(row_axis)
+    per_row = P(None, row_axis) if k_mode else row
+    out_g = (feature_axis, row_axis)
+    out_spec = (P(None, None, out_g, None, None) if k_mode
+                else P(None, out_g, None, None))
+    return shard_map_rows(
+        _local, mesh,
+        (P(row_axis, feature_axis), per_row, per_row, per_row, row),
+        out_spec)
+
+
+def make_sharded_bin_gather_2d(mesh, row_axis: str, feature_axis: str,
+                               g_loc: int, batched: bool = False):
+    """Per-row stored-bin fetch on the 2D mesh: the chosen split feature's
+    bins column lives on ONE feature shard of each row block, so the owner
+    reads its local column slice and a psum over the FEATURE axis only
+    replicates the value across that row block — the row axis never
+    communicates (every row lives on exactly one row shard, and the
+    result stays row-sharded).  ``g_loc`` is the per-feature-shard group
+    count G / D_feat; ``grp`` holds GLOBAL group indices.  ``batched``
+    handles the (K, N) multiclass-lockstep shape (rows on dim 1)."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_rows
+
+    def _local(bins_s, grp):
+        me = jax.lax.axis_index(feature_axis)
+        local = grp.astype(jnp.int32) - me * g_loc
+        owned = (local >= 0) & (local < bins_s.shape[1])
+        idx = jnp.clip(local, 0, bins_s.shape[1] - 1)
+        if batched:
+            vals = jnp.take_along_axis(bins_s, idx.T, axis=1).T
+        else:
+            vals = jnp.take_along_axis(bins_s, idx[:, None], axis=1)[:, 0]
+        with jax.named_scope("route_bin_psum_2d"):
+            return jax.lax.psum(
+                jnp.where(owned, vals.astype(jnp.int32), 0), feature_axis)
+
+    grp_spec = P(None, row_axis) if batched else P(row_axis)
+    return shard_map_rows(_local, mesh,
+                          (P(row_axis, feature_axis), grp_spec), grp_spec)
+
+
 def make_sharded_bin_gather(mesh, axis: str, gs: int):
     """shard_map-wrapped per-row stored-bin fetch for feature-parallel
     routing: rows are replicated but the bins column of a chosen split
@@ -462,7 +545,8 @@ def voting_bytes_per_round(num_slots: int, num_features: int, top_k2: int,
 def hist_comms_bytes_per_round(num_slots: int, num_groups: int, bmax: int,
                                d: int, mode: str, dtype: str = "f32",
                                num_class: int = 1,
-                               packed_width: int = 32) -> int:
+                               packed_width: int = 32,
+                               d_feat: int = 1) -> int:
     """Analytic per-device histogram payload DELIVERED per growth round.
 
     Convention (docs/DISTRIBUTED.md): bytes of reduced histogram payload a
@@ -478,7 +562,22 @@ def hist_comms_bytes_per_round(num_slots: int, num_groups: int, bmax: int,
     stream): 16 packs each (grad, hess) int pair into ONE int32 lane (4
     bytes per pair instead of 8 — half), 8 packs the pair into ONE int16
     lane (2 bytes per pair — quarter).  The two scale scalars ride the
-    best-split record exchange; their bytes are noise and not counted."""
+    best-split record exchange; their bytes are noise and not counted.
+
+    ``d_feat`` > 1 is the 2D (rows x feature-groups) mesh: the feature
+    axis ships ZERO histogram bytes (each feature shard builds only its
+    own groups, like tree_learner=feature), the row axis psum_scatters
+    each feature-local block so a device materializes only its
+    G / (d * d_feat) group slice, and the best-split records all_gather
+    over BOTH axes (d * d_feat shards).  The 2D path runs the exact-f32
+    contraction build (no stream kernel per feature shard), so the wire
+    is always 4-byte f32 there — hist_packed_width and bf16_pair resolve
+    to 32-wide f32 (documented in docs/DISTRIBUTED.md "2D mesh")."""
+    if d_feat > 1:
+        gs = -(-num_groups // (d * d_feat))
+        elems_slice = num_class * num_slots * gs * bmax * 2
+        record_bytes = (d * d_feat) * num_class * num_slots * 7 * 4
+        return elems_slice * 4 + record_bytes
     per_elem = {32: 4, 16: 2, 8: 1}[packed_width]
     if mode == "psum":
         return num_class * num_slots * num_groups * bmax * 2 * per_elem
@@ -498,10 +597,18 @@ def make_rs_context(mesh, axis: str, layout: FeatureLayout, routing,
     static ShardPlan, a SplitResult-shaped shard-local finder, and the
     owner-shard categorical bitset (None without categorical features).
     Shared by grow_tree and grow_tree_k so the scan kwargs can never
-    drift between the two growth paths."""
+    drift between the two growth paths.
+
+    ``axis`` may be a TUPLE of mesh axis names (the 2D mesh passes
+    ``(feature, data)``): the plan then slices groups over the COMBINED
+    d = prod(sizes) shards, and every collective inside the finder /
+    bitset (all_gather, psum) runs over the compound axis — jax orders
+    tuple-axis collectives first-named-major, so flat shard
+    f * D_rows + r matches the post-psum_scatter slice ownership."""
     from ..ops.split import SplitResult
 
-    n_dev = int(mesh.shape[axis])
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
     plan = build_shard_plan(layout, routing, num_groups, bmax, n_dev)
     scan_kw = dict(
         lambda_l1=params.lambda_l1, lambda_l2=params.lambda_l2,

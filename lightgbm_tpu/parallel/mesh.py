@@ -85,19 +85,26 @@ def create_mesh(mesh_shape: str = "", tree_learner: str = "serial",
                  "Proceeding with the devices visible to this process.")
     if mesh_shape:
         names, sizes = parse_mesh_shape(mesh_shape)
-        # combined 2-axis meshes (e.g. "data:4,feature:2") would silently
-        # fall through learner selection — no learner consumes both axes,
-        # so the bins sharding and the split collectives would disagree.
-        # Refuse loudly until 2D (rows x feature-groups) sharding lands;
-        # trailing size-1 axes are harmless (their collectives are
+        # combined 2-axis meshes: ONLY tree_learner=data consumes both
+        # axes (histograms build shard-locally over feature groups and
+        # psum_scatter over rows — docs/DISTRIBUTED.md "2D mesh"). The
+        # feature and voting learners run their collectives on a single
+        # axis, so a combined mesh would leave the second axis unconsumed
+        # and the bins sharding and split collectives would disagree.
+        # Trailing size-1 axes are harmless (their collectives are
         # identities) and stay allowed for sweep tooling.
         big = [f"{nm}:{sz}" for nm, sz in zip(names, sizes) if sz > 1]
-        if len(big) > 1:
+        big_names = {nm for nm, sz in zip(names, sizes) if sz > 1}
+        if len(big) > 1 and not (tree_learner == "data"
+                                 and big_names <= {DATA_AXIS, FEATURE_AXIS}):
             raise LightGBMError(
                 f"mesh_shape {mesh_shape!r} requests a combined "
-                f"{' x '.join(big)} mesh; 2-axis sharding is not supported "
-                "yet — shard ONE axis (\"data:D\" with tree_learner=data/"
-                "voting, or \"feature:D\" with tree_learner=feature)")
+                f"{' x '.join(big)} mesh; 2-axis sharding is only "
+                f"supported as \"{DATA_AXIS}:R,{FEATURE_AXIS}:F\" with "
+                "tree_learner=data (rows x feature-groups, docs/"
+                "DISTRIBUTED.md \"2D mesh\") — other learners shard ONE "
+                "axis (\"data:D\" with tree_learner=voting, or "
+                "\"feature:D\" with tree_learner=feature)")
         if tree_learner == "feature" and FEATURE_AXIS not in names:
             raise LightGBMError(
                 f"tree_learner=feature needs a mesh with a "
@@ -142,7 +149,11 @@ def bins_sharding(mesh: Mesh, tree_learner: str) -> NamedSharding:
                                      and DATA_AXIS not in mesh.axis_names):
         return NamedSharding(mesh, P(None, FEATURE_AXIS))
     axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-    if FEATURE_AXIS in mesh.axis_names and tree_learner != "data":
+    if FEATURE_AXIS in mesh.axis_names and (
+            tree_learner != "data" or int(mesh.shape[FEATURE_AXIS]) > 1):
+        # tree_learner=data with a real feature axis is the 2D mesh: bins
+        # (N, G) shard over BOTH axes; a size-1 feature axis keeps the
+        # rows-only spec so the 1D stream path is untouched.
         return NamedSharding(mesh, P(axis, FEATURE_AXIS))
     return NamedSharding(mesh, P(axis))
 

@@ -73,7 +73,15 @@ def state_shardings(mesh, row_axis: Optional[str], num_class: int,
     state array is pinned fully REPLICATED instead; mixing a replicated
     score with group-sharded bins is exactly the layout the fp grow
     program's shard_maps expect, and an accidental row sharding here
-    would silently re-shard every iteration."""
+    would silently re-shard every iteration.
+
+    2D mesh variant (tree_learner=data over ``data x feature`` axes,
+    docs/DISTRIBUTED.md "2D mesh"): pass the 2D mesh with the row axis
+    and ``replicate_rows=False`` — ``P(row_axis)`` on a multi-axis mesh
+    shards rows over the data axis and REPLICATES them over the feature
+    axis, which is exactly the placement the 2D grow program requires
+    for every per-row array (score, grad/hess, leaf routing, bag/GOSS
+    mask); only the bins matrix shards over both axes."""
     if mesh is None or (row_axis is None and not replicate_rows):
         return None
     from jax.sharding import NamedSharding, PartitionSpec as P

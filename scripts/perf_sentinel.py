@@ -160,6 +160,15 @@ def measure(workload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             "use_quantized_grad": True, "hist_packed_width": 16}, 4)
     if entries["grow_tree_packed16"].get("available") is False:
         unavailable.append("grow_tree_packed16")
+    # 2D rows x feature-groups grow program (docs/DISTRIBUTED.md "2D
+    # mesh"): data:2,feature:2 on the same 4-device CPU mesh — segsum
+    # pinned because the 2D path forbids stream and the sentinel must
+    # watch ONE deterministic backend
+    entries["grow_tree_mesh2d"] = _measure_backend_grow(
+        w, {"tree_learner": "data", "mesh_shape": "data:2,feature:2",
+            "hist_backend": "segsum"}, 4)
+    if entries["grow_tree_mesh2d"].get("available") is False:
+        unavailable.append("grow_tree_mesh2d")
     import jax
     return {
         "workload": w,

@@ -105,6 +105,16 @@ XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
 --xla_force_host_platform_device_count=4" \
     python -m pytest tests/test_feature_parallel.py tests/test_voting.py \
     -x -q -m 'not slow'
+# 2D rows x feature-groups mesh on 4 devices (the 2x2 identity matrix):
+# plain/bagging/GOSS/multiclass-batched vs serial, fused single launch,
+# state placement, the d_feat analytic comms model vs the telemetry
+# gauge, and the mesh_shape 2D validation paths (docs/DISTRIBUTED.md
+# "2D mesh") — run at exactly the device count the mesh needs
+echo "=== stage: 2D-mesh tier (D=4, data:2,feature:2) ==="
+XLA_FLAGS="$(printf '%s' "${XLA_FLAGS:-}" \
+    | sed 's/--xla_force_host_platform_device_count=[0-9]*//') \
+--xla_force_host_platform_device_count=4" \
+    python -m pytest tests/test_mesh2d.py -x -q -m 'not slow'
 # wide-data bench smoke: reduced rows/features, single device count —
 # gates the structural payload claims (feature ships ZERO histogram
 # bytes, voting <= 2k elected columns, both beat data-parallel by the

@@ -289,6 +289,124 @@ def test_query_aligned_sharding_keeps_queries_whole(tmp_path):
         assert int(q[2]["group"].sum()) == len(q[0])
 
 
+def test_streamed_query_aligned_shards(tmp_path):
+    """Streaming ingest shards ranking files on QUERY boundaries: every
+    rank's chunk stream reproduces exactly a whole-query row slice (no
+    query straddles a shard) and the group slices concatenate back to the
+    full .query sidecar."""
+    from lightgbm_tpu.ingest import _FileSource
+
+    p = str(tmp_path / "r.csv")
+    sizes = _write_ranking_csv(p, nq=37, seed=5)
+    full = np.loadtxt(p, delimiter=",")
+    bounds = set(np.concatenate([[0], np.cumsum(sizes)]).tolist())
+    rows_seen = 0
+    groups = []
+    for r in range(3):
+        src = _FileSource(p, {}, chunk_rows=64, rank=r, nproc=3)
+        chunks = [c[1] for c in src.chunks()]
+        X = np.vstack(chunks) if chunks else \
+            np.empty((0, full.shape[1] - 1))
+        assert src.start_row == rows_seen
+        # the shard's first and last rows sit ON query boundaries
+        assert rows_seen in bounds and (rows_seen + len(X)) in bounds, \
+            f"rank {r} shard straddles a query"
+        assert int(src.group_slice.sum()) == len(X)
+        np.testing.assert_allclose(
+            X, full[rows_seen:rows_seen + len(X), 1:])
+        groups.append(np.asarray(src.group_slice))
+        rows_seen += len(X)
+    assert rows_seen == len(full)
+    np.testing.assert_array_equal(np.concatenate(groups), sizes)
+
+
+def test_query_aligned_byte_range_empty_rank(tmp_path):
+    """More ranks than queries: the starved rank reads zero bytes and an
+    empty group slice instead of double-reading rows."""
+    from lightgbm_tpu.dataset_io import query_aligned_byte_range
+
+    p = str(tmp_path / "tiny.csv")
+    sizes = _write_ranking_csv(p, nq=1, seed=7)
+    shards = [query_aligned_byte_range(p, sizes, r, 3) for r in range(3)]
+    nonempty = [s for s in shards if s[1] > s[0]]
+    assert len(nonempty) == 1
+    assert sum(int(np.sum(s[3])) for s in shards) == int(sizes.sum())
+
+
+_CHILD_RANK_STREAM = r"""
+import os, sys, json
+os.environ.pop("XLA_FLAGS", None)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (older jax: option absent)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+try:
+    from jax.extend.backend import clear_backends; clear_backends()
+except Exception:
+    pass
+port, rank, data, out = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=rank)
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgb_tpu_jax_cache")
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(data, params={"ingest_mode": "stream",
+                               "ingest_chunk_rows": 256})
+bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                 "verbosity": -1, "min_data_in_leaf": 5,
+                 "tree_learner": "data"},
+                ds, num_boost_round=5)
+assert ds.get_group() is not None
+assert ds._dist is not None and ds._dist["nproc"] == 2
+if rank == 0:
+    open(out, "w").write(bst.model_to_string())
+"""
+
+
+@pytest.mark.slow
+def test_two_process_lambdarank_streamed_matches_inmem(
+        tmp_path, require_two_process_collectives):
+    """Streamed distributed ranking no longer falls back (or errors) on
+    .query files: chunk boundaries snap to query boundaries, and the
+    2-process streamed model must match single-process INMEM training —
+    structural identity implies NDCG parity, asserted explicitly."""
+    data = str(tmp_path / "rank.csv")
+    sizes = _write_ranking_csv(data)
+    out = str(tmp_path / "dist_rank_stream_model.txt")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD_RANK_STREAM, str(port), str(r), data,
+         out], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-4000:]}"
+
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(data), num_boost_round=5)
+    dist_model = open(out).read()
+    _models_structurally_equal(bst.model_to_string(), dist_model)
+
+    # NDCG parity vs inmem on the full file
+    full = np.loadtxt(data, delimiter=",")
+    y, X = full[:, 0], full[:, 1:]
+    qb = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+    from test_ranking import _ndcg_at
+    bst_d = lgb.Booster(model_str=dist_model)
+    n_in = _ndcg_at(np.asarray(bst.predict(X)), y, qb)
+    n_st = _ndcg_at(np.asarray(bst_d.predict(X)), y, qb)
+    assert abs(n_in - n_st) < 0.02, (n_in, n_st)
+
+
 def test_shard_loading_skips_blank_and_comment_lines(tmp_path):
     """Blank/comment lines must not shift per-row sidecar alignment."""
     p = str(tmp_path / "d.csv")

@@ -261,13 +261,19 @@ def test_feature_parallel_zero_hist_bytes():
 # ---------------------------------------------------------------------------
 
 def test_combined_mesh_rejected():
-    """data:X,feature:Y combined meshes raise instead of silently falling
-    through learner selection (no learner consumes both axes yet)."""
+    """data:X,feature:Y combined meshes stay rejected for every learner
+    EXCEPT tree_learner=data, which now consumes both axes as the 2D
+    rows x feature-groups mesh (tests/test_mesh2d.py); the refusal names
+    the supported 2D spelling instead of claiming 2-axis sharding is
+    unsupported."""
     X, y = make_synthetic_binary(n=500, f=4)
-    with pytest.raises(LightGBMError, match="2-axis"):
-        lgb.train({"objective": "binary", "verbosity": -1,
-                   "mesh_shape": "data:2,feature:2"},
-                  lgb.Dataset(X, label=y), num_boost_round=1)
+    for learner in ({}, {"tree_learner": "feature"},
+                    {"tree_learner": "voting"}):
+        with pytest.raises(LightGBMError, match="2-axis") as ei:
+            lgb.train(dict({"objective": "binary", "verbosity": -1,
+                            "mesh_shape": "data:2,feature:2"}, **learner),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        assert "tree_learner=data" in str(ei.value)
 
 
 @needs_mesh

@@ -1,0 +1,310 @@
+"""2D mesh (rows x feature-groups) training tests — docs/DISTRIBUTED.md
+"2D mesh".
+
+tree_learner=data with mesh_shape="data:R,feature:F" runs ONE shard_map
+over BOTH axes: histograms build shard-locally on each device's feature-
+group slice (zero feature-axis collective) and psum_scatter over the row
+axis down to G/(R*F) groups per device; the split scan runs on that slice
+through the ShardPlan sub-FeatureLayout machinery, and best-split records
+all_gather over both axes with the exact (max gain, lowest global feature
+id) tie-break.  Every per-row array stays sharded over rows ONLY and
+replicated over the feature axis.
+
+Identity discipline (PR 6): the round-1 tree matches serial BYTE-for-byte
+(low-mantissa round-1 gradients make every f32 summation order exact);
+later rounds match structurally with ulp tolerance (the psum_scatter
+reduction order differs from the serial accumulation).  Runs on the
+conftest 8-device CPU mesh and the 4-device 2x2 tier run_all_tests.sh
+adds.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import global_registry, launch_count
+from lightgbm_tpu.utils.log import LightGBMError
+
+from conftest import make_synthetic_binary, make_synthetic_multiclass
+
+N_DEV = len(jax.devices())
+MESHES_2D = [(r, f) for r, f in ((2, 2), (2, 4)) if r * f <= N_DEV]
+needs_mesh = pytest.mark.skipif(N_DEV < 4, reason="needs a >=4-device mesh")
+
+
+def _strip_params(model_str: str) -> str:
+    return model_str.split("\nparameters:")[0]
+
+
+def _assert_2d_identity(a: str, b: str):
+    """Round-1 byte equality + full structural identity with ulp-tolerant
+    float fields (the PR 6 non-associativity discipline)."""
+    a, b = _strip_params(a), _strip_params(b)
+    ta, tb = a.split("Tree="), b.split("Tree=")
+    assert len(ta) == len(tb)
+    assert ta[1] == tb[1], "round-1 tree must match serial byte-for-byte"
+    la, lb = a.splitlines(), b.splitlines()
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        if xa == xb:
+            continue
+        ka, _, va = xa.partition("=")
+        kb, _, vb = xb.partition("=")
+        assert ka == kb, f"{ka!r} != {kb!r}"
+        if ka == "tree_sizes":    # byte lengths of the float reprs
+            continue
+        fa = np.array([float(t) for t in va.split()])
+        fb = np.array([float(t) for t in vb.split()])
+        np.testing.assert_allclose(fa, fb, rtol=3e-4, atol=3e-4,
+                                   err_msg=ka)
+
+
+def _train(params, X, y, rounds=4, mesh=None, **ds_kw):
+    p = dict(params, verbosity=-1)
+    if mesh is not None:
+        r, f = mesh
+        p.update(tree_learner="data", mesh_shape=f"data:{r},feature:{f}")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, **ds_kw),
+                    num_boost_round=rounds)
+    if mesh is not None:
+        eng = bst.engine
+        assert eng._mesh_2d and not eng._mesh_stream
+        assert eng._row_axis == "data" and eng._feature_axis == "feature"
+    return bst
+
+
+def _2d_vs_serial(params, X, y, rounds=4, mesh=(2, 2), **ds_kw):
+    s = _train(params, X, y, rounds, None, **ds_kw)
+    m = _train(params, X, y, rounds, mesh, **ds_kw)
+    _assert_2d_identity(s.model_to_string(), m.model_to_string())
+    return m
+
+
+# ---------------------------------------------------------------------------
+# end-to-end identity vs serial on 2x2 and 2x4
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+@pytest.mark.parametrize("mesh", MESHES_2D,
+                         ids=[f"{r}x{f}" for r, f in MESHES_2D])
+def test_2d_identity_binary(mesh):
+    X, y = make_synthetic_binary(n=2000, f=8)
+    _2d_vs_serial({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5}, X, y, mesh=mesh)
+
+
+@needs_mesh
+@pytest.mark.parametrize("mesh", MESHES_2D,
+                         ids=[f"{r}x{f}" for r, f in MESHES_2D])
+def test_2d_identity_bagging(mesh):
+    X, y = make_synthetic_binary(n=2000, f=8)
+    _2d_vs_serial({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5, "bagging_fraction": 0.7,
+                   "bagging_freq": 2, "seed": 3}, X, y, rounds=5, mesh=mesh)
+
+
+@needs_mesh
+@pytest.mark.parametrize("mesh", MESHES_2D,
+                         ids=[f"{r}x{f}" for r, f in MESHES_2D])
+def test_2d_identity_goss(mesh):
+    """GOSS on the 2D mesh: the global top-rate threshold reduces over the
+    row axis only (per-row |g| arrays are feature-replicated), sampling
+    runs as exact zero-weight dense masking (no compaction on 2D).
+
+    Identity discipline for GOSS (docs/DISTRIBUTED.md "2D mesh"): the
+    UNSAMPLED warmup rounds match serial byte-for-byte, every tree keeps
+    the identical shape, and quality stays at parity — the top-rate cut
+    is a discrete threshold on ulp-drifted gradients, so a borderline
+    row may legitimately flip in-bag after warmup (the same reason the
+    1D stream mesh never claimed serial byte-identity for GOSS)."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "data_sample_strategy": "goss", "learning_rate": 0.5,
+         "top_rate": 0.2, "other_rate": 0.2}
+    f = _train(p, X, y, rounds=5, mesh=mesh)
+    assert f.engine._fused_last
+    assert f.engine._last_compact_rows == 0, \
+        "2D mesh must not engage row compaction"
+    assert f.engine._last_sampled_rows > 0
+    s = _train(p, X, y, rounds=5)
+    ts = _strip_params(s.model_to_string()).split("Tree=")[1:]
+    tf = _strip_params(f.model_to_string()).split("Tree=")[1:]
+    warmup = 2   # 1 / learning_rate unsampled iterations
+    for i in range(warmup):
+        assert ts[i] == tf[i], \
+            f"warmup tree {i} must match serial byte-for-byte"
+    for i, (a, b) in enumerate(zip(ts, tf)):
+        assert len(a.splitlines()) == len(b.splitlines()), \
+            f"tree {i} shape diverged from serial"
+    acc_s = np.mean((np.asarray(s.predict(X)) > 0.5) == y)
+    acc_f = np.mean((np.asarray(f.predict(X)) > 0.5) == y)
+    assert acc_f >= acc_s - 0.02
+
+
+@needs_mesh
+@pytest.mark.parametrize("mesh", MESHES_2D,
+                         ids=[f"{r}x{f}" for r, f in MESHES_2D])
+def test_2d_identity_multiclass_batched(mesh):
+    """All K class trees grow in lockstep through the 2D grow_tree_k
+    path — histograms stack the K channel inside the same shard_map."""
+    X, y = make_synthetic_multiclass(n=2000, f=8, k=3)
+    m = _2d_vs_serial({"objective": "multiclass", "num_class": 3,
+                       "num_leaves": 11, "min_data_in_leaf": 5},
+                      X, y, rounds=3, mesh=mesh)
+    assert m.engine._mc_batched_last
+
+
+# ---------------------------------------------------------------------------
+# placement + dispatch invariants
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_2d_state_placement():
+    """Bins shard over BOTH axes; every per-row array shards over rows
+    only (spec names the data axis, never the feature axis) — P('data')
+    on the 2D mesh replicates over 'feature' automatically."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y,
+                 mesh=(2, 2))
+    eng = bst.engine
+    bins_spec = tuple(eng.dd.bins.sharding.spec)
+    assert bins_spec == ("data", "feature"), bins_spec
+    st = eng._train_state
+    assert st is not None and st.score is eng.score
+    for name in ("score", "grad", "hess", "leaf_id", "mask"):
+        spec = tuple(getattr(st, name).sharding.spec)
+        assert "data" in spec, f"state.{name} lost its row sharding"
+        assert "feature" not in spec, \
+            f"state.{name} must replicate over the feature axis: {spec}"
+    assert tuple(st.finished.sharding.spec) == ()
+
+
+@needs_mesh
+def test_2d_fused_single_launch_per_iteration():
+    """The fused path must engage on the 2D mesh and stay at ONE
+    watched_jit launch per steady-state iteration."""
+    X, y = make_synthetic_binary(n=2000, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y,
+                 rounds=2, mesh=(2, 2))
+    assert bst.engine._fused_last, "fused path did not engage on 2D"
+    l0 = launch_count()
+    for _ in range(4):
+        bst.update()
+    launches = (launch_count() - l0) / 4
+    assert launches <= 1.5, f"2D fused path dispatched {launches}/iter"
+
+
+@needs_mesh
+def test_2d_backend_resolution_and_stream_rejected():
+    """2D resolves to a contraction backend (stream cannot slice its
+    row-major packed group words over the feature axis) and an explicit
+    stream request fails loudly."""
+    X, y = make_synthetic_binary(n=800, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 7}, X, y,
+                 rounds=1, mesh=(2, 2))
+    assert bst.engine._grow_params.hist_backend in ("segsum", "onehot")
+    assert not bst.engine._grow_params.int_hist
+    with pytest.raises(LightGBMError, match="stream"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "tree_learner": "data",
+                   "mesh_shape": "data:2,feature:2",
+                   "hist_backend": "stream"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(LightGBMError, match="monotone"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "tree_learner": "data",
+                   "mesh_shape": "data:2,feature:2",
+                   "monotone_constraints": [1] + [0] * 7},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+# ---------------------------------------------------------------------------
+# 2D analytic comms model vs telemetry (satellite: hist_comms_bytes 2D)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+@pytest.mark.parametrize(
+    "extra", [{}, {"hist_packed_width": 16, "use_quantized_grad": True}],
+    ids=["default", "packed16"])
+def test_2d_comms_gauge_matches_analytic_model(extra):
+    """comms/hist_bytes_per_round must equal the 2D analytic model on
+    2x2: row-axis scatter of each device's G/F block down to G/(R*F)
+    groups + both-axes record gather, feature-axis histogram bytes ZERO.
+    hist_packed_width rides the int-stream wire, which 2D cannot use —
+    the wire stays 4-byte f32 and the gauge must NOT change."""
+    from lightgbm_tpu.parallel.comms import hist_comms_bytes_per_round
+
+    X, y = make_synthetic_binary(n=1500, f=8)
+    global_registry.reset()
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "tree_learner": "data", "mesh_shape": "data:2,feature:2",
+         "telemetry": True}
+    p.update(extra)
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    eng = bst.engine
+    cm = eng._comms_model()
+    assert cm["mode"] == "2d"
+    assert cm["devices"] == 4 and cm["d_rows"] == 2 and cm["d_feat"] == 2
+    assert cm["packed_width"] == 32   # packed wire never applies on 2D
+    gp = eng._grow_params
+    S = min(gp.max_splits_per_round, max(gp.num_leaves - 1, 1))
+    expected = hist_comms_bytes_per_round(
+        S, eng.dd.num_groups, eng.dd.max_bins, 2, "reduce_scatter",
+        "f32", num_class=1, packed_width=32, d_feat=2)
+    assert cm["per_round_bytes"] == expected
+    snap = global_registry.snapshot()
+    assert snap["gauges"]["comms/hist_bytes_per_round"] == expected
+    assert snap["counters"]["comms/hist_bytes"] > 0
+    # the scatter slice scales down ~R*F-fold vs the replicated psum block
+    psum_block = hist_comms_bytes_per_round(
+        S, eng.dd.num_groups, eng.dd.max_bins, 4, "psum")
+    assert expected * 2 < psum_block
+
+
+# ---------------------------------------------------------------------------
+# mesh construction error paths for the newly legal 2D shapes
+# ---------------------------------------------------------------------------
+
+def test_2d_mesh_shape_rejects_oversized_product():
+    """Axis product beyond the device count fails loudly with the
+    required total."""
+    from lightgbm_tpu.parallel.mesh import create_mesh
+    need = 2 * N_DEV
+    with pytest.raises(LightGBMError,
+                       match=f"needs {need} devices, have {N_DEV}"):
+        create_mesh(f"data:2,feature:{N_DEV}", "data")
+
+
+def test_2d_mesh_shape_rejects_zero_axis():
+    """Zero/negative axis sizes raise naming the offending axis part."""
+    from lightgbm_tpu.parallel.mesh import create_mesh, parse_mesh_shape
+    for spec, bad in [("data:0,feature:2", "data:0"),
+                      ("data:2,feature:0", "feature:0"),
+                      ("data:2,feature:-1", "feature:-1")]:
+        with pytest.raises(LightGBMError, match="non-positive size"):
+            parse_mesh_shape(spec)
+        try:
+            create_mesh(spec, "data")
+            raise AssertionError("create_mesh accepted " + spec)
+        except LightGBMError as e:
+            assert bad in str(e), (spec, str(e))
+
+
+@needs_mesh
+def test_2d_mesh_only_data_learner():
+    """Only tree_learner=data consumes both axes; the other learners
+    still refuse a combined mesh (the refusal now points at the 2D
+    spelling instead of claiming 2-axis sharding is unsupported)."""
+    from lightgbm_tpu.parallel.mesh import create_mesh
+    m = create_mesh("data:2,feature:2", "data")
+    assert m is not None and m.shape == {"data": 2, "feature": 2}
+    for learner in ("serial", "feature", "voting"):
+        with pytest.raises(LightGBMError, match="2-axis"):
+            create_mesh("data:2,feature:2", learner)
+    # unknown second axes stay refused even for tree_learner=data
+    with pytest.raises(LightGBMError, match="2-axis"):
+        create_mesh("data:2,model:2", "data")
+    # trailing size-1 axes remain harmless for sweep tooling
+    m1 = create_mesh("data:2,feature:1", "data")
+    assert m1 is not None and m1.shape == {"data": 2, "feature": 1}
